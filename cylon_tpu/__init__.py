@@ -1,0 +1,61 @@
+"""cylon_tpu: a TPU-native distributed data-parallel relational framework.
+
+Brand-new design with the capabilities of the reference library studied in
+SURVEY.md (vibhatha/cylon): an Arrow-compatible columnar Table whose columns
+live in TPU HBM as XLA device buffers, relational kernels lowered to
+jit-compiled XLA computations, and a mesh communicator running the shuffle
+over ICI via ``lax.all_to_all`` — no MPI, no per-row C++ loops.
+"""
+import os
+
+import jax
+
+# Dataframe semantics need 64-bit ints/floats (CSV ints are int64, pandas
+# float is float64). Opt out with CYLON_TPU_NO_X64=1 for pure-32-bit
+# pipelines (TPU int64 is emulated; hot benchmarks should use 32-bit columns).
+if not os.environ.get("CYLON_TPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+from . import dtypes  # noqa: E402
+from .column import Column  # noqa: E402
+from .config import (  # noqa: E402
+    CommConfig,
+    CommType,
+    CPUConfig,
+    LocalConfig,
+    MPIConfig,
+    TPUConfig,
+)
+from .context import CylonContext  # noqa: E402
+from .io import (  # noqa: E402
+    CSVReadOptions,
+    CSVWriteOptions,
+    read_csv,
+    read_parquet,
+    write_csv,
+    write_parquet,
+)
+from .table import Table, concat, merge  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Column",
+    "CommConfig",
+    "CommType",
+    "CPUConfig",
+    "CSVReadOptions",
+    "CSVWriteOptions",
+    "CylonContext",
+    "LocalConfig",
+    "MPIConfig",
+    "TPUConfig",
+    "Table",
+    "concat",
+    "dtypes",
+    "merge",
+    "read_csv",
+    "read_parquet",
+    "write_csv",
+    "write_parquet",
+]

@@ -1,0 +1,119 @@
+"""Column: a typed, nullable device-resident column.
+
+Reference analog: ``cylon::Column`` wrapping ``arrow::ChunkedArray``
+(cpp/src/cylon/column.hpp:31-104). Here the physical storage is a single
+fixed-capacity ``jax.Array`` (rows beyond the table's valid count are padding),
+plus an optional bool validity mask (Arrow validity-bitmap analog) and, for
+dictionary-encoded types, a host-side **sorted** numpy dictionary so that code
+order == value order (sorts/range-partitions work on codes directly).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import DataType, Type
+
+
+class Column:
+    __slots__ = ("data", "valid", "dtype", "dictionary")
+
+    def __init__(
+        self,
+        data: jax.Array,
+        dtype: DataType,
+        valid: Optional[jax.Array] = None,
+        dictionary: Optional[np.ndarray] = None,
+    ):
+        self.data = data
+        self.dtype = dtype
+        self.valid = valid  # None == all rows valid
+        self.dictionary = dictionary
+        if dtype.is_dictionary and dictionary is None:
+            raise ValueError("dictionary-encoded column requires a dictionary")
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def encode_host(values: np.ndarray) -> tuple[np.ndarray, Optional[np.ndarray], DataType, Optional[np.ndarray]]:
+        """Host-side: raw numpy values -> (physical data, valid, dtype, dict).
+
+        Strings/objects are dictionary-encoded with a *sorted* dictionary
+        (np.unique) so code comparisons are order-equivalent to value
+        comparisons. NaN / None / NaT become nulls.
+        """
+        values = np.asarray(values)
+        if values.dtype.kind in ("U", "S", "O"):
+            vals = np.asarray(values, dtype=object)
+            is_null = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in vals])
+            filler = ""
+            safe = np.where(is_null, filler, vals)
+            dictionary, codes = np.unique(np.asarray(safe, dtype=str), return_inverse=True)
+            codes = codes.astype(np.int32)
+            valid = None if not is_null.any() else ~is_null
+            return codes, valid, DataType(Type.STRING), dictionary
+        if values.dtype.kind == "M":  # datetime64 -> int64 ns
+            data = values.astype("datetime64[ns]").astype(np.int64)
+            is_null = np.isnat(values)
+            valid = None if not is_null.any() else ~is_null
+            return data, valid, DataType(Type.TIMESTAMP), None
+        if values.dtype.kind == "f":
+            is_null = np.isnan(values)
+            valid = None if not is_null.any() else ~is_null
+            return values, valid, DataType.from_numpy_dtype(values.dtype), None
+        return values, None, DataType.from_numpy_dtype(values.dtype), None
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def with_data(self, data, valid="__same__") -> "Column":
+        v = self.valid if valid == "__same__" else valid
+        return Column(data, self.dtype, v, self.dictionary)
+
+    def valid_mask(self) -> jax.Array:
+        """Materialized validity mask (all-true if None)."""
+        if self.valid is None:
+            return jnp.ones(self.data.shape, dtype=bool)
+        return self.valid
+
+    # -- host conversion ----------------------------------------------------
+    def decode_host(self, data_np: np.ndarray, valid_np: Optional[np.ndarray]):
+        """Physical host values -> logical numpy values (strings decoded,
+        nulls as NaN/None)."""
+        if self.dtype.is_dictionary:
+            out = self.dictionary[np.clip(data_np, 0, len(self.dictionary) - 1)]
+            out = out.astype(object)
+            if valid_np is not None:
+                out[~valid_np] = None
+            return out
+        if self.dtype.type == Type.TIMESTAMP:
+            out = data_np.astype("datetime64[ns]")
+            if valid_np is not None:
+                out[~valid_np] = np.datetime64("NaT")
+            return out
+        if valid_np is not None and not valid_np.all():
+            out = data_np.astype(np.float64, copy=True)
+            out[~valid_np] = np.nan
+            return out
+        return data_np
+
+    def __repr__(self):
+        return f"Column({self.dtype}, cap={self.capacity}, nullable={self.valid is not None})"
+
+
+def unify_dictionaries(a: Column, b: Column) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build the union dictionary of two dictionary columns and the
+    old-code -> new-code remapping vectors (host side).
+
+    Needed before any cross-table comparison/hash of string columns: each
+    table encodes its strings against its own dictionary; the union keeps the
+    sorted invariant so code order remains value order.
+    """
+    union = np.union1d(a.dictionary, b.dictionary)
+    map_a = np.searchsorted(union, a.dictionary).astype(np.int32)
+    map_b = np.searchsorted(union, b.dictionary).astype(np.int32)
+    return union, map_a, map_b

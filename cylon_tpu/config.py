@@ -1,0 +1,78 @@
+"""Communication / context configuration.
+
+Mirrors the reference's ``CommConfig``/``CommType`` layer
+(reference: cpp/src/cylon/net/comm_config.hpp:22-36, net/comm_type.hpp) but the
+concrete backends are TPU-native:
+
+- ``LOCAL``  -> single device, no collectives (reference CommType::LOCAL)
+- ``TPU``    -> a jax.sharding.Mesh over the ICI-connected devices; collectives
+               are XLA all_to_all / psum over the mesh axis (replaces the
+               reference's MPI backend, net/mpi/mpi_communicator.cpp:51-66).
+- ``CPU``    -> same code path on host CPU devices (used by tests via
+               ``--xla_force_host_platform_device_count``).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, Optional, Sequence
+
+
+class CommType(enum.IntEnum):
+    LOCAL = 0
+    TPU = 1
+    CPU = 2
+
+
+class CommConfig:
+    """Base config. Key/value store like reference CommConfig (void* KV)."""
+
+    def __init__(self) -> None:
+        self._config: Dict[str, Any] = {}
+
+    def comm_type(self) -> CommType:
+        raise NotImplementedError
+
+    def add_config(self, key: str, value: Any) -> None:
+        self._config[key] = value
+
+    def get_config(self, key: str, default: Any = None) -> Any:
+        return self._config.get(key, default)
+
+
+class LocalConfig(CommConfig):
+    """Single-device execution (no mesh axis)."""
+
+    def comm_type(self) -> CommType:
+        return CommType.LOCAL
+
+
+class TPUConfig(CommConfig):
+    """Distributed execution over a device mesh.
+
+    Parameters
+    ----------
+    devices: explicit device list (default: all ``jax.devices()``).
+    axis_name: mesh axis name used by collectives (default ``"dp"``).
+
+    This is the user-visible switch replacing the reference's ``MPIConfig``
+    (python/pycylon/net/mpi_config.pyx): ``CylonEnv(config=TPUConfig())``.
+    """
+
+    def __init__(self, devices: Optional[Sequence[Any]] = None, axis_name: str = "dp"):
+        super().__init__()
+        self.devices = devices
+        self.axis_name = axis_name
+
+    def comm_type(self) -> CommType:
+        return CommType.TPU
+
+
+# Alias used by tests / CPU runs; identical semantics, host devices.
+class CPUConfig(TPUConfig):
+    def comm_type(self) -> CommType:
+        return CommType.CPU
+
+
+# pycylon compatibility alias: reference users write MPIConfig(); here it maps
+# onto the mesh-based backend (there is no MPI in the loop).
+MPIConfig = TPUConfig

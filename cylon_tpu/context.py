@@ -1,0 +1,138 @@
+"""CylonContext: the entry point owning the device mesh and communicator.
+
+Reference analog: ``cylon::CylonContext`` (cpp/src/cylon/ctx/cylon_context.hpp:29-146)
+owns the MPI communicator, a string KV config map and sequence numbers for
+concurrent collectives. Here the "communicator" is a ``jax.sharding.Mesh``;
+rank/world_size map to process_index/mesh size; Barrier is
+``block_until_ready`` on a tiny collective (XLA collectives are themselves
+synchronizing, so an explicit barrier is rarely needed).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .config import CommConfig, CommType, LocalConfig, TPUConfig
+
+
+class CylonContext:
+    """Holds the mesh, config KV map, and collective sequence numbers.
+
+    Create via :meth:`init` (local, 1 device) or :meth:`init_distributed`
+    (mesh over all visible devices), mirroring ``CylonContext::Init`` /
+    ``InitDistributed`` (reference ctx/cylon_context.cpp:25-41).
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str, comm_type: CommType):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.comm_type = comm_type
+        self._config: Dict[str, str] = {}
+        self._sequence = itertools.count()
+        self._finalized = False
+
+    # -- factory ------------------------------------------------------------
+    @classmethod
+    def init(cls, config: Optional[CommConfig] = None) -> "CylonContext":
+        """Local (single-device) context; reference CylonContext::Init."""
+        if config is not None and config.comm_type() != CommType.LOCAL:
+            return cls.init_distributed(config)
+        dev = jax.devices()[0]
+        mesh = Mesh(np.array([dev]), ("dp",))
+        return cls(mesh, "dp", CommType.LOCAL)
+
+    @classmethod
+    def init_distributed(cls, config: CommConfig) -> "CylonContext":
+        """Distributed context over a device mesh.
+
+        Reference ``InitDistributed`` accepts only MPI and throws otherwise
+        (ctx/cylon_context.cpp:33-41); here we accept mesh-based configs.
+        """
+        if not isinstance(config, TPUConfig):
+            raise ValueError(
+                f"distributed init requires TPUConfig/CPUConfig, got {type(config)}"
+            )
+        devices = config.devices if config.devices is not None else jax.devices()
+        mesh = Mesh(np.asarray(devices), (config.axis_name,))
+        return cls(mesh, config.axis_name, config.comm_type())
+
+    # -- identity -----------------------------------------------------------
+    def get_world_size(self) -> int:
+        return self.mesh.size
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    def get_rank(self) -> int:
+        # single-controller JAX: the "rank" is the process index (0 except
+        # under multi-host jax.distributed).
+        return jax.process_index()
+
+    @property
+    def rank(self) -> int:
+        return self.get_rank()
+
+    def get_neighbours(self, include_self: bool = False):
+        """Reference GetNeighbours (ctx/cylon_context.cpp:87)."""
+        w = self.get_world_size()
+        r = self.get_rank()
+        return [i for i in range(w) if include_self or i != r]
+
+    def is_distributed(self) -> bool:
+        return self.mesh.size > 1
+
+    # -- config KV (reference AddConfig/GetConfig, cylon_context.hpp:60-69) --
+    def add_config(self, key: str, value: str) -> None:
+        self._config[key] = value
+
+    def get_config(self, key: str, default: str = "") -> str:
+        return self._config.get(key, default)
+
+    # -- sequencing (reference GetNextSequence, cylon_context.cpp:106) ------
+    def get_next_sequence(self) -> int:
+        return next(self._sequence)
+
+    # -- sharding helpers ---------------------------------------------------
+    @property
+    def spec(self) -> PartitionSpec:
+        """Row-sharded partition spec for table columns."""
+        return PartitionSpec(self.axis_name)
+
+    @property
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec)
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    # -- sync ---------------------------------------------------------------
+    def barrier(self) -> None:
+        """Reference Barrier (ctx/cylon_context.hpp:143). XLA collectives are
+        synchronizing; this blocks the host on an all-device no-op."""
+        x = jax.device_put(
+            np.zeros(self.mesh.size, np.int32), self.sharding
+        )
+        jax.block_until_ready(jax.jit(lambda v: v + 1)(x))
+
+    def finalize(self) -> None:
+        self._finalized = True
+
+    def is_finalized(self) -> bool:
+        return self._finalized
+
+    def memory_usage(self) -> int:
+        """Total live device memory (bytes) across the mesh, best effort."""
+        total = 0
+        for d in self.mesh.devices.flat:
+            try:
+                stats = d.memory_stats()
+                total += stats.get("bytes_in_use", 0)
+            except Exception:
+                pass
+        return total

@@ -1,0 +1,184 @@
+"""Data type system for cylon_tpu.
+
+Mirrors the reference's stripped-down Arrow type enum (reference:
+cpp/src/cylon/data_types.hpp:25-84) but maps every logical type onto a
+TPU-resident physical representation:
+
+- numeric / bool / temporal types -> a jnp dtype stored directly in HBM
+- STRING / BINARY -> dictionary encoding: int32 codes in HBM + a host-side
+  sorted numpy dictionary (codes are order-preserving, so sorts and range
+  partitions operate on codes alone).
+
+Nullability is carried by a separate bool validity mask (Arrow validity
+bitmap analog, reference: cpp/src/cylon/arrow/arrow_partition_kernels.cpp:171-179).
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class Type(enum.IntEnum):
+    """Logical types (reference data_types.hpp:25-64)."""
+
+    BOOL = 0
+    UINT8 = 1
+    INT8 = 2
+    UINT16 = 3
+    INT16 = 4
+    UINT32 = 5
+    INT32 = 6
+    UINT64 = 7
+    INT64 = 8
+    HALF_FLOAT = 9
+    FLOAT = 10
+    DOUBLE = 11
+    STRING = 12
+    BINARY = 13
+    DATE32 = 16
+    DATE64 = 17
+    TIMESTAMP = 18
+    TIME32 = 19
+    TIME64 = 20
+
+
+class Layout(enum.IntEnum):
+    """Physical layout (reference data_types.hpp:66-74)."""
+
+    FIXED_WIDTH = 1
+    VARIABLE_WIDTH = 2
+
+
+_NUMPY_TO_TYPE = {
+    np.dtype(np.bool_): Type.BOOL,
+    np.dtype(np.uint8): Type.UINT8,
+    np.dtype(np.int8): Type.INT8,
+    np.dtype(np.uint16): Type.UINT16,
+    np.dtype(np.int16): Type.INT16,
+    np.dtype(np.uint32): Type.UINT32,
+    np.dtype(np.int32): Type.INT32,
+    np.dtype(np.uint64): Type.UINT64,
+    np.dtype(np.int64): Type.INT64,
+    np.dtype(np.float16): Type.HALF_FLOAT,
+    np.dtype(np.float32): Type.FLOAT,
+    np.dtype(np.float64): Type.DOUBLE,
+}
+
+_TYPE_TO_NUMPY = {v: k for k, v in _NUMPY_TO_TYPE.items()}
+# dictionary-encoded types store int32 codes on device
+_TYPE_TO_NUMPY[Type.STRING] = np.dtype(np.int32)
+_TYPE_TO_NUMPY[Type.BINARY] = np.dtype(np.int32)
+_TYPE_TO_NUMPY[Type.DATE32] = np.dtype(np.int32)
+_TYPE_TO_NUMPY[Type.DATE64] = np.dtype(np.int64)
+_TYPE_TO_NUMPY[Type.TIMESTAMP] = np.dtype(np.int64)
+_TYPE_TO_NUMPY[Type.TIME32] = np.dtype(np.int32)
+_TYPE_TO_NUMPY[Type.TIME64] = np.dtype(np.int64)
+
+
+class DataType:
+    """A logical column type.
+
+    ``physical_dtype`` is the numpy/jnp dtype of the on-device buffer.
+    Dictionary-encoded types (STRING/BINARY) store int32 codes on device.
+    """
+
+    __slots__ = ("type",)
+
+    def __init__(self, type_: Type):
+        self.type = Type(type_)
+
+    @property
+    def layout(self) -> Layout:
+        if self.type in (Type.STRING, Type.BINARY):
+            return Layout.VARIABLE_WIDTH
+        return Layout.FIXED_WIDTH
+
+    @property
+    def is_dictionary(self) -> bool:
+        return self.type in (Type.STRING, Type.BINARY)
+
+    @property
+    def is_numeric(self) -> bool:
+        return Type.UINT8 <= self.type <= Type.DOUBLE
+
+    @property
+    def is_floating(self) -> bool:
+        return self.type in (Type.HALF_FLOAT, Type.FLOAT, Type.DOUBLE)
+
+    @property
+    def physical_dtype(self) -> np.dtype:
+        return _TYPE_TO_NUMPY[self.type]
+
+    @classmethod
+    def from_numpy_dtype(cls, dt) -> "DataType":
+        dt = np.dtype(dt)
+        if dt.kind in ("U", "S", "O"):
+            return cls(Type.STRING)
+        if dt.kind == "M":  # datetime64
+            return cls(Type.TIMESTAMP)
+        t = _NUMPY_TO_TYPE.get(dt)
+        if t is None:
+            raise TypeError(f"unsupported dtype {dt}")
+        return cls(t)
+
+    def __eq__(self, other):
+        return isinstance(other, DataType) and self.type == other.type
+
+    def __hash__(self):
+        return hash(self.type)
+
+    def __repr__(self):
+        return f"DataType({self.type.name})"
+
+
+def bool_() -> DataType:
+    return DataType(Type.BOOL)
+
+
+def int8() -> DataType:
+    return DataType(Type.INT8)
+
+
+def int16() -> DataType:
+    return DataType(Type.INT16)
+
+
+def int32() -> DataType:
+    return DataType(Type.INT32)
+
+
+def int64() -> DataType:
+    return DataType(Type.INT64)
+
+
+def uint8() -> DataType:
+    return DataType(Type.UINT8)
+
+
+def uint16() -> DataType:
+    return DataType(Type.UINT16)
+
+
+def uint32() -> DataType:
+    return DataType(Type.UINT32)
+
+
+def uint64() -> DataType:
+    return DataType(Type.UINT64)
+
+
+def float32() -> DataType:
+    return DataType(Type.FLOAT)
+
+
+def float64() -> DataType:
+    return DataType(Type.DOUBLE)
+
+
+def string() -> DataType:
+    return DataType(Type.STRING)
+
+
+def timestamp() -> DataType:
+    return DataType(Type.TIMESTAMP)

@@ -1,0 +1,64 @@
+"""Execution engine: cached jit+shard_map kernel dispatch.
+
+Every relational op is a per-shard, static-shaped kernel run under
+``jax.shard_map`` over the context mesh. This module provides:
+
+- capacity rounding (power-of-two buckets so jit's shape-specialized cache
+  stays warm across calls with slightly different sizes);
+- a per-context cache of jitted shard_map callables keyed by (op, statics) —
+  shape specialization inside each entry is handled by jit itself;
+- the standard calling convention: ``kernel(dp_args, rep_args) -> dp_outputs``
+  where dp_args/outputs are per-shard (row-sharded) pytrees and rep_args are
+  replicated (e.g. shape-carrying dummies that tell the kernel its output
+  capacity).
+
+Reference analog: this replaces the reference's eager C++ call tree — there,
+each op is a hand-written loop nest (cpp/src/cylon/table.cpp); here each op is
+one XLA program per (shapes, statics) combination, compiled once and reused.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from .context import CylonContext
+
+
+def round_cap(n: int, minimum: int = 8) -> int:
+    """Round a capacity up to a power of two (>= minimum)."""
+    n = max(int(n), minimum)
+    return 1 << (n - 1).bit_length()
+
+
+def shard_caps(total_rows: int, world: int) -> Tuple[np.ndarray, int]:
+    """Even row split of a global table: (per-shard counts [P], shard cap)."""
+    base, rem = divmod(int(total_rows), world)
+    counts = np.array([base + (1 if i < rem else 0) for i in range(world)], np.int64)
+    return counts, round_cap(counts.max() if world else 0)
+
+
+def get_kernel(
+    ctx: CylonContext, key: Tuple, builder: Callable[[], Callable]
+) -> Callable:
+    """Fetch (or build+jit) the shard_map-wrapped kernel for this context."""
+    cache = ctx.__dict__.setdefault("_jit_cache", {})
+    fn = cache.get(key)
+    if fn is None:
+        kernel = builder()
+        fn = jax.jit(
+            jax.shard_map(
+                kernel,
+                mesh=ctx.mesh,
+                in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
+                out_specs=PartitionSpec(ctx.axis_name),
+            )
+        )
+        cache[key] = fn
+    return fn
+
+
+def run(ctx: CylonContext, key: Tuple, builder, dp_args, rep_args=()):
+    return get_kernel(ctx, key, builder)(dp_args, rep_args)

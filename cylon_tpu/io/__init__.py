@@ -1,0 +1,11 @@
+from .csv import CSVReadOptions, CSVWriteOptions, read_csv, write_csv
+from .parquet import read_parquet, write_parquet
+
+__all__ = [
+    "CSVReadOptions",
+    "CSVWriteOptions",
+    "read_csv",
+    "write_csv",
+    "read_parquet",
+    "write_parquet",
+]

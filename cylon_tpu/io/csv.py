@@ -1,0 +1,112 @@
+"""CSV ingest/egress at the host boundary.
+
+Reference analog: io/arrow_io.cpp:33-61 (Arrow csv::TableReader over mmap),
+CSVReadOptions builder (io/csv_read_config.hpp), WriteCSV row-wise printer
+(table.cpp:244-253), and multi-file concurrent reads (table.cpp:791-829).
+
+Device data never round-trips through CSV parsing: pyarrow's multithreaded
+native reader produces host columns that are padded + device_put once.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..context import CylonContext
+from ..table import Table
+
+
+class CSVReadOptions:
+    """Builder-style options (reference io/csv_read_config.hpp:30+)."""
+
+    def __init__(self):
+        self._delimiter = ","
+        self._use_threads = True
+        self._block_size = 1 << 20
+        self._skip_rows = 0
+        self._column_names: Optional[List[str]] = None
+
+    def with_delimiter(self, d: str) -> "CSVReadOptions":
+        self._delimiter = d
+        return self
+
+    def use_threads(self, flag: bool) -> "CSVReadOptions":
+        self._use_threads = flag
+        return self
+
+    def block_size(self, b: int) -> "CSVReadOptions":
+        self._block_size = b
+        return self
+
+    def skip_rows(self, n: int) -> "CSVReadOptions":
+        self._skip_rows = n
+        return self
+
+    def with_column_names(self, names: Sequence[str]) -> "CSVReadOptions":
+        self._column_names = list(names)
+        return self
+
+
+class CSVWriteOptions:
+    def __init__(self):
+        self._delimiter = ","
+
+    def with_delimiter(self, d: str) -> "CSVWriteOptions":
+        self._delimiter = d
+        return self
+
+
+def _read_one(path: str, options: CSVReadOptions) -> Dict[str, np.ndarray]:
+    from pyarrow import csv as pacsv
+
+    ropts = pacsv.ReadOptions(
+        use_threads=options._use_threads,
+        block_size=options._block_size,
+        skip_rows=options._skip_rows,
+        column_names=options._column_names,
+    )
+    popts = pacsv.ParseOptions(delimiter=options._delimiter)
+    at = pacsv.read_csv(path, read_options=ropts, parse_options=popts)
+    out = {}
+    for name in at.column_names:
+        col = at.column(name)
+        np_col = col.to_numpy(zero_copy_only=False)
+        out[name] = np_col
+    return out
+
+
+def read_csv(
+    ctx: CylonContext,
+    paths: Union[str, Sequence[str]],
+    options: Optional[CSVReadOptions] = None,
+) -> Table:
+    """Read CSV file(s) into a sharded Table.
+
+    - single path: rows are split evenly across the mesh;
+    - list of world_size paths: file i becomes shard i's partition (the
+      reference's per-rank ``csv1_{RANK}.csv`` pattern, and its concurrent
+      multi-file read, table.cpp:791-829 — here a thread pool).
+    """
+    options = options or CSVReadOptions()
+    if isinstance(paths, (list, tuple)):
+        with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
+            shards = list(ex.map(lambda p: _read_one(p, options), paths))
+        if len(shards) == 1:
+            return Table.from_pydict(ctx, shards[0])
+        if len(shards) != ctx.world_size:
+            # concat then re-split evenly
+            names = list(shards[0].keys())
+            merged = {n: np.concatenate([s[n] for s in shards]) for n in names}
+            return Table.from_pydict(ctx, merged)
+        return Table.from_shards(ctx, shards)
+    return Table.from_pydict(ctx, _read_one(paths, options))
+
+
+def write_csv(
+    table: Table, path: str, options: Optional[CSVWriteOptions] = None
+) -> None:
+    """Reference WriteCSV (table.cpp:244-253)."""
+    options = options or CSVWriteOptions()
+    table.to_pandas().to_csv(path, index=False, sep=options._delimiter)

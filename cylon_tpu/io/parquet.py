@@ -1,0 +1,38 @@
+"""Parquet ingest/egress (reference io/arrow_io.cpp:63-116, gated there by
+BUILD_CYLON_PARQUET; always available here via pyarrow)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from ..context import CylonContext
+from ..table import Table
+
+
+def read_parquet(ctx: CylonContext, paths: Union[str, Sequence[str]]) -> Table:
+    import pyarrow.parquet as pq
+
+    if isinstance(paths, (list, tuple)):
+        shards = []
+        for p in paths:
+            at = pq.read_table(p)
+            shards.append(
+                {n: at.column(n).to_numpy(zero_copy_only=False) for n in at.column_names}
+            )
+        if len(shards) == ctx.world_size:
+            return Table.from_shards(ctx, shards)
+        names = list(shards[0].keys())
+        merged = {n: np.concatenate([s[n] for s in shards]) for n in names}
+        return Table.from_pydict(ctx, merged)
+    at = pq.read_table(paths)
+    return Table.from_pydict(
+        ctx, {n: at.column(n).to_numpy(zero_copy_only=False) for n in at.column_names}
+    )
+
+
+def write_parquet(table: Table, path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(table.to_arrow(), path)

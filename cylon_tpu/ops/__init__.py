@@ -1,0 +1,1 @@
+from . import factorize, groupby, hash, join, partition, setops, sort  # noqa: F401

@@ -1,0 +1,174 @@
+"""Sort/segment-based groupby-aggregate kernels.
+
+Reference analog: cpp/src/cylon/groupby/hash_groupby.cpp — ``make_groups``
+builds dense group ids via a row-hash map (:92-126) then typed aggregate
+kernels run per column (aggregate<op> templates, resolver ~:143-230); the
+aggregate op set {SUM, COUNT, MIN, MAX, MEAN, VAR, STDDEV, NUNIQUE, QUANTILE}
+comes from compute/aggregate_kernels.hpp:40-50.
+
+TPU-native design: group ids come from :func:`factorize` (lexsort +
+run-detect — ids are dense AND in sorted key order, so the output doubles as
+the sorted-key pipeline groupby, groupby/pipeline_groupby.cpp); aggregates are
+XLA ``segment_sum/min/max`` ops, which lower to efficient sorted-segment
+reductions. Count/emit split: ``num_groups`` is the only host sync.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .factorize import factorize
+from .sort import KeyCol
+
+# aggregation op ids, mirroring reference AggregationOpId
+# (compute/aggregate_kernels.hpp:40-50)
+SUM, COUNT, MIN, MAX, MEAN, VAR, STDDEV, NUNIQUE, QUANTILE, COUNT_DISTINCT = range(10)
+
+_AGG_NAMES = {
+    "sum": SUM, "count": COUNT, "min": MIN, "max": MAX, "mean": MEAN,
+    "avg": MEAN, "var": VAR, "std": STDDEV, "stddev": STDDEV,
+    "nunique": NUNIQUE, "quantile": QUANTILE, "median": QUANTILE,
+    "count_distinct": NUNIQUE, "size": COUNT,
+}
+
+
+def agg_op_id(name) -> int:
+    if isinstance(name, int):
+        return name
+    try:
+        return _AGG_NAMES[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown aggregation {name!r}") from None
+
+
+def group_ids(
+    key_cols: Sequence[KeyCol], n: jax.Array, cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """(ids [cap] int32 with padding -> cap, num_groups scalar)."""
+    return factorize(key_cols, n, cap)
+
+
+def group_representatives(ids: jax.Array, cap_out: int) -> jax.Array:
+    """First-occurrence row index of each group id -> [cap_out] int32.
+
+    Entries for ids >= cap_out are dropped; absent groups get cap (clamp on
+    gather + group count masking makes that safe).
+    """
+    cap = ids.shape[0]
+    rows = jnp.arange(cap, dtype=jnp.int32)
+    rep = jnp.full((cap_out,), cap, jnp.int32)
+    # min row index per id == first occurrence
+    return rep.at[ids].min(rows, mode="drop")
+
+
+def _masked(values: jax.Array, valid: Optional[jax.Array], fill) -> jax.Array:
+    if valid is None:
+        return values
+    return jnp.where(valid, values, jnp.asarray(fill, values.dtype))
+
+
+def _seg_sum(vals, ids, cap_out):
+    return jnp.zeros((cap_out,), vals.dtype).at[ids].add(vals, mode="drop")
+
+
+def _seg_min(vals, ids, cap_out, init):
+    return jnp.full((cap_out,), init, vals.dtype).at[ids].min(vals, mode="drop")
+
+
+def _seg_max(vals, ids, cap_out, init):
+    return jnp.full((cap_out,), init, vals.dtype).at[ids].max(vals, mode="drop")
+
+
+def _type_extrema(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype), jnp.array(-jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max, dtype), jnp.asarray(info.min, dtype)
+
+
+def aggregate_column(
+    op: int,
+    data: jax.Array,
+    valid: Optional[jax.Array],
+    ids: jax.Array,
+    num_groups: jax.Array,
+    cap_out: int,
+    ddof: int = 1,
+    quantile: float = 0.5,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Aggregate one value column over group ids. Null entries are skipped
+    (pandas semantics; count counts non-null). Returns (out [cap_out], valid).
+    """
+    vmask = valid if valid is not None else jnp.ones(data.shape, bool)
+    # padding rows already have ids == cap (dropped by mode="drop" scatters
+    # when cap >= cap_out; make sure by re-masking)
+    live_ids = jnp.where(vmask, ids, jnp.int32(data.shape[0]))
+    cnt = _seg_sum(vmask.astype(jnp.int64), live_ids, cap_out)
+    gmask = jnp.arange(cap_out) < num_groups
+    if op == COUNT:
+        return jnp.where(gmask, cnt, 0), None
+    if op == SUM:
+        acc = data.astype(jnp.int64) if jnp.issubdtype(data.dtype, jnp.integer) else data
+        s = _seg_sum(_masked(acc, vmask, 0), live_ids, cap_out)
+        return jnp.where(gmask, s, jnp.zeros_like(s)), gmask & (cnt > 0) if valid is not None else None
+    if op in (MIN, MAX):
+        hi, lo = _type_extrema(data.dtype)
+        if op == MIN:
+            out = _seg_min(_masked(data, vmask, hi), live_ids, cap_out, hi)
+        else:
+            out = _seg_max(_masked(data, vmask, lo), live_ids, cap_out, lo)
+        has = gmask & (cnt > 0)
+        return out, (has if valid is not None else None)
+    if op == MEAN:
+        s = _seg_sum(_masked(data.astype(jnp.float64), vmask, 0.0), live_ids, cap_out)
+        out = s / jnp.maximum(cnt, 1)
+        return jnp.where(gmask, out, 0.0), gmask & (cnt > 0)
+    if op in (VAR, STDDEV):
+        x = _masked(data.astype(jnp.float64), vmask, 0.0)
+        s = _seg_sum(x, live_ids, cap_out)
+        ss = _seg_sum(x * x, live_ids, cap_out)
+        denom = jnp.maximum(cnt - ddof, 1)
+        mean = s / jnp.maximum(cnt, 1)
+        var = (ss - s * mean) / denom
+        var = jnp.maximum(var, 0.0)
+        out = jnp.sqrt(var) if op == STDDEV else var
+        return jnp.where(gmask, out, 0.0), gmask & (cnt > ddof)
+    if op == NUNIQUE:
+        # distinct (id, value) pairs: lexsort by (id, value), run-detect
+        cap = data.shape[0]
+        d = data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
+        order = jnp.lexsort((d, live_ids))
+        sid = live_ids[order]
+        sval = d[order]
+        newpair = (
+            (sid != jnp.roll(sid, 1)) | (sval != jnp.roll(sval, 1))
+        ).at[0].set(True)
+        uniq = _seg_sum(newpair.astype(jnp.int64), sid, cap_out)
+        return jnp.where(gmask, uniq, 0), None
+    if op == QUANTILE:
+        cap = data.shape[0]
+        d = _masked(data.astype(jnp.float64), vmask, jnp.inf)
+        order = jnp.lexsort((d, live_ids))
+        sid = live_ids[order]
+        sval = d[order]
+        starts = jnp.searchsorted(sid, jnp.arange(cap_out), side="left").astype(jnp.int32)
+        q = quantile
+        pos = starts.astype(jnp.float64) + q * jnp.maximum(cnt - 1, 0)
+        lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, cap - 1)
+        hi_i = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, cap - 1)
+        frac = pos - jnp.floor(pos)
+        out = sval[lo_i] * (1 - frac) + sval[hi_i] * frac
+        has = gmask & (cnt > 0)
+        return jnp.where(has, out, 0.0), has
+    raise ValueError(f"unsupported aggregation op {op}")
+
+
+# ops that can be pre-combined locally before the shuffle (reference
+# ASSOCIATIVE_OPS = {SUM, MIN, MAX}, groupby/groupby.cpp:24-31; COUNT combines
+# as SUM of partial counts)
+ASSOCIATIVE = frozenset({SUM, MIN, MAX})

@@ -1,0 +1,167 @@
+"""Sort-based equi-join kernels.
+
+Reference analog: cpp/src/cylon/join/ — hash join (hash_join.cpp:309-346,
+multimap build/probe) and sort join (sort_join.cpp, argsort + merge with run
+detection). On TPU, scatter-heavy hash multimaps are hostile to the memory
+system while sorts are native, so the single algorithm here is:
+
+  1. ``factorize_two``: both tables' key tuples -> one dense id space
+     (replaces TwoTableRowIndexHash maps);
+  2. sort right ids, ``searchsorted`` each left id for its match run
+     (replaces the multimap probe);
+  3. count phase -> exact output size (host syncs once);
+  4. emit phase: ``jnp.repeat`` + gather produce (left_idx, right_idx) pairs
+     with -1 marking the null side of outer joins
+     (reference emits via probe_hash_map_no_fill/with_fill/outer,
+     hash_join.cpp:21-90, and build_final_table join_utils.cpp:28-160).
+
+Join types: INNER/LEFT/RIGHT/FULL_OUTER (join/join_config.hpp:26-45).
+All functions are static-shaped and jit-safe; the count->emit split is the
+only host round-trip.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .factorize import factorize_two
+from .sort import KeyCol
+
+INNER, LEFT, RIGHT, FULL_OUTER = 0, 1, 2, 3
+_JOIN_TYPES = {"inner": INNER, "left": LEFT, "right": RIGHT, "fullouter": FULL_OUTER,
+               "outer": FULL_OUTER, "full_outer": FULL_OUTER}
+
+
+def join_type_id(how: str) -> int:
+    try:
+        return _JOIN_TYPES[how.replace("-", "_").lower()]
+    except KeyError:
+        raise ValueError(f"unknown join type {how!r}") from None
+
+
+class _Probe(NamedTuple):
+    l_ids: jax.Array      # [cap_l] dense ids (padding -> big)
+    r_ids: jax.Array      # [cap_r]
+    r_order: jax.Array    # [cap_r] argsort of r_ids (stable)
+    r_sorted: jax.Array   # [cap_r] sorted r_ids
+    lo: jax.Array         # [cap_l] first match position in r_sorted
+    cnt: jax.Array        # [cap_l] match count per live left row
+    r_cnt: jax.Array      # [cap_r] match count per live right row
+
+
+def _probe(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+) -> _Probe:
+    l_ids, r_ids, _ = factorize_two(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
+    idx_r = jnp.arange(cap_r, dtype=jnp.int32)
+    big = jnp.int32(cap_l + cap_r)
+    l_ids = jnp.where(idx_l < nl, l_ids, big)
+    r_ids = jnp.where(idx_r < nr, r_ids, big)
+    r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+    r_sorted = r_ids[r_order]
+    lo = jnp.searchsorted(r_sorted, l_ids, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(r_sorted, l_ids, side="right").astype(jnp.int32)
+    cnt = jnp.where(idx_l < nl, hi - lo, 0).astype(jnp.int32)
+    l_sorted = jnp.sort(l_ids)
+    rlo = jnp.searchsorted(l_sorted, r_ids, side="left").astype(jnp.int32)
+    rhi = jnp.searchsorted(l_sorted, r_ids, side="right").astype(jnp.int32)
+    r_cnt = jnp.where(idx_r < nr, rhi - rlo, 0).astype(jnp.int32)
+    return _Probe(l_ids, r_ids, r_order, r_sorted, lo, cnt, r_cnt)
+
+
+def join_count(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    how: int,
+) -> jax.Array:
+    """Exact number of output rows for the given join type (scalar int32)."""
+    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    inner = jnp.sum(p.cnt)
+    l_un = jnp.sum((p.cnt == 0) & (jnp.arange(cap_l) < nl))
+    r_un = jnp.sum((p.r_cnt == 0) & (jnp.arange(cap_r) < nr))
+    total = inner
+    if how in (LEFT, FULL_OUTER):
+        total = total + l_un
+    if how in (RIGHT, FULL_OUTER):
+        total = total + r_un
+    return total.astype(jnp.int32)
+
+
+def join_emit(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    how: int,
+    cap_out: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Emit join row indices.
+
+    Returns (left_idx [cap_out], right_idx [cap_out], n_out scalar). Index -1
+    means "no row on this side" (outer joins). Rows >= n_out are padding.
+    ``cap_out`` must be >= the corresponding :func:`join_count`.
+    """
+    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
+    live_l = idx_l < nl
+    # per-left-row emitted count: outer-left rows emit one null-match row
+    if how in (LEFT, FULL_OUTER):
+        cnt_adj = jnp.where(live_l & (p.cnt == 0), 1, p.cnt)
+    else:
+        cnt_adj = p.cnt
+    offs = jnp.cumsum(cnt_adj) - cnt_adj  # exclusive prefix
+    total_l = jnp.sum(cnt_adj).astype(jnp.int32)
+
+    li = jnp.repeat(idx_l, cnt_adj, total_repeat_length=cap_out)
+    offs_rep = jnp.repeat(offs, cnt_adj, total_repeat_length=cap_out)
+    within = jnp.arange(cap_out, dtype=jnp.int32) - offs_rep
+    has_match = p.cnt[li] > 0
+    rpos = jnp.clip(p.lo[li] + within, 0, cap_r - 1)
+    ri = jnp.where(has_match, p.r_order[rpos], -1)
+    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
+    in_left_part = out_pos < total_l
+    li = jnp.where(in_left_part, li, -1)
+    ri = jnp.where(in_left_part, ri, -1)
+
+    n_out = total_l
+    if how in (RIGHT, FULL_OUTER):
+        idx_r = jnp.arange(cap_r, dtype=jnp.int32)
+        r_un = (p.r_cnt == 0) & (idx_r < nr)
+        r_un_rank = jnp.cumsum(r_un.astype(jnp.int32)) - 1
+        n_r_un = jnp.sum(r_un).astype(jnp.int32)
+        dest = jnp.where(r_un, total_l + r_un_rank, cap_out)  # cap_out = drop
+        ri = ri.at[dest].set(idx_r, mode="drop")
+        li = li.at[dest].set(-1, mode="drop")
+        n_out = total_l + n_r_un
+    return li, ri, n_out.astype(jnp.int32)
+
+
+def gather_column(
+    data: jax.Array, valid, idx: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather one column by (possibly -1) row indices.
+
+    Replaces the reference's typed gather ``copy_array_by_indices``
+    (util/copy_arrray.cpp). -1 indices produce null outputs.
+    """
+    safe = jnp.clip(idx, 0, data.shape[0] - 1)
+    out = data[safe]
+    ok = idx >= 0
+    if valid is None:
+        return out, ok
+    return out, ok & valid[safe]

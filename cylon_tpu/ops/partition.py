@@ -1,0 +1,100 @@
+"""Partition-id assignment: hash partitioning and sample-sort range partitioning.
+
+Reference analogs:
+- hash partition kernels (cpp/src/cylon/arrow/arrow_partition_kernels.cpp:
+  67-330): per-row murmur3 / pseudo-hash -> ``hash % num_partitions`` with a
+  power-of-2 fast path (:51-61). Here the hash is the vectorized murmur3 of
+  ops/hash.py and the modulo is one XLA op over the whole column.
+- range partition kernel (:332-455): sample ``num_samples`` values, global
+  min/max, build a ``num_bins`` histogram, **AllReduce the bin counts**
+  (:406-416 — MPI_Allreduce there, ``lax.psum`` here), then split bins into
+  equal-weight partitions (:418-440).
+
+``axis_name=None`` runs the same code single-shard (local mode) — the psum
+becomes a no-op, mirroring the reference's LOCAL short-circuit
+(compute/aggregate_utils.hpp:48-51).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hash import hash_columns
+from .sort import KeyCol
+
+
+def hash_partition_ids(
+    key_cols: Sequence[KeyCol], n: jax.Array, num_partitions: int
+) -> jax.Array:
+    """Target partition per row (uint32 hash mod P); padding rows -> P."""
+    h = hash_columns(key_cols)
+    cap = h.shape[0]
+    if num_partitions & (num_partitions - 1) == 0:
+        pid = (h & np.uint32(num_partitions - 1)).astype(jnp.int32)
+    else:
+        pid = (h % np.uint32(num_partitions)).astype(jnp.int32)
+    live = jnp.arange(cap, dtype=jnp.int32) < n
+    return jnp.where(live, pid, num_partitions)
+
+
+def _as_float(data: jax.Array) -> jax.Array:
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return jnp.where(jnp.isnan(data), jnp.zeros_like(data), data).astype(jnp.float64)
+    return data.astype(jnp.float64)
+
+
+def range_partition_ids(
+    key: KeyCol,
+    n: jax.Array,
+    num_partitions: int,
+    num_bins: Optional[int] = None,
+    axis_name: Optional[str] = None,
+    ascending: bool = True,
+) -> jax.Array:
+    """Sample-sort range partitioning on a single key column.
+
+    Partition boundaries are chosen so partitions receive ~equal global row
+    counts and partition i holds keys <= partition i+1's keys (ascending), so
+    a post-shuffle local sort yields a globally sorted table.
+
+    Default num_bins mirrors the reference: 16 * num_partitions
+    (partition/partition.cpp:182). Nulls and padding go to the last partition
+    (nulls-last sort order).
+    """
+    data, valid = key
+    cap = data.shape[0]
+    if num_bins is None:
+        num_bins = 16 * num_partitions
+    x = _as_float(data)
+    live = jnp.arange(cap, dtype=jnp.int32) < n
+    ok = live if valid is None else (live & valid)
+    big = jnp.float64(np.finfo(np.float64).max)
+    lo = jnp.min(jnp.where(ok, x, big))
+    hi = jnp.max(jnp.where(ok, x, -big))
+    if axis_name is not None:
+        lo = jax.lax.pmin(lo, axis_name)
+        hi = jax.lax.pmax(hi, axis_name)
+    span = jnp.maximum(hi - lo, 1e-300)
+    # local histogram over num_bins equal-width bins
+    b = jnp.clip(((x - lo) / span * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    b = jnp.where(ok, b, num_bins)  # nulls+padding counted out of range
+    hist = jnp.zeros((num_bins,), jnp.int64).at[b].add(1, mode="drop")
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)  # reference MPI_Allreduce :410
+    total = jnp.sum(hist)
+    # bin -> partition: equal cumulative weight split (reference
+    # build_bin_to_partition :418-440)
+    cum = jnp.cumsum(hist) - hist  # exclusive
+    per_part = jnp.maximum(total.astype(jnp.float64) / num_partitions, 1.0)
+    bin_to_part = jnp.clip(
+        (cum.astype(jnp.float64) / per_part).astype(jnp.int32), 0, num_partitions - 1
+    )
+    pid = bin_to_part[jnp.clip(b, 0, num_bins - 1)]
+    if not ascending:
+        pid = num_partitions - 1 - pid
+    # nulls -> last partition; padding -> P sentinel
+    pid = jnp.where(ok, pid, num_partitions - 1)
+    return jnp.where(live, pid, num_partitions).astype(jnp.int32)

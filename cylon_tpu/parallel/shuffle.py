@@ -1,0 +1,115 @@
+"""The all-to-all shuffle: the heart of every Distributed* op.
+
+Reference analog: the whole L0-L2 stack — MPIChannel's nonblocking pairwise
+messages (cpp/src/cylon/net/mpi/mpi_channel.cpp:30-233), the buffer-level
+AllToAll with per-target queues + FIN protocol (net/ops/all_to_all.cpp:64-177)
+and the Arrow-aware table reassembly (arrow/arrow_all_to_all.cpp:68-231).
+
+TPU-native design: none of that machinery survives. One ``lax.all_to_all``
+over the ICI mesh moves all buckets of all columns in a single fused XLA
+collective; "reassembly" is a compaction argsort. Raggedness (the reference
+streams variable-size byte buffers) is handled by the static-shape two-phase
+recipe from SURVEY.md §7: exchange exact bucket counts (cheap int all_to_all),
+let the host pick the bucket capacity, then exchange padded buckets.
+
+Runs inside ``shard_map``; every function here is per-shard code.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Cols = Sequence[Tuple[jax.Array, Optional[jax.Array]]]
+
+
+def bucket_counts(pid: jax.Array, num_partitions: int) -> jax.Array:
+    """Rows per target partition on this shard -> [P] int32 (padding pid==P
+    is dropped)."""
+    return (
+        jnp.zeros((num_partitions,), jnp.int32).at[pid].add(1, mode="drop")
+    )
+
+
+def exchange_counts(counts: jax.Array, axis_name: str) -> jax.Array:
+    """all_to_all the [P] send-counts -> [P] receive-counts (entry s = rows
+    arriving from source shard s)."""
+    return jax.lax.all_to_all(
+        counts.reshape(-1, 1), axis_name, split_axis=0, concat_axis=0, tiled=False
+    ).reshape(-1)
+
+
+def shuffle_gather_order(pid: jax.Array, num_partitions: int) -> jax.Array:
+    """Stable order grouping rows by target partition (padding last)."""
+    return jnp.argsort(pid, stable=True).astype(jnp.int32)
+
+
+def build_send_slots(
+    pid: jax.Array, counts: jax.Array, num_partitions: int, bucket_cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Destination slot in the [P * bucket_cap] send buffer for every row.
+
+    Returns (dest [cap] int32 with P*bucket_cap meaning drop, overflow scalar
+    = rows that did not fit their bucket; caller guarantees 0 by sizing
+    bucket_cap from the exact counts).
+    """
+    cap = pid.shape[0]
+    order = shuffle_gather_order(pid, num_partitions)
+    spid = pid[order]
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix per partition
+    safe_pid = jnp.clip(spid, 0, num_partitions - 1)
+    slot = jnp.arange(cap, dtype=jnp.int32) - starts[safe_pid]
+    ok = (spid < num_partitions) & (slot < bucket_cap)
+    dest_sorted = jnp.where(
+        ok, safe_pid * bucket_cap + slot, num_partitions * bucket_cap
+    )
+    dest = jnp.full((cap,), num_partitions * bucket_cap, jnp.int32).at[order].set(
+        dest_sorted
+    )
+    overflow = jnp.sum((spid < num_partitions) & (slot >= bucket_cap)).astype(jnp.int32)
+    return dest, overflow
+
+
+def exchange_column(
+    data: jax.Array, dest: jax.Array, num_partitions: int, bucket_cap: int,
+    axis_name: str,
+) -> jax.Array:
+    """Scatter one column into the padded send buffer and all_to_all it.
+
+    Output: [P * bucket_cap]; chunk s holds the rows sent by source shard s
+    (front-packed within the chunk, garbage after its count).
+    """
+    buf = jnp.zeros((num_partitions * bucket_cap,), data.dtype).at[dest].set(
+        data, mode="drop"
+    )
+    return jax.lax.all_to_all(
+        buf.reshape(num_partitions, bucket_cap),
+        axis_name,
+        split_axis=0,
+        concat_axis=0,
+        tiled=False,
+    ).reshape(num_partitions * bucket_cap)
+
+
+def received_row_mask(
+    recv_counts: jax.Array, num_partitions: int, bucket_cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """(live mask [P*bucket_cap], total received scalar int32)."""
+    slot = jnp.arange(num_partitions * bucket_cap, dtype=jnp.int32) % bucket_cap
+    src = jnp.arange(num_partitions * bucket_cap, dtype=jnp.int32) // bucket_cap
+    mask = slot < recv_counts[src]
+    return mask, jnp.sum(recv_counts).astype(jnp.int32)
+
+
+def compact_received(
+    cols: List[Tuple[jax.Array, Optional[jax.Array]]],
+    mask: jax.Array,
+) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
+    """Front-pack received rows (stable), restoring the live-prefix invariant."""
+    order = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    out = []
+    for data, valid in cols:
+        out.append((data[order], None if valid is None else valid[order]))
+    return out

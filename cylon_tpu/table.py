@@ -1,0 +1,1181 @@
+"""Table: the product — a distributed, device-resident relational table.
+
+Reference analog: ``cylon::Table`` and its free-function op suite
+(cpp/src/cylon/table.hpp:46-208 class; Join/DistributedJoin :258-270,
+Union/Subtract/Intersect + Distributed* :279-330, Shuffle :339, HashPartition
+:348, Sort :358, DistributedSort :394, Select :413, Project :423, Unique :433)
+plus the pycylon Cython surface (python/pycylon/data/table.pyx).
+
+TPU-native representation (SURVEY.md §7): a struct-of-columns of fixed-capacity
+jax Arrays, row-sharded over the context mesh (PartitionSpec('dp')). Each of
+the P shards owns ``shard_cap`` physical rows of every column, of which the
+first ``row_counts[i]`` are live (front-packed); the rest are padding. All
+relational kernels are static-shaped jit programs under shard_map; data-
+dependent output sizes use a count->emit two-phase with exactly one host sync.
+
+"Local" ops act independently per shard (== per MPI rank in the reference);
+"distributed_*" ops are collective over the mesh.
+"""
+from __future__ import annotations
+
+import numbers
+from collections import OrderedDict
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column, unify_dictionaries
+from .context import CylonContext
+from .dtypes import DataType, Type
+from .engine import get_kernel, round_cap, shard_caps
+from .ops import groupby as _g
+from .ops import join as _j
+from .ops import partition as _p
+from .ops import setops as _s
+from .ops.sort import lexsort_rows
+from .parallel import shuffle as _sh
+
+KeyCol = Tuple[jax.Array, Optional[jax.Array]]
+
+
+def _scalar(x) -> jax.Array:
+    """Per-shard [1] arrays carry scalars through shard_map."""
+    return x.reshape(1) if hasattr(x, "reshape") else jnp.asarray([x])
+
+
+class Table:
+    """See module docstring. Construct via the ``from_*`` factories."""
+
+    def __init__(
+        self,
+        ctx: CylonContext,
+        columns: "OrderedDict[str, Column]",
+        row_counts: np.ndarray,
+        shard_cap: int,
+    ):
+        self.ctx = ctx
+        self._columns: "OrderedDict[str, Column]" = columns
+        self._row_counts = np.asarray(row_counts, np.int64)
+        self._shard_cap = int(shard_cap)
+        self._counts_dev = None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._columns.keys())
+
+    @property
+    def column_count(self) -> int:
+        return len(self._columns)
+
+    @property
+    def row_count(self) -> int:
+        return int(self._row_counts.sum())
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.row_count, self.column_count)
+
+    @property
+    def shard_cap(self) -> int:
+        return self._shard_cap
+
+    @property
+    def row_counts(self) -> np.ndarray:
+        return self._row_counts
+
+    @property
+    def world_size(self) -> int:
+        return self.ctx.world_size
+
+    def __len__(self) -> int:
+        return self.row_count
+
+    def column(self, name: str) -> Column:
+        return self._columns[name]
+
+    def dtype_of(self, name: str) -> DataType:
+        return self._columns[name].dtype
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_pydict(cls, ctx: CylonContext, data: Dict[str, Any]) -> "Table":
+        """Build a row-sharded table from host columnar data (dict of
+        name -> array-like). Mirrors pycylon ``Table.from_pydict``
+        (data/table.pyx:768-909)."""
+        arrays = {k: np.asarray(v) if not isinstance(v, np.ndarray) else v for k, v in data.items()}
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        for k, v in arrays.items():
+            if len(v) != n:
+                raise ValueError("all columns must have equal length")
+        world = ctx.world_size
+        counts, cap = shard_caps(n, world)
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        for name, values in arrays.items():
+            phys, valid, dtype, dictionary = Column.encode_host(np.asarray(values))
+            buf = np.zeros((world * cap,), dtype=phys.dtype)
+            vbuf = np.ones((world * cap,), dtype=bool) if valid is not None else None
+            for i in range(world):
+                lo, hi = offs[i], offs[i + 1]
+                buf[i * cap : i * cap + (hi - lo)] = phys[lo:hi]
+                if vbuf is not None:
+                    vbuf[i * cap : i * cap + (hi - lo)] = valid[lo:hi]
+            data_dev = jax.device_put(buf, ctx.sharding)
+            valid_dev = jax.device_put(vbuf, ctx.sharding) if vbuf is not None else None
+            cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
+        return cls(ctx, cols, counts, cap)
+
+    @classmethod
+    def from_pandas(cls, ctx: CylonContext, df) -> "Table":
+        return cls.from_pydict(ctx, {str(c): df[c].to_numpy() for c in df.columns})
+
+    @classmethod
+    def from_numpy(cls, ctx: CylonContext, names: Sequence[str], arrays) -> "Table":
+        return cls.from_pydict(ctx, dict(zip(names, arrays)))
+
+    @classmethod
+    def from_arrow(cls, ctx: CylonContext, atable) -> "Table":
+        """From a pyarrow.Table (reference Table::FromArrowTable,
+        table.hpp:67)."""
+        return cls.from_pandas(ctx, atable.to_pandas())
+
+    @classmethod
+    def from_shards(cls, ctx: CylonContext, shards: Sequence[Dict[str, Any]]) -> "Table":
+        """Per-shard construction: shard i's rows come from ``shards[i]`` —
+        the analog of each MPI rank loading its own ``csv1_{RANK}.csv``
+        (reference cpp/test/join_test.cpp:21-24)."""
+        world = ctx.world_size
+        if len(shards) != world:
+            raise ValueError(f"need {world} shards, got {len(shards)}")
+        names = list(shards[0].keys())
+        counts = np.array([len(next(iter(s.values()))) if s else 0 for s in shards], np.int64)
+        cap = round_cap(int(counts.max()))
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for name in names:
+            # encode all shards together so dictionaries are global
+            concat = np.concatenate([np.asarray(s[name]) for s in shards])
+            phys, valid, dtype, dictionary = Column.encode_host(concat)
+            buf = np.zeros((world * cap,), dtype=phys.dtype)
+            vbuf = np.ones((world * cap,), dtype=bool) if valid is not None else None
+            off = 0
+            for i in range(world):
+                c = int(counts[i])
+                buf[i * cap : i * cap + c] = phys[off : off + c]
+                if vbuf is not None:
+                    vbuf[i * cap : i * cap + c] = valid[off : off + c]
+                off += c
+            data_dev = jax.device_put(buf, ctx.sharding)
+            valid_dev = jax.device_put(vbuf, ctx.sharding) if vbuf is not None else None
+            cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
+        return cls(ctx, cols, counts, cap)
+
+    def _replace(self, columns=None, row_counts=None, shard_cap=None) -> "Table":
+        return Table(
+            self.ctx,
+            self._columns if columns is None else columns,
+            self._row_counts if row_counts is None else row_counts,
+            self._shard_cap if shard_cap is None else shard_cap,
+        )
+
+    # ------------------------------------------------------------------
+    # host conversion
+    # ------------------------------------------------------------------
+    def _host_column(self, name: str):
+        col = self._columns[name]
+        world, cap = self.ctx.world_size, self._shard_cap
+        data = np.asarray(col.data).reshape(world, cap)
+        valid = None if col.valid is None else np.asarray(col.valid).reshape(world, cap)
+        parts, vparts = [], []
+        for i in range(world):
+            c = int(self._row_counts[i])
+            parts.append(data[i, :c])
+            if valid is not None:
+                vparts.append(valid[i, :c])
+        data_np = np.concatenate(parts) if parts else np.empty((0,), data.dtype)
+        valid_np = np.concatenate(vparts) if valid is not None else None
+        return col.decode_host(data_np, valid_np)
+
+    def to_pydict(self) -> Dict[str, np.ndarray]:
+        return {name: self._host_column(name) for name in self.column_names}
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.to_pydict())
+
+    def to_numpy(self, order: str = "F") -> np.ndarray:
+        cols = [np.asarray(v, dtype=np.float64 if v.dtype == object else None)
+                for v in self.to_pydict().values()]
+        return np.stack(cols, axis=1) if cols else np.empty((0, 0))
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.Table.from_pydict({k: list(v) if v.dtype == object else v
+                                     for k, v in self.to_pydict().items()})
+
+    def __repr__(self):
+        head = self.to_pandas()
+        return f"cylon_tpu.Table[{self.row_count} rows x {self.column_count} cols, P={self.world_size}]\n{head}"
+
+    # ------------------------------------------------------------------
+    # kernel plumbing
+    # ------------------------------------------------------------------
+    @property
+    def counts_dev(self) -> jax.Array:
+        if self._counts_dev is None:
+            self._counts_dev = jax.device_put(
+                self._row_counts.astype(np.int32), self.ctx.sharding
+            )
+        return self._counts_dev
+
+    def _flat_cols(self, names: Optional[Sequence[str]] = None) -> List[KeyCol]:
+        names = self.column_names if names is None else names
+        return [(self._columns[n].data, self._columns[n].valid) for n in names]
+
+    def _rebuild_cols(
+        self, names: Sequence[str], flat, row_counts, cap, dicts: Optional[Dict[str, np.ndarray]] = None
+    ) -> "Table":
+        """Reassemble a Table from kernel output (data, valid) pairs keeping
+        dtype/dictionary metadata of the named source columns."""
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for (out_name, src_col), (data, valid) in zip(names, flat):
+            dic = (dicts or {}).get(out_name, src_col.dictionary)
+            cols[out_name] = Column(data, src_col.dtype, valid, dic)
+        return Table(self.ctx, cols, row_counts, cap)
+
+    def _out_counts(self, per_shard) -> np.ndarray:
+        return np.asarray(per_shard).astype(np.int64)
+
+    # ------------------------------------------------------------------
+    # column-level ops (no shard_map needed: elementwise / global reduce)
+    # ------------------------------------------------------------------
+    def project(self, columns: Sequence[Union[str, int]]) -> "Table":
+        """Reference Project (table.cpp:831-850)."""
+        names = [self.column_names[c] if isinstance(c, int) else c for c in columns]
+        cols = OrderedDict((n, self._columns[n]) for n in names)
+        return self._replace(columns=cols)
+
+    def rename(self, mapping: Union[Dict[str, str], Sequence[str]]) -> "Table":
+        if isinstance(mapping, dict):
+            new_names = [mapping.get(n, n) for n in self.column_names]
+        else:
+            new_names = list(mapping)
+        cols = OrderedDict(zip(new_names, self._columns.values()))
+        return self._replace(columns=cols)
+
+    def drop(self, columns: Sequence[str]) -> "Table":
+        drop = set(columns)
+        cols = OrderedDict((n, c) for n, c in self._columns.items() if n not in drop)
+        return self._replace(columns=cols)
+
+    def add_column(self, name: str, col: Union[Column, np.ndarray, jax.Array]) -> "Table":
+        if not isinstance(col, Column):
+            raise TypeError("add_column expects a Column; use from_pydict for host data")
+        cols = OrderedDict(self._columns)
+        cols[name] = col
+        return self._replace(columns=cols)
+
+    def _live_mask(self) -> jax.Array:
+        """Global [P*cap] bool mask of live rows."""
+        cap = self._shard_cap
+        counts = self.counts_dev  # [P] sharded
+
+        def f(counts):
+            return (jnp.arange(cap, dtype=jnp.int32)[None, :] < counts[:, None]).reshape(-1)
+
+        return jax.jit(f)(counts)
+
+    # ------------------------------------------------------------------
+    # filtering / row selection
+    # ------------------------------------------------------------------
+    def filter(self, mask: Union["Table", Column, jax.Array]) -> "Table":
+        """Keep rows where mask is True. The vectorized analog of the
+        reference's UDF Select (table.cpp:504-529) and of pycylon's boolean
+        __getitem__ (data/table.pyx:1066-1223)."""
+        if isinstance(mask, Table):
+            mask = next(iter(mask._columns.values()))
+        if isinstance(mask, Column):
+            m = mask.data
+            if mask.valid is not None:
+                m = m & mask.valid
+        else:
+            m = mask
+        names = self.column_names
+        flat = self._flat_cols()
+        key = ("filter", len(flat))
+
+        def build_count():
+            def kern(dp, rep):
+                (m, counts) = dp
+                n = counts[0]
+                cap = m.shape[0]
+                live = jnp.arange(cap, dtype=jnp.int32) < n
+                return _scalar(jnp.sum(m & live).astype(jnp.int32))
+
+            return kern
+
+        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
+            (m, self.counts_dev), ()
+        )
+        cnts = self._out_counts(cnts)
+        cap_out = round_cap(int(cnts.max()))
+
+        def build_emit():
+            def kern(dp, rep):
+                (m, cols, counts) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                n = counts[0]
+                cap = m.shape[0]
+                live = jnp.arange(cap, dtype=jnp.int32) < n
+                idx, total = _s.compact_mask(m & live, co)
+                out = [_j.gather_column(d, v, idx) for d, v in cols]
+                return out, _scalar(total)
+
+            return kern
+
+        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+            (m, flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+        )
+        return self._rebuild_cols(
+            list(zip(names, self._columns.values())), out, self._out_counts(nout), cap_out
+        )
+
+    def select(self, predicate) -> "Table":
+        """Row filter by a vectorized predicate over a dict of column arrays.
+        (Reference Select takes a row UDF, table.cpp:504-529; here the
+        predicate is jit-compiled over whole columns — TPU-native.)"""
+        env = {n: self._columns[n].data for n in self.column_names}
+        mask = predicate(env)
+        return self.filter(mask)
+
+    def take(self, indices: np.ndarray) -> "Table":
+        """Host-index gather across the global table (utility)."""
+        df = self.to_pandas().iloc[np.asarray(indices)]
+        return Table.from_pandas(self.ctx, df)
+
+    # ------------------------------------------------------------------
+    # sort
+    # ------------------------------------------------------------------
+    def sort(
+        self,
+        order_by: Union[str, int, Sequence[Union[str, int]]],
+        ascending: Union[bool, Sequence[bool]] = True,
+    ) -> "Table":
+        """Per-shard sort (reference local Sort, table.cpp:291-328)."""
+        names = self._resolve_cols(order_by)
+        asc = self._resolve_asc(ascending, len(names))
+        all_names = self.column_names
+        key_idx = tuple(all_names.index(n) for n in names)
+        flat = self._flat_cols()
+        key = ("sort", key_idx, asc, len(flat))
+
+        def build():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                keys = [cols[i] for i in key_idx]
+                order = lexsort_rows(keys, n, cap, ascending=list(asc))
+                return [
+                    (d[order], None if v is None else v[order]) for d, v in cols
+                ]
+
+            return kern
+
+        out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
+        return self._rebuild_cols(
+            list(zip(all_names, self._columns.values())), out, self._row_counts, self._shard_cap
+        )
+
+    def distributed_sort(
+        self,
+        order_by: Union[str, int, Sequence[Union[str, int]]],
+        ascending: Union[bool, Sequence[bool]] = True,
+        num_bins: int = 0,
+        num_samples: int = 0,
+    ) -> "Table":
+        """Global sample-sort (reference DistributedSort, table.cpp:338-382):
+        range-partition on the primary key over the mesh, shuffle, then local
+        sort. ``num_bins``/``num_samples`` mirror SortOptions
+        (table.hpp:388-393); 0 = defaults."""
+        names = self._resolve_cols(order_by)
+        asc = self._resolve_asc(ascending, len(names))
+        if self.world_size == 1:
+            return self.sort(order_by, ascending)
+        shuffled = self._shuffle_impl(
+            kind="range", key_names=[names[0]], asc0=asc[0], num_bins=num_bins
+        )
+        return shuffled.sort(order_by, ascending)
+
+    # ------------------------------------------------------------------
+    # shuffle (the distributed backbone)
+    # ------------------------------------------------------------------
+    def shuffle(self, hash_columns: Sequence[Union[str, int]]) -> "Table":
+        """Reference Shuffle (table.cpp:910-921): hash-partition on the given
+        columns to world_size partitions + all-to-all."""
+        names = self._resolve_cols(hash_columns)
+        if self.world_size == 1:
+            return self
+        return self._shuffle_impl(kind="hash", key_names=names)
+
+    def _shuffle_impl(
+        self,
+        kind: str,
+        key_names: Sequence[str],
+        asc0: bool = True,
+        num_bins: int = 0,
+    ) -> "Table":
+        """hash/range partition -> exact-size exchange -> padded all_to_all ->
+        compact (SURVEY.md §7 stage 5; reference shuffle_table_by_hashing
+        table.cpp:135-157 / MapToSortPartitions partition.cpp:168-198)."""
+        ctx = self.ctx
+        world = ctx.world_size
+        all_names = self.column_names
+        key_idx = tuple(all_names.index(n) for n in key_names)
+        flat = self._flat_cols()
+        ax = ctx.axis_name
+        nb = num_bins if num_bins else 16 * world
+
+        def compute_pid(cols, n):
+            keys = [cols[i] for i in key_idx]
+            if kind == "hash":
+                return _p.hash_partition_ids(keys, n, world)
+            return _p.range_partition_ids(
+                keys[0], n, world, num_bins=nb, axis_name=ax, ascending=asc0
+            )
+
+        key = ("shuffle", kind, key_idx, asc0, nb, len(flat))
+
+        def build_count():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                n = counts[0]
+                pid = compute_pid(cols, n)
+                return _sh.bucket_counts(pid, world)
+
+            return kern
+
+        send_counts = get_kernel(ctx, key + ("count",), build_count)(
+            (flat, self.counts_dev), ()
+        )
+        send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
+        bucket_cap = round_cap(int(send_counts.max()))
+        new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
+
+        def build_emit():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                (dummy,) = rep
+                bc = dummy.shape[0]
+                n = counts[0]
+                pid = compute_pid(cols, n)
+                cnt = _sh.bucket_counts(pid, world)
+                dest, _overflow = _sh.build_send_slots(pid, cnt, world, bc)
+                recv_counts = _sh.exchange_counts(cnt, ax)
+                out_cols = []
+                for data, valid in cols:
+                    d = _sh.exchange_column(data, dest, world, bc, ax)
+                    v = (
+                        None
+                        if valid is None
+                        else _sh.exchange_column(valid, dest, world, bc, ax).astype(bool)
+                    )
+                    out_cols.append((d, v))
+                mask, total = _sh.received_row_mask(recv_counts, world, bc)
+                out_cols = _sh.compact_received(out_cols, mask)
+                return out_cols, _scalar(total)
+
+            return kern
+
+        out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
+            (flat, self.counts_dev), (jnp.zeros((bucket_cap,), jnp.int8),)
+        )
+        got = self._out_counts(nout)
+        assert (got == new_counts).all(), (got, new_counts)
+        return self._rebuild_cols(
+            list(zip(all_names, self._columns.values())), out, new_counts, world * bucket_cap
+        )
+
+    def hash_partition(self, hash_columns: Sequence[Union[str, int]], num_partitions: int) -> Dict[int, "Table"]:
+        """Local hash partition into k tables (reference HashPartition,
+        table.cpp:384-405). Not a hot path; built on filter()."""
+        names = self._resolve_cols(hash_columns)
+        flat = self._flat_cols(names)
+        key = ("hash_partition", tuple(names), num_partitions)
+
+        def build():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                n = counts[0]
+                return _p.hash_partition_ids(cols, n, num_partitions)
+
+            return kern
+
+        pid = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
+        out = {}
+        for p in range(num_partitions):
+            out[p] = self.filter(pid == p)
+        return out
+
+    # ------------------------------------------------------------------
+    # join
+    # ------------------------------------------------------------------
+    def join(
+        self,
+        other: "Table",
+        on: Optional[Union[str, Sequence[str]]] = None,
+        how: str = "inner",
+        left_on: Optional[Sequence[str]] = None,
+        right_on: Optional[Sequence[str]] = None,
+        suffixes: Tuple[str, str] = ("_x", "_y"),
+        algorithm: str = "sort",
+    ) -> "Table":
+        """Per-shard (local) equi-join — all 4 types (reference Join,
+        table.cpp:428-480; join/hash_join.cpp + sort_join.cpp). ``algorithm``
+        is accepted for API parity; the TPU implementation is always the
+        sort/searchsorted join (SURVEY.md §7: argsort is native, hash
+        multimaps are not)."""
+        l_names, r_names = self._resolve_join_keys(other, on, left_on, right_on)
+        howi = _j.join_type_id(how)
+        left, right = _unify_dict_pair(self, other, l_names, r_names)
+        lflat_k = left._flat_cols(l_names)
+        rflat_k = right._flat_cols(r_names)
+        lflat = left._flat_cols()
+        rflat = right._flat_cols()
+        lk_idx = tuple(left.column_names.index(n) for n in l_names)
+        rk_idx = tuple(right.column_names.index(n) for n in r_names)
+        key = ("join", howi, lk_idx, rk_idx, len(lflat), len(rflat))
+
+        def build_count():
+            def kern(dp, rep):
+                (lk, rk, nl, nr) = dp
+                cap_l = lk[0][0].shape[0]
+                cap_r = rk[0][0].shape[0]
+                return _scalar(
+                    _j.join_count(lk, rk, nl[0], nr[0], cap_l, cap_r, howi)
+                )
+
+            return kern
+
+        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
+            (lflat_k, rflat_k, left.counts_dev, right.counts_dev), ()
+        )
+        cnts = self._out_counts(cnts)
+        cap_out = round_cap(int(cnts.max()))
+
+        def build_emit():
+            def kern(dp, rep):
+                (lk, rk, lcols, rcols, nl, nr) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                cap_l = lk[0][0].shape[0]
+                cap_r = rk[0][0].shape[0]
+                li, ri, n_out = _j.join_emit(
+                    lk, rk, nl[0], nr[0], cap_l, cap_r, howi, co
+                )
+                out = [_j.gather_column(d, v, li) for d, v in lcols]
+                out += [_j.gather_column(d, v, ri) for d, v in rcols]
+                return out, _scalar(n_out)
+
+            return kern
+
+        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+            (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
+            (jnp.zeros((cap_out,), jnp.int8),),
+        )
+        # output schema: left columns then right columns, suffix on collision
+        # (reference join_utils.cpp:28-160 suffix renaming)
+        out_names = _suffix_names(left.column_names, right.column_names, suffixes)
+        src_cols = list(left._columns.values()) + list(right._columns.values())
+        return self._rebuild_cols(
+            list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
+        )
+
+    def distributed_join(self, other: "Table", **kwargs) -> "Table":
+        """The flagship op (reference DistributedJoin, table.cpp:482-502):
+        hash-shuffle both tables on the join keys over the mesh, then local
+        join per shard. world_size==1 short-circuits to the local join
+        (reference :487-489)."""
+        if self.world_size == 1:
+            return self.join(other, **kwargs)
+        l_names, r_names = self._resolve_join_keys(
+            other, kwargs.get("on"), kwargs.get("left_on"), kwargs.get("right_on")
+        )
+        left, right = _unify_dict_pair(self, other, l_names, r_names)
+        ls = left._shuffle_impl(kind="hash", key_names=l_names)
+        rs = right._shuffle_impl(kind="hash", key_names=r_names)
+        return ls.join(rs, **kwargs)
+
+    # ------------------------------------------------------------------
+    # set operations
+    # ------------------------------------------------------------------
+    def _setop_pair(self, other: "Table"):
+        if self.column_names != other.column_names:
+            raise ValueError("set operations require identical schemas")
+        return _unify_dict_pair(self, other, self.column_names, other.column_names)
+
+    def union(self, other: "Table") -> "Table":
+        """Distinct union (reference Union, table.cpp:531-603):
+        concat + dedup."""
+        a, b = self._setop_pair(other)
+        return _concat_tables([a, b]).unique()
+
+    def subtract(self, other: "Table") -> "Table":
+        """Distinct rows of self not in other (reference Subtract,
+        table.cpp:605-663)."""
+        return self._two_table_setop(other, "subtract")
+
+    def intersect(self, other: "Table") -> "Table":
+        """Distinct rows present in both (reference Intersect,
+        table.cpp:665-721)."""
+        return self._two_table_setop(other, "intersect")
+
+    def _two_table_setop(self, other: "Table", op: str) -> "Table":
+        a, b = self._setop_pair(other)
+        lflat = a._flat_cols()
+        rflat = b._flat_cols()
+        nc = len(lflat)
+        key = ("setop", op, nc)
+        cnt_fn = _s.subtract_count if op == "subtract" else _s.intersect_count
+        emit_fn = _s.subtract_emit if op == "subtract" else _s.intersect_emit
+
+        def build_count():
+            def kern(dp, rep):
+                (lk, rk, nl, nr) = dp
+                cap_l = lk[0][0].shape[0]
+                cap_r = rk[0][0].shape[0]
+                return _scalar(cnt_fn(lk, rk, nl[0], nr[0], cap_l, cap_r))
+
+            return kern
+
+        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
+            (lflat, rflat, a.counts_dev, b.counts_dev), ()
+        )
+        cnts = self._out_counts(cnts)
+        cap_out = round_cap(int(cnts.max()))
+
+        def build_emit():
+            def kern(dp, rep):
+                (lk, rk, nl, nr) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                cap_l = lk[0][0].shape[0]
+                cap_r = rk[0][0].shape[0]
+                idx, total = emit_fn(lk, rk, nl[0], nr[0], cap_l, cap_r, co)
+                out = [_j.gather_column(d, v, idx) for d, v in lk]
+                return out, _scalar(total)
+
+            return kern
+
+        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+            (lflat, rflat, a.counts_dev, b.counts_dev),
+            (jnp.zeros((cap_out,), jnp.int8),),
+        )
+        return a._rebuild_cols(
+            list(zip(a.column_names, a._columns.values())), out, self._out_counts(nout), cap_out
+        )
+
+    def distributed_union(self, other: "Table") -> "Table":
+        return self._dist_setop(other, "union")
+
+    def distributed_subtract(self, other: "Table") -> "Table":
+        return self._dist_setop(other, "subtract")
+
+    def distributed_intersect(self, other: "Table") -> "Table":
+        return self._dist_setop(other, "intersect")
+
+    def _dist_setop(self, other: "Table", op: str) -> "Table":
+        """Reference DoDistributedSetOperation (table.cpp:727-785): shuffle
+        both tables on ALL columns, then run the local op per shard."""
+        if self.world_size == 1:
+            return getattr(self, op)(other)
+        a, b = self._setop_pair(other)
+        asf = a._shuffle_impl(kind="hash", key_names=a.column_names)
+        bsf = b._shuffle_impl(kind="hash", key_names=b.column_names)
+        return getattr(asf, op)(bsf)
+
+    # ------------------------------------------------------------------
+    # unique
+    # ------------------------------------------------------------------
+    def unique(
+        self,
+        columns: Optional[Sequence[Union[str, int]]] = None,
+        keep: str = "first",
+    ) -> "Table":
+        """Per-shard dedup (reference Unique, table.cpp:923-982)."""
+        names = self.column_names if columns is None else self._resolve_cols(columns)
+        all_names = self.column_names
+        key_idx = tuple(all_names.index(n) for n in names)
+        flat = self._flat_cols()
+        key = ("unique", key_idx, keep, len(flat))
+
+        def build_count():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                keys = [cols[i] for i in key_idx]
+                return _scalar(_s.unique_count(keys, n, cap))
+
+            return kern
+
+        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
+            (flat, self.counts_dev), ()
+        )
+        cnts = self._out_counts(cnts)
+        cap_out = round_cap(int(cnts.max()))
+
+        def build_emit():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                keys = [cols[i] for i in key_idx]
+                idx, total = _s.unique_emit(keys, n, cap, co, keep)
+                out = [_j.gather_column(d, v, idx) for d, v in cols]
+                return out, _scalar(total)
+
+            return kern
+
+        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+            (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+        )
+        return self._rebuild_cols(
+            list(zip(all_names, self._columns.values())), out, self._out_counts(nout), cap_out
+        )
+
+    def distributed_unique(
+        self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
+    ) -> "Table":
+        """Reference DistributedUnique (table.cpp:984-999): shuffle on the
+        key columns then local unique."""
+        if self.world_size == 1:
+            return self.unique(columns, keep)
+        names = self.column_names if columns is None else self._resolve_cols(columns)
+        return self._shuffle_impl(kind="hash", key_names=names).unique(columns, keep)
+
+    # ------------------------------------------------------------------
+    # groupby
+    # ------------------------------------------------------------------
+    def groupby(
+        self,
+        by: Union[str, int, Sequence[Union[str, int]]],
+        agg: Dict[str, Union[str, int, Sequence[Union[str, int]]]],
+        ddof: int = 1,
+        quantile: float = 0.5,
+    ) -> "Table":
+        """Per-shard groupby-aggregate (reference HashGroupBy,
+        groupby/hash_groupby.cpp). ``agg`` maps value column -> op(s) from
+        {sum,count,min,max,mean,var,std,nunique,quantile,median}. Output has
+        the key columns (sorted key order) then one column per (col, op)
+        named ``col_op`` (pycylon naming, data/table.pyx:587-648)."""
+        key_names = self._resolve_cols(by)
+        # normalize agg spec -> list of (col, op_id, op_name)
+        specs: List[Tuple[str, int, str]] = []
+        for col, ops in agg.items():
+            ops_list = ops if isinstance(ops, (list, tuple)) else [ops]
+            for o in ops_list:
+                oid = _g.agg_op_id(o)
+                oname = o if isinstance(o, str) else _agg_name(oid)
+                specs.append((col, oid, oname))
+        all_names = self.column_names
+        key_idx = tuple(all_names.index(n) for n in key_names)
+        val_idx = tuple(all_names.index(c) for c, _, _ in specs)
+        ops_t = tuple(oid for _, oid, _ in specs)
+        flat = self._flat_cols()
+        key = ("groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat))
+
+        def build_count():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                keys = [cols[i] for i in key_idx]
+                _, ng = _g.group_ids(keys, n, cap)
+                return _scalar(ng)
+
+            return kern
+
+        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
+            (flat, self.counts_dev), ()
+        )
+        cnts = self._out_counts(cnts)
+        cap_out = round_cap(int(cnts.max()))
+
+        def build_emit():
+            def kern(dp, rep):
+                (cols, counts) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                keys = [cols[i] for i in key_idx]
+                ids, ng = _g.group_ids(keys, n, cap)
+                rep_rows = _g.group_representatives(ids, co)
+                gmask = jnp.arange(co) < ng
+                rep_idx = jnp.where(gmask, jnp.clip(rep_rows, 0, cap - 1), -1)
+                out = [_j.gather_column(d, v, rep_idx) for d, v in keys]
+                for (vi, oid) in zip(val_idx, ops_t):
+                    d, v = cols[vi]
+                    a, av = _g.aggregate_column(
+                        oid, d, v, ids, ng, co, ddof=ddof, quantile=quantile
+                    )
+                    out.append((a, av))
+                return out, _scalar(ng)
+
+            return kern
+
+        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+            (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+        )
+        # build output schema
+        names_src: List[Tuple[str, Column]] = [
+            (n, self._columns[n]) for n in key_names
+        ]
+        agg_cols = []
+        for (coln, oid, oname), (a, av) in zip(specs, out[len(key_names):]):
+            agg_cols.append((f"{coln}_{oname}", a, av))
+        counts_np = self._out_counts(nout)
+        cols_od: "OrderedDict[str, Column]" = OrderedDict()
+        for (n, src), (d, v) in zip(names_src, out[: len(key_names)]):
+            cols_od[n] = Column(d, src.dtype, v, src.dictionary)
+        for cname, d, v in agg_cols:
+            cols_od[cname] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
+        return Table(self.ctx, cols_od, counts_np, cap_out)
+
+    def distributed_groupby(
+        self,
+        by: Union[str, int, Sequence[Union[str, int]]],
+        agg: Dict[str, Union[str, Sequence[str]]],
+        **kw,
+    ) -> "Table":
+        """Reference DistributedHashGroupBy (groupby/groupby.cpp:33-91):
+        local pre-combine iff every op is associative {SUM,MIN,MAX}
+        (:24-31,57-67), shuffle on keys, final local groupby."""
+        if self.world_size == 1:
+            return self.groupby(by, agg, **kw)
+        key_names = self._resolve_cols(by)
+        all_ops = []
+        for col, ops in agg.items():
+            ops_list = ops if isinstance(ops, (list, tuple)) else [ops]
+            all_ops += [_g.agg_op_id(o) for o in ops_list]
+        t = self
+        if all(o in _g.ASSOCIATIVE for o in all_ops):
+            pre = t.groupby(by, agg, **kw)
+            # rename aggregated columns back to the source names so the final
+            # pass re-aggregates them under the same spec
+            ren = {}
+            newagg = {}
+            for col, ops in agg.items():
+                o = ops if isinstance(ops, (str, int)) else (ops[0] if len(ops) == 1 else None)
+                if o is None:
+                    # multiple ops per column can't pre-combine under one name
+                    pre = None
+                    break
+                oname = o if isinstance(o, str) else _agg_name(_g.agg_op_id(o))
+                ren[f"{col}_{oname}"] = col
+                newagg[col] = o
+            if pre is not None:
+                t = pre.rename(ren)
+                shuffled = t._shuffle_impl(kind="hash", key_names=key_names)
+                return shuffled.groupby(by, newagg, **kw)
+        shuffled = t._shuffle_impl(kind="hash", key_names=key_names)
+        return shuffled.groupby(by, agg, **kw)
+
+    # ------------------------------------------------------------------
+    # scalar aggregates (reference compute::Sum/Count/Min/Max,
+    # compute/aggregates.cpp:26-137 — local arrow::compute + AllReduce; here
+    # a global masked reduction over the sharded array: XLA inserts the
+    # cross-shard collective automatically)
+    # ------------------------------------------------------------------
+    def _masked_col(self, column: Union[str, int]):
+        name = self._resolve_cols(column)[0]
+        col = self._columns[name]
+        live = self._live_mask()
+        ok = live if col.valid is None else (live & col.valid)
+        return col, ok
+
+    def sum(self, column: Union[str, int]):
+        col, ok = self._masked_col(column)
+        d = col.data
+        if jnp.issubdtype(d.dtype, jnp.integer):
+            d = d.astype(jnp.int64)
+        return jnp.sum(jnp.where(ok, d, jnp.zeros_like(d))).item()
+
+    def count(self, column: Union[str, int]) -> int:
+        _, ok = self._masked_col(column)
+        return int(jnp.sum(ok))
+
+    def min(self, column: Union[str, int]):
+        col, ok = self._masked_col(column)
+        d = col.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            big = jnp.asarray(jnp.inf, d.dtype)
+        else:
+            big = jnp.asarray(jnp.iinfo(d.dtype).max, d.dtype)
+        out = jnp.min(jnp.where(ok, d, big)).item()
+        return self._decode_scalar(col, out)
+
+    def max(self, column: Union[str, int]):
+        col, ok = self._masked_col(column)
+        d = col.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            small = jnp.asarray(-jnp.inf, d.dtype)
+        else:
+            small = jnp.asarray(jnp.iinfo(d.dtype).min, d.dtype)
+        out = jnp.max(jnp.where(ok, d, small)).item()
+        return self._decode_scalar(col, out)
+
+    def mean(self, column: Union[str, int]):
+        col, ok = self._masked_col(column)
+        d = col.data.astype(jnp.float64)
+        s = jnp.sum(jnp.where(ok, d, 0.0))
+        c = jnp.sum(ok)
+        return (s / jnp.maximum(c, 1)).item()
+
+    @staticmethod
+    def _decode_scalar(col: Column, value):
+        if col.dtype.is_dictionary:
+            return col.dictionary[int(value)]
+        return value
+
+    # ------------------------------------------------------------------
+    # elementwise / pandas-flavored utilities (pycylon table.pyx surface)
+    # ------------------------------------------------------------------
+    def isnull(self) -> "Table":
+        cols = OrderedDict()
+        for n, c in self._columns.items():
+            nulls = (~c.valid) if c.valid is not None else jnp.zeros(c.data.shape, bool)
+            cols[n] = Column(nulls, DataType(Type.BOOL), None, None)
+        return self._replace(columns=cols)
+
+    def notnull(self) -> "Table":
+        cols = OrderedDict()
+        for n, c in self._columns.items():
+            ok = c.valid if c.valid is not None else jnp.ones(c.data.shape, bool)
+            cols[n] = Column(ok, DataType(Type.BOOL), None, None)
+        return self._replace(columns=cols)
+
+    def fillna(self, value) -> "Table":
+        cols = OrderedDict()
+        for n, c in self._columns.items():
+            if c.valid is None:
+                cols[n] = c
+                continue
+            if c.dtype.is_dictionary:
+                # add fill value to dictionary if missing
+                dic = c.dictionary
+                pos = np.searchsorted(dic, value)
+                if pos >= len(dic) or dic[pos] != value:
+                    dic = np.insert(dic, pos, value)
+                    remap = jnp.asarray(
+                        np.searchsorted(dic, c.dictionary).astype(np.int32)
+                    )
+                    data = remap[jnp.clip(c.data, 0, len(c.dictionary) - 1)]
+                else:
+                    data = c.data
+                filled = jnp.where(c.valid, data, jnp.int32(pos))
+                cols[n] = Column(filled, c.dtype, None, dic)
+            else:
+                filled = jnp.where(c.valid, c.data, jnp.asarray(value, c.data.dtype))
+                cols[n] = Column(filled, c.dtype, None, None)
+        return self._replace(columns=cols)
+
+    def astype(self, dtype_map: Union[Any, Dict[str, Any]]) -> "Table":
+        if not isinstance(dtype_map, dict):
+            dtype_map = {n: dtype_map for n in self.column_names}
+        cols = OrderedDict(self._columns)
+        for n, dt in dtype_map.items():
+            c = self._columns[n]
+            if c.dtype.is_dictionary:
+                raise TypeError("astype on string columns not supported")
+            nd = np.dtype(dt)
+            cols[n] = Column(c.data.astype(nd), DataType.from_numpy_dtype(nd), c.valid, None)
+        return self._replace(columns=cols)
+
+    def equals(self, other: "Table", ordered: bool = True) -> bool:
+        """Content equality; unordered compares as multisets of rows (the
+        reference tests verify via Subtract-emptiness, test_utils.hpp:37-59)."""
+        if self.column_names != other.column_names or self.row_count != other.row_count:
+            return False
+        a = self.to_pandas()
+        b = other.to_pandas()
+        if not ordered:
+            cols = list(a.columns)
+            a = a.sort_values(cols, kind="stable").reset_index(drop=True)
+            b = b.sort_values(cols, kind="stable").reset_index(drop=True)
+        try:
+            import pandas.testing as pdt
+
+            pdt.assert_frame_equal(a, b, check_dtype=False)
+            return True
+        except AssertionError:
+            return False
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _resolve_cols(self, spec) -> List[str]:
+        if isinstance(spec, (str, int)):
+            spec = [spec]
+        names = []
+        for s in spec:
+            names.append(self.column_names[s] if isinstance(s, int) else s)
+        missing = [n for n in names if n not in self._columns]
+        if missing:
+            raise KeyError(f"unknown columns {missing}")
+        return names
+
+    @staticmethod
+    def _resolve_asc(ascending, k) -> Tuple[bool, ...]:
+        if isinstance(ascending, bool):
+            return tuple([ascending] * k)
+        return tuple(ascending)
+
+    def _resolve_join_keys(self, other, on, left_on, right_on):
+        if on is not None:
+            names = self._resolve_cols(on)
+            return names, names
+        if left_on is None or right_on is None:
+            raise ValueError("join requires `on` or both `left_on`/`right_on`")
+        return self._resolve_cols(left_on), other._resolve_cols(right_on)
+
+
+# ----------------------------------------------------------------------
+# module-level helpers
+# ----------------------------------------------------------------------
+
+def _suffix_names(lnames, rnames, suffixes):
+    overlap = set(lnames) & set(rnames)
+    out = [n + suffixes[0] if n in overlap else n for n in lnames]
+    out += [n + suffixes[1] if n in overlap else n for n in rnames]
+    return out
+
+
+def _agg_name(oid: int) -> str:
+    return {
+        _g.SUM: "sum", _g.COUNT: "count", _g.MIN: "min", _g.MAX: "max",
+        _g.MEAN: "mean", _g.VAR: "var", _g.STDDEV: "std", _g.NUNIQUE: "nunique",
+        _g.QUANTILE: "quantile",
+    }[oid]
+
+
+def _remap_codes(col: Column, mapping: np.ndarray, dictionary: np.ndarray) -> Column:
+    m = jnp.asarray(mapping)
+    data = m[jnp.clip(col.data, 0, len(mapping) - 1)]
+    return Column(data, col.dtype, col.valid, dictionary)
+
+
+def _unify_dict_pair(
+    a: "Table", b: "Table", a_cols: Sequence[str], b_cols: Sequence[str]
+) -> Tuple["Table", "Table"]:
+    """Remap dictionary codes of paired string columns onto their union
+    dictionary so cross-table comparisons/hashes are valid."""
+    new_a = OrderedDict(a._columns)
+    new_b = OrderedDict(b._columns)
+    changed = False
+    for an, bn in zip(a_cols, b_cols):
+        ca, cb = a._columns[an], b._columns[bn]
+        if not (ca.dtype.is_dictionary and cb.dtype.is_dictionary):
+            continue
+        if ca.dictionary is cb.dictionary or (
+            len(ca.dictionary) == len(cb.dictionary)
+            and (ca.dictionary == cb.dictionary).all()
+        ):
+            continue
+        union, map_a, map_b = unify_dictionaries(ca, cb)
+        new_a[an] = _remap_codes(ca, map_a, union)
+        new_b[bn] = _remap_codes(cb, map_b, union)
+        changed = True
+    if not changed:
+        return a, b
+    return a._replace(columns=new_a), b._replace(columns=new_b)
+
+
+def _concat_tables(tables: Sequence["Table"]) -> "Table":
+    """Row-wise concat of same-schema tables, per shard (reference Merge,
+    table.cpp:267-289)."""
+    assert len(tables) >= 1
+    t0 = tables[0]
+    if len(tables) == 1:
+        return t0
+    # fold binary concat; unify dictionaries pairwise first
+    acc = t0
+    for t in tables[1:]:
+        acc2, t2 = _unify_dict_pair(acc, t, acc.column_names, t.column_names)
+        acc = _concat2(acc2, t2)
+    return acc
+
+
+def _concat2(a: "Table", b: "Table") -> "Table":
+    ctx = a.ctx
+    names = a.column_names
+    if names != b.column_names:
+        raise ValueError("concat requires identical schemas")
+    new_counts = a.row_counts + b.row_counts
+    cap_out = round_cap(int(new_counts.max()))
+    aflat = a._flat_cols()
+    bflat = b._flat_cols()
+    key = ("concat2", len(aflat))
+
+    def build():
+        def kern(dp, rep):
+            (ac, bc, na, nb) = dp
+            (dummy,) = rep
+            co = dummy.shape[0]
+            cap_a = ac[0][0].shape[0]
+            cap_b = bc[0][0].shape[0]
+            na0, nb0 = na[0], nb[0]
+            ia = jnp.arange(cap_a, dtype=jnp.int32)
+            ib = jnp.arange(cap_b, dtype=jnp.int32)
+            dest_a = jnp.where(ia < na0, ia, co)
+            dest_b = jnp.where(ib < nb0, na0 + ib, co)
+            out = []
+            for (da, va), (db, vb) in zip(ac, bc):
+                common = jnp.promote_types(da.dtype, db.dtype)
+                buf = jnp.zeros((co,), common)
+                buf = buf.at[dest_a].set(da.astype(common), mode="drop")
+                buf = buf.at[dest_b].set(db.astype(common), mode="drop")
+                if va is None and vb is None:
+                    vout = None
+                else:
+                    vam = jnp.ones((cap_a,), bool) if va is None else va
+                    vbm = jnp.ones((cap_b,), bool) if vb is None else vb
+                    vbuf = jnp.zeros((co,), bool)
+                    vbuf = vbuf.at[dest_a].set(vam, mode="drop")
+                    vbuf = vbuf.at[dest_b].set(vbm, mode="drop")
+                    vout = vbuf
+                out.append((buf, vout))
+            return out, _scalar(na0 + nb0)
+
+        return kern
+
+    out, nout = get_kernel(ctx, key, build)(
+        (aflat, bflat, a.counts_dev, b.counts_dev),
+        (jnp.zeros((cap_out,), jnp.int8),),
+    )
+    return a._rebuild_cols(
+        list(zip(names, a._columns.values())), out, np.asarray(nout, np.int64), cap_out
+    )
+
+
+def concat(tables: Sequence["Table"]) -> "Table":
+    """Public concat (pycylon Table.concat, data/table.pyx:2334)."""
+    return _concat_tables(list(tables))
+
+
+def merge(tables: Sequence["Table"]) -> "Table":
+    """Reference Merge (table.cpp:267-289)."""
+    return _concat_tables(list(tables))

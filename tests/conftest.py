@@ -1,0 +1,50 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+Reference analog: CTest runs every suite under ``mpirun -np {1,2,4}``
+(cpp/test/CMakeLists.txt:44-117). Here a single process gets 8 virtual XLA CPU
+devices (SURVEY.md §4.3) and the same tests run on 1-, 2-, 4- and 8-device
+meshes via the ``ctx`` fixtures.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) >= 8, f"need 8 virtual CPU devices, got {len(d)}"
+    return d
+
+
+@pytest.fixture(scope="session")
+def local_ctx(devices):
+    return ct.CylonContext.init()
+
+
+@pytest.fixture(scope="session", params=[1, 2, 4, 8])
+def world_ctx(request, devices):
+    """Mesh sizes mirroring the reference's mpirun -np sweep (+8)."""
+    n = request.param
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:n]))
+
+
+@pytest.fixture(scope="session")
+def ctx8(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:8]))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
