@@ -1,0 +1,76 @@
+"""Headline benchmark: distributed inner join throughput on TPU.
+
+Mirrors the reference's flagship benchmark (distributed inner join, strong
+scaling — docs/docs/arch.md:148-160; driver
+cpp/src/examples/bench/table_join_dist_test.cpp). Baseline normalization:
+Cylon joins 2x200M-row tables in 141.5 s on 1 CPU worker (BASELINE.md)
+-> 400e6/141.5 = 2.827e6 input rows/sec/worker. ``vs_baseline`` is our
+per-chip input-row rate over that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+# keep the benchmark in 32-bit: TPU int64 is emulated and the baseline join
+# is on int keys that fit int32
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import jax  # noqa: E402
+
+import cylon_tpu as ct  # noqa: E402
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    rng = np.random.default_rng(0)
+
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig())
+    keyspace = n  # ~1 match per key on average, like the reference generator
+    left = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, keyspace, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+        },
+    )
+    right = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, keyspace, n).astype(np.int32),
+            "w": rng.normal(size=n).astype(np.float32),
+        },
+    )
+
+    # warmup (compile)
+    out = left.distributed_join(right, on="k", how="inner")
+    _ = out.row_count
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = left.distributed_join(right, on="k", how="inner")
+        jax.block_until_ready([c.data for c in out._columns.values()])
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+
+    rate = 2 * n / best / ctx.world_size  # per-chip (1 on the bench host)
+    baseline = 400e6 / 141.5  # cylon 1-worker input rows/sec
+    print(
+        json.dumps(
+            {
+                "metric": "dist_inner_join_input_rows_per_sec_per_chip",
+                "value": round(rate),
+                "unit": "rows/s",
+                "vs_baseline": round(rate / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,8 @@ from .io import (  # noqa: E402
     write_csv,
     write_parquet,
 )
+from .frame import CylonEnv, DataFrame  # noqa: E402
+from .frame import concat as concat_frames  # noqa: E402
 from .table import Table, concat, merge  # noqa: E402
 
 __version__ = "0.1.0"
@@ -47,6 +49,9 @@ __all__ = [
     "CSVReadOptions",
     "CSVWriteOptions",
     "CylonContext",
+    "CylonEnv",
+    "DataFrame",
+    "concat_frames",
     "LocalConfig",
     "MPIConfig",
     "TPUConfig",

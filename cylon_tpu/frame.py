@@ -1,0 +1,479 @@
+"""Pandas-like DataFrame facade + CylonEnv.
+
+Reference analog: python/pycylon/frame.py — ``CylonEnv`` wraps
+context/rank/world_size/finalize/barrier (:34-65); ``DataFrame`` is a
+pandas-like API over Table where the ``env: CylonEnv = None`` kwarg switches
+local -> distributed execution on join (:1115-1242), merge (:1244),
+concat (:1470), drop_duplicates (:1636), sort_values (:1709); plus
+operator surface (:229-763).
+
+The TPU twist (BASELINE.json north star): ``CylonEnv(config=TPUConfig())`` is
+the only user-visible change vs pycylon.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .column import Column
+from .config import CommConfig, TPUConfig
+from .context import CylonContext
+from .table import Table, _concat_tables
+
+
+class CylonEnv:
+    """Execution environment (reference frame.py:34-65)."""
+
+    def __init__(self, config: Optional[CommConfig] = None, distributed: bool = True):
+        if distributed:
+            self.context = CylonContext.init_distributed(config or TPUConfig())
+        else:
+            self.context = CylonContext.init(config)
+        self._distributed = distributed
+        self._finalized = False
+
+    @property
+    def rank(self) -> int:
+        return self.context.get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return self.context.get_world_size()
+
+    @property
+    def is_distributed(self) -> bool:
+        return self._distributed and self.world_size > 1
+
+    def finalize(self):
+        self._finalized = True
+        self.context.finalize()
+
+    def barrier(self):
+        self.context.barrier()
+
+    def __repr__(self):
+        return f"CylonEnv(rank={self.rank}, world_size={self.world_size})"
+
+
+_default_local_ctx: Optional[CylonContext] = None
+
+
+def _local_ctx() -> CylonContext:
+    global _default_local_ctx
+    if _default_local_ctx is None:
+        _default_local_ctx = CylonContext.init()
+    return _default_local_ctx
+
+
+class DataFrame:
+    """Pandas-flavored facade over :class:`Table` (reference frame.py)."""
+
+    def __init__(self, data=None, columns: Optional[Sequence[str]] = None,
+                 ctx: Optional[CylonContext] = None, _table: Optional[Table] = None):
+        if _table is not None:
+            self._table = _table
+            return
+        ctx = ctx or _local_ctx()
+        if data is None:
+            data = {}
+        if isinstance(data, Table):
+            self._table = data
+            return
+        if isinstance(data, DataFrame):
+            self._table = data._table
+            return
+        try:
+            import pandas as pd
+
+            if isinstance(data, pd.DataFrame):
+                self._table = Table.from_pandas(ctx, data)
+                return
+        except ImportError:
+            pass
+        if isinstance(data, dict):
+            self._table = Table.from_pydict(ctx, data)
+            return
+        if isinstance(data, (list, tuple)):
+            # list of columns (pycylon frame.py accepts list-of-lists)
+            names = columns or [str(i) for i in range(len(data))]
+            self._table = Table.from_pydict(ctx, dict(zip(names, data)))
+            return
+        if isinstance(data, np.ndarray):
+            if data.ndim != 2:
+                raise ValueError("2-D array required")
+            names = columns or [str(i) for i in range(data.shape[1])]
+            self._table = Table.from_pydict(
+                ctx, {n: data[:, i] for i, n in enumerate(names)}
+            )
+            return
+        raise TypeError(f"cannot build DataFrame from {type(data)}")
+
+    # -- basic ---------------------------------------------------------
+    @property
+    def table(self) -> Table:
+        return self._table
+
+    def to_table(self) -> Table:
+        return self._table
+
+    @property
+    def columns(self) -> List[str]:
+        return self._table.column_names
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self._table.shape
+
+    def __len__(self) -> int:
+        return self._table.row_count
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy(self):
+        return self._table.to_numpy()
+
+    def to_dict(self):
+        return self._table.to_pydict()
+
+    def __repr__(self):
+        return repr(self._table)
+
+    def _wrap(self, t: Table) -> "DataFrame":
+        return DataFrame(_table=t)
+
+    # -- selection -----------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self._wrap(self._table.project([key]))
+        if isinstance(key, (list, tuple)) and all(isinstance(k, str) for k in key):
+            return self._wrap(self._table.project(list(key)))
+        if isinstance(key, DataFrame):
+            return self._wrap(self._table.filter(key._table))
+        raise TypeError(f"unsupported key {key!r}")
+
+    def __setitem__(self, key: str, value):
+        if isinstance(value, DataFrame):
+            col = next(iter(value._table._columns.values()))
+        elif isinstance(value, Column):
+            col = value
+        else:
+            raise TypeError("assign a DataFrame single column")
+        self._table = self._table.add_column(key, col)
+
+    def drop(self, columns: Sequence[str]) -> "DataFrame":
+        return self._wrap(self._table.drop(columns))
+
+    def rename(self, mapper: Union[Dict[str, str], Sequence[str]]) -> "DataFrame":
+        return self._wrap(self._table.rename(mapper))
+
+    # -- comparisons / arithmetic (single-column frames) ---------------
+    def _binop(self, other, fn):
+        from collections import OrderedDict
+
+        from .dtypes import DataType
+
+        t = self._table
+        new = OrderedDict()
+        for n, c in t._columns.items():
+            if isinstance(other, DataFrame):
+                oc = next(iter(other._table._columns.values()))
+                data = fn(c.data, oc.data)
+                valid = _and_valid(c.valid, oc.valid)
+            else:
+                data = fn(c.data, other)
+                valid = c.valid
+            new[n] = Column(data, DataType.from_numpy_dtype(np.dtype(data.dtype)), valid, None)
+        return DataFrame(_table=t._replace(columns=new))
+
+    def __eq__(self, other):  # noqa: A003
+        return self._binop(other, lambda a, b: a == b)
+
+    def __ne__(self, other):
+        return self._binop(other, lambda a, b: a != b)
+
+    def __lt__(self, other):
+        return self._binop(other, lambda a, b: a < b)
+
+    def __le__(self, other):
+        return self._binop(other, lambda a, b: a <= b)
+
+    def __gt__(self, other):
+        return self._binop(other, lambda a, b: a > b)
+
+    def __ge__(self, other):
+        return self._binop(other, lambda a, b: a >= b)
+
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b)
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b)
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b)
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b)
+
+    def __and__(self, other):
+        return self._binop(other, lambda a, b: a & b)
+
+    def __or__(self, other):
+        return self._binop(other, lambda a, b: a | b)
+
+    def __invert__(self):
+        return self._binop(True, lambda a, b: ~a)
+
+    # -- relational (env switches local/distributed; reference
+    #    frame.py:1115-1242) ------------------------------------------
+    def join(
+        self,
+        other: "DataFrame",
+        on=None,
+        how: str = "left",
+        lsuffix: str = "l",
+        rsuffix: str = "r",
+        algorithm: str = "sort",
+        env: Optional[CylonEnv] = None,
+    ) -> "DataFrame":
+        """pandas.DataFrame.join flavor (suffix-renames both sides,
+        reference frame.py:1115-1226)."""
+        t = self._retarget(env)
+        o = other._retarget(env)
+        suff = (f"_{lsuffix}", f"_{rsuffix}")
+        if env is not None and env.is_distributed:
+            return self._wrap(
+                t.distributed_join(o, on=on, how=how, suffixes=suff, algorithm=algorithm)
+            )
+        return self._wrap(t.join(o, on=on, how=how, suffixes=suff, algorithm=algorithm))
+
+    def merge(
+        self,
+        right: "DataFrame",
+        how: str = "inner",
+        on=None,
+        left_on=None,
+        right_on=None,
+        suffixes: Tuple[str, str] = ("_x", "_y"),
+        algorithm: str = "sort",
+        env: Optional[CylonEnv] = None,
+    ) -> "DataFrame":
+        """pandas.merge semantics: with ``on=``, output carries ONE key
+        column (coalesced for outer joins). Reference frame.py:1244+."""
+        t = self._retarget(env)
+        o = right._retarget(env)
+        kwargs = dict(how=how, suffixes=suffixes, algorithm=algorithm)
+        if on is not None:
+            kwargs["on"] = on
+        else:
+            kwargs["left_on"] = left_on
+            kwargs["right_on"] = right_on
+        if env is not None and env.is_distributed:
+            joined = t.distributed_join(o, **kwargs)
+        else:
+            joined = t.join(o, **kwargs)
+        if on is not None:
+            keys = [on] if isinstance(on, str) else list(on)
+            joined = _coalesce_keys(joined, keys, suffixes, how)
+        return self._wrap(joined)
+
+    def sort_values(
+        self,
+        by,
+        ascending: Union[bool, Sequence[bool]] = True,
+        env: Optional[CylonEnv] = None,
+    ) -> "DataFrame":
+        t = self._retarget(env)
+        if env is not None and env.is_distributed:
+            return self._wrap(t.distributed_sort(by, ascending))
+        return self._wrap(t.sort(by, ascending))
+
+    def drop_duplicates(
+        self,
+        subset: Optional[Sequence[str]] = None,
+        keep: str = "first",
+        env: Optional[CylonEnv] = None,
+    ) -> "DataFrame":
+        t = self._retarget(env)
+        if env is not None and env.is_distributed:
+            return self._wrap(t.distributed_unique(subset, keep))
+        return self._wrap(t.unique(subset, keep))
+
+    def groupby(self, by, env: Optional[CylonEnv] = None) -> "GroupByView":
+        return GroupByView(self._retarget(env), by, env)
+
+    def isin(self, values: Sequence) -> "DataFrame":
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.asarray(values))
+        return self._binop(None, lambda a, b: jnp.isin(a, vals))
+
+    def fillna(self, value) -> "DataFrame":
+        return self._wrap(self._table.fillna(value))
+
+    def isnull(self) -> "DataFrame":
+        return self._wrap(self._table.isnull())
+
+    def notnull(self) -> "DataFrame":
+        return self._wrap(self._table.notnull())
+
+    def astype(self, dtype) -> "DataFrame":
+        return self._wrap(self._table.astype(dtype))
+
+    # -- indexing ------------------------------------------------------
+    def set_index(self, column) -> "DataFrame":
+        return self._wrap(self._table.set_index(column))
+
+    def reset_index(self) -> "DataFrame":
+        return self._wrap(self._table.reset_index())
+
+    @property
+    def index(self):
+        return self._table.index
+
+    @property
+    def loc(self):
+        from .indexing.indexer import LocIndexer
+
+        return _Wrapping(LocIndexer(self._table))
+
+    @property
+    def iloc(self):
+        from .indexing.indexer import ILocIndexer
+
+        return _Wrapping(ILocIndexer(self._table))
+
+    # scalar reductions
+    def sum(self):
+        return {n: self._table.sum(n) for n in self.columns}
+
+    def min(self):
+        return {n: self._table.min(n) for n in self.columns}
+
+    def max(self):
+        return {n: self._table.max(n) for n in self.columns}
+
+    def count(self):
+        return {n: self._table.count(n) for n in self.columns}
+
+    def mean(self):
+        return {n: self._table.mean(n) for n in self.columns}
+
+    def _retarget(self, env: Optional[CylonEnv]) -> Table:
+        """Move the table onto the env's context if different (reference
+        frame.py converts local tables on distributed calls)."""
+        t = self._table
+        if env is None or t.ctx is env.context:
+            return t
+        return Table.from_pydict(env.context, t.to_pydict())
+
+
+class GroupByView:
+    """Deferred groupby: ``df.groupby('k').agg({'v': 'sum'})`` or
+    ``.sum()/.min()/...`` like pycylon's groupby (data/groupby.pyx)."""
+
+    def __init__(self, table: Table, by, env: Optional[CylonEnv]):
+        self._table = table
+        self._by = by
+        self._env = env
+
+    def agg(self, spec: Dict[str, Union[str, Sequence[str]]]) -> DataFrame:
+        if self._env is not None and self._env.is_distributed:
+            return DataFrame(_table=self._table.distributed_groupby(self._by, spec))
+        return DataFrame(_table=self._table.groupby(self._by, spec))
+
+    def _all_values(self, op: str) -> DataFrame:
+        by = [self._by] if isinstance(self._by, (str, int)) else list(self._by)
+        by_names = self._table._resolve_cols(by)
+        vals = [n for n in self._table.column_names if n not in by_names]
+        return self.agg({v: op for v in vals})
+
+    def sum(self) -> DataFrame:
+        return self._all_values("sum")
+
+    def min(self) -> DataFrame:
+        return self._all_values("min")
+
+    def max(self) -> DataFrame:
+        return self._all_values("max")
+
+    def mean(self) -> DataFrame:
+        return self._all_values("mean")
+
+    def count(self) -> DataFrame:
+        return self._all_values("count")
+
+    def std(self) -> DataFrame:
+        return self._all_values("std")
+
+    def var(self) -> DataFrame:
+        return self._all_values("var")
+
+    def nunique(self) -> DataFrame:
+        return self._all_values("nunique")
+
+
+class _Wrapping:
+    """Wraps a table indexer so results come back as DataFrames."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getitem__(self, item):
+        return DataFrame(_table=self._inner[item])
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _coalesce_keys(t: Table, keys: Sequence[str], suffixes, how: str) -> Table:
+    """After a same-name key join, collapse key_x/key_y into one column
+    (pandas.merge semantics)."""
+    import jax.numpy as jnp
+
+    from collections import OrderedDict
+
+    sx, sy = suffixes
+    new = OrderedDict()
+    for n, c in t._columns.items():
+        base = n[: -len(sx)] if sx and n.endswith(sx) else None
+        if base in keys:
+            cy = t._columns.get(base + sy)
+            if cy is not None:
+                if how in ("right",):
+                    data = jnp.where(
+                        cy.valid if cy.valid is not None else True, cy.data, c.data
+                    )
+                else:
+                    data = jnp.where(
+                        c.valid if c.valid is not None else True, c.data, cy.data
+                    )
+                valid = None
+                if c.valid is not None and cy.valid is not None:
+                    valid = c.valid | cy.valid
+                new[base] = Column(data, c.dtype, valid, c.dictionary)
+                continue
+        if sy and n.endswith(sy) and n[: -len(sy)] in keys:
+            continue  # dropped: coalesced above
+        new[n] = c
+    return t._replace(columns=new)
+
+
+def concat(
+    dfs: Sequence[DataFrame],
+    axis: int = 0,
+    env: Optional[CylonEnv] = None,
+) -> DataFrame:
+    """Reference frame.py:1470 concat (axis=0 row concat)."""
+    if axis != 0:
+        raise NotImplementedError("axis=1 concat not supported yet")
+    tables = [d._retarget(env) for d in dfs]
+    out = _concat_tables(tables)
+    return DataFrame(_table=out)

@@ -1,0 +1,2 @@
+from .index import BaseIndex, ColumnIndex, RangeIndex  # noqa: F401
+from .indexer import ILocIndexer, LocIndexer  # noqa: F401

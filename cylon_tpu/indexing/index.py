@@ -1,0 +1,67 @@
+"""Index objects for pandas-style row addressing.
+
+Reference analog: cpp/src/cylon/indexing/index.hpp — ``BaseIndex`` (:30),
+typed ``HashIndex`` (value -> row positions multimap, :82), ``RangeIndex``
+(:362), ``LinearIndex`` (:395).
+
+TPU-native design: there is no multimap. An index is either
+
+- :class:`RangeIndex` — implicit 0..n positions (no storage), or
+- :class:`ColumnIndex` — a designated column of the table; lookups are the
+  same vectorized searchsorted/isin kernels every other op uses. The
+  reference's HashIndex-vs-LinearIndex distinction collapses: an O(log n)
+  sorted probe over a whole batch of keys is the device-friendly equivalent
+  of both.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class BaseIndex:
+    """Common index surface (reference indexing/index.hpp:30-80)."""
+
+    @property
+    def name(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def is_range(self) -> bool:
+        return False
+
+
+class RangeIndex(BaseIndex):
+    """Implicit positional index (reference indexing/index.hpp:362-393)."""
+
+    def __init__(self, size: int):
+        self._size = int(size)
+
+    @property
+    def name(self):
+        return None
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def is_range(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"RangeIndex(0..{self._size})"
+
+
+class ColumnIndex(BaseIndex):
+    """Index backed by a table column (reference HashIndex/LinearIndex;
+    here value lookup is a vectorized probe, not a hash multimap)."""
+
+    def __init__(self, column_name: str):
+        self._name = column_name
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __repr__(self):
+        return f"ColumnIndex({self._name!r})"

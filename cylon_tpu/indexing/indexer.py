@@ -1,0 +1,147 @@
+"""loc / iloc row addressing.
+
+Reference analog: ``LocIndexer``/``ILocIndexer`` (indexing/indexer.hpp:143,214
++ 1160-LoC indexer.cpp implementing per-type loc modes). Here both reduce to
+building a boolean row mask with vectorized kernels and reusing
+``Table.filter``:
+
+- loc: value-based against the table's index column (single value, list of
+  values via sorted-probe isin, inclusive value slice);
+- iloc: position-based against the global front-packed row numbering.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _global_positions(table):
+    """Device array [P*cap]: global row number of each live row (padding gets
+    a number past the end). Host-known shard counts make this a constant."""
+    world = table.ctx.world_size
+    cap = table.shard_cap
+    counts = table.row_counts
+    offsets = np.concatenate([[0], np.cumsum(counts)])[:-1]  # per-shard start
+    total = int(counts.sum())
+    host = np.full((world * cap,), total + 1, np.int64)
+    for i in range(world):
+        c = int(counts[i])
+        host[i * cap : i * cap + c] = offsets[i] + np.arange(c)
+    import jax
+
+    return jax.device_put(host, table.ctx.sharding)
+
+
+def _index_column(table):
+    name = table.index_name
+    if name is None:
+        raise ValueError("loc requires set_index() first (table has RangeIndex)")
+    return table.column(name)
+
+
+def _encode_values(col, values):
+    """Host values -> physical device-comparable values for the column.
+    Dictionary misses encode to -1 (matches nothing: codes are >= 0)."""
+    vals = np.asarray(values)
+    if col.dtype.is_dictionary:
+        pos = np.searchsorted(col.dictionary, vals)
+        pos = np.clip(pos, 0, max(len(col.dictionary) - 1, 0))
+        hit = col.dictionary[pos] == vals
+        return np.where(hit, pos, -1).astype(np.int32)
+    return vals.astype(col.data.dtype)
+
+
+def _encode_bound(col, value, side: str):
+    """Encode a slice bound. For dictionary columns a missing bound maps to
+    its insertion point so range semantics hold (e.g. 'c' between 'b' and
+    'd')."""
+    if col.dtype.is_dictionary:
+        if side == "lo":
+            return np.int32(np.searchsorted(col.dictionary, value, side="left"))
+        return np.int32(np.searchsorted(col.dictionary, value, side="right") - 1)
+    return np.asarray(value).astype(col.data.dtype)
+
+
+class LocIndexer:
+    """table.loc[rows, cols] (reference indexer.hpp:143+)."""
+
+    def __init__(self, table):
+        self._t = table
+
+    def __getitem__(self, item):
+        rows, cols = _split_item(item)
+        t = self._t if cols is None else self._t.project(cols)
+        col = _index_column(self._t)
+        if isinstance(rows, slice):
+            if rows.step is not None:
+                raise ValueError("loc slices do not support step")
+            mask = None
+            if rows.start is not None:
+                lo = _encode_bound(col, rows.start, "lo")
+                m = col.data >= lo
+                mask = m if mask is None else (mask & m)
+            if rows.stop is not None:
+                hi = _encode_bound(col, rows.stop, "hi")
+                m = col.data <= hi  # pandas loc slices are inclusive
+                mask = m if mask is None else (mask & m)
+            if mask is None:
+                return t
+        else:
+            scalar = np.isscalar(rows) or isinstance(rows, str)
+            vals = [rows] if scalar else list(rows)
+            enc = np.sort(_encode_values(col, vals))
+            dev = jnp.asarray(enc)
+            pos = jnp.searchsorted(dev, col.data)
+            pos = jnp.clip(pos, 0, len(enc) - 1)
+            mask = dev[pos] == col.data
+        if col.valid is not None:
+            mask = mask & col.valid
+        return t.filter(mask)
+
+
+class ILocIndexer:
+    """table.iloc[positions, cols] (reference indexer.hpp:214+)."""
+
+    def __init__(self, table):
+        self._t = table
+
+    def __getitem__(self, item):
+        rows, cols = _split_item(item)
+        t = self._t if cols is None else self._t.project(cols)
+        gpos = _global_positions(self._t)
+        n = self._t.row_count
+        if isinstance(rows, slice):
+            start, stop, step = rows.indices(n)
+            if step == 1:
+                mask = (gpos >= start) & (gpos < stop)
+            else:
+                mask = (gpos >= start) & (gpos < stop) & ((gpos - start) % step == 0)
+        elif np.isscalar(rows):
+            p = int(rows)
+            if p < 0:
+                p += n
+            mask = gpos == p
+        else:
+            vals = np.asarray(list(rows), np.int64)
+            vals = np.where(vals < 0, vals + n, vals)
+            if len(vals) > 1 and not (np.diff(vals) > 0).all():
+                # duplicates / reordering: pandas iloc repeats and reorders
+                # rows — fall back to the host gather path
+                return t.take(vals)
+            dev = jnp.asarray(np.sort(vals))
+            pos = jnp.clip(jnp.searchsorted(dev, gpos), 0, len(vals) - 1)
+            mask = dev[pos] == gpos
+        return t.filter(mask)
+
+
+def _split_item(item):
+    if isinstance(item, tuple) and len(item) == 2:
+        rows, cols = item
+        if isinstance(cols, (str, int)):
+            cols = [cols]
+        elif isinstance(cols, slice):
+            cols = None if cols == slice(None) else cols
+        return rows, cols
+    return item, None

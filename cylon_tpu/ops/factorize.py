@@ -81,12 +81,9 @@ def factorize_two(
     # messy; do it directly here.
     lanes = []
     for data, valid in reversed(cat_cols):
-        d = data
-        if jnp.issubdtype(d.dtype, jnp.floating):
-            d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
-        if d.dtype == jnp.bool_:
-            d = d.astype(jnp.int8)
-        lanes.append(d)
+        from .sort import orderable_key
+
+        lanes.append(orderable_key(data))
         if valid is not None:
             lanes.append((~valid).astype(jnp.int8))
     lanes.append((~live).astype(jnp.int8))  # most significant: padding last

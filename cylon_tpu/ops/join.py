@@ -43,13 +43,26 @@ def join_type_id(how: str) -> int:
 
 
 class _Probe(NamedTuple):
-    l_ids: jax.Array      # [cap_l] dense ids (padding -> big)
-    r_ids: jax.Array      # [cap_r]
-    r_order: jax.Array    # [cap_r] argsort of r_ids (stable)
-    r_sorted: jax.Array   # [cap_r] sorted r_ids
-    lo: jax.Array         # [cap_l] first match position in r_sorted
+    lo: jax.Array         # [cap_l] first match position in sorted right keys
     cnt: jax.Array        # [cap_l] match count per live left row
+    r_order: jax.Array    # [cap_r] argsort of right keys (stable)
     r_cnt: jax.Array      # [cap_r] match count per live right row
+
+
+def _fast_path_ok(cols: Sequence[KeyCol]) -> bool:
+    """Single key column, no validity mask, <=32-bit physical value: the key
+    canonicalizes to one uint32 lane (ops.sort.orderable_key), no factorize
+    needed."""
+    if len(cols) != 1:
+        return False
+    data, valid = cols[0]
+    if valid is not None:
+        return False
+    dt = data.dtype
+    return dt == jnp.bool_ or (
+        (jnp.issubdtype(dt, jnp.integer) or dt in (jnp.float32, jnp.float16))
+        and np.dtype(dt).itemsize <= 4
+    )
 
 
 def _probe(
@@ -60,9 +73,47 @@ def _probe(
     cap_l: int,
     cap_r: int,
 ) -> _Probe:
-    l_ids, r_ids, _ = factorize_two(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     idx_r = jnp.arange(cap_r, dtype=jnp.int32)
+    # promote key dtypes to a common type first: orderable_key lanes are only
+    # comparable within one dtype (int32 vs uint32 canonicalize differently)
+    if (
+        len(l_key_cols) == 1
+        and len(r_key_cols) == 1
+        and l_key_cols[0][0].dtype != r_key_cols[0][0].dtype
+    ):
+        common = jnp.promote_types(l_key_cols[0][0].dtype, r_key_cols[0][0].dtype)
+        l_key_cols = [(l_key_cols[0][0].astype(common), l_key_cols[0][1])]
+        r_key_cols = [(r_key_cols[0][0].astype(common), r_key_cols[0][1])]
+    if _fast_path_ok(l_key_cols) and _fast_path_ok(r_key_cols):
+        # Single <=32-bit key, no nulls: stay entirely in uint32 (no int64
+        # emulation on TPU). Padding rows take the value UINT32_MAX; because
+        # tables are front-packed (padding indices >= n) and argsort is
+        # stable, live rows with a real MAX key still sort BEFORE padding
+        # inside the equal run, so emit's positional gather stays correct;
+        # the count correction below subtracts the padding run exactly.
+        from .sort import orderable_key
+
+        MAXU = np.uint32(0xFFFFFFFF)
+        lk = orderable_key(l_key_cols[0][0])
+        rk = orderable_key(r_key_cols[0][0])
+        l_ids = jnp.where(idx_l < nl, lk, MAXU)
+        r_ids = jnp.where(idx_r < nr, rk, MAXU)
+        r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+        r_sorted = r_ids[r_order]
+        lo = jnp.searchsorted(r_sorted, l_ids, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(r_sorted, l_ids, side="right").astype(jnp.int32)
+        pad_r = (cap_r - nr).astype(jnp.int32)
+        cnt = hi - lo - jnp.where(l_ids == MAXU, pad_r, 0)
+        cnt = jnp.where(idx_l < nl, jnp.maximum(cnt, 0), 0).astype(jnp.int32)
+        l_sorted = jnp.sort(l_ids)
+        rlo = jnp.searchsorted(l_sorted, r_ids, side="left").astype(jnp.int32)
+        rhi = jnp.searchsorted(l_sorted, r_ids, side="right").astype(jnp.int32)
+        pad_l = (cap_l - nl).astype(jnp.int32)
+        r_cnt = rhi - rlo - jnp.where(r_ids == MAXU, pad_l, 0)
+        r_cnt = jnp.where(idx_r < nr, jnp.maximum(r_cnt, 0), 0).astype(jnp.int32)
+        return _Probe(lo, cnt, r_order, r_cnt)
+    l_ids, r_ids, _ = factorize_two(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
     big = jnp.int32(cap_l + cap_r)
     l_ids = jnp.where(idx_l < nl, l_ids, big)
     r_ids = jnp.where(idx_r < nr, r_ids, big)
@@ -75,7 +126,67 @@ def _probe(
     rlo = jnp.searchsorted(l_sorted, r_ids, side="left").astype(jnp.int32)
     rhi = jnp.searchsorted(l_sorted, r_ids, side="right").astype(jnp.int32)
     r_cnt = jnp.where(idx_r < nr, rhi - rlo, 0).astype(jnp.int32)
-    return _Probe(l_ids, r_ids, r_order, r_sorted, lo, cnt, r_cnt)
+    return _Probe(lo, cnt, r_order, r_cnt)
+
+
+def probe_arrays(
+    l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Phase-1 kernel surface: returns the static-shaped probe state
+    (lo, cnt, r_order, r_cnt) so the emit phase need not recompute the sorts."""
+    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    return (p.lo, p.cnt, p.r_order, p.r_cnt)
+
+
+def count_from_probe(cnt, r_cnt, nl, nr, how: int) -> jax.Array:
+    cap_l = cnt.shape[0]
+    cap_r = r_cnt.shape[0]
+    inner = jnp.sum(cnt)
+    total = inner
+    if how in (LEFT, FULL_OUTER):
+        total = total + jnp.sum((cnt == 0) & (jnp.arange(cap_l) < nl))
+    if how in (RIGHT, FULL_OUTER):
+        total = total + jnp.sum((r_cnt == 0) & (jnp.arange(cap_r) < nr))
+    return total.astype(jnp.int32)
+
+
+def emit_from_probe(
+    lo, cnt, r_order, r_cnt, nl, nr, how: int, cap_out: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Phase-2: join row indices from the phase-1 probe state."""
+    cap_l = lo.shape[0]
+    cap_r = r_order.shape[0]
+    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
+    live_l = idx_l < nl
+    if how in (LEFT, FULL_OUTER):
+        cnt_adj = jnp.where(live_l & (cnt == 0), 1, cnt)
+    else:
+        cnt_adj = cnt
+    offs = jnp.cumsum(cnt_adj) - cnt_adj
+    total_l = jnp.sum(cnt_adj).astype(jnp.int32)
+
+    li = jnp.repeat(idx_l, cnt_adj, total_repeat_length=cap_out)
+    offs_rep = jnp.repeat(offs, cnt_adj, total_repeat_length=cap_out)
+    within = jnp.arange(cap_out, dtype=jnp.int32) - offs_rep
+    has_match = cnt[li] > 0
+    rpos = jnp.clip(lo[li] + within, 0, cap_r - 1)
+    ri = jnp.where(has_match, r_order[rpos], -1)
+    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
+    in_left_part = out_pos < total_l
+    li = jnp.where(in_left_part, li, -1)
+    ri = jnp.where(in_left_part, ri, -1)
+
+    n_out = total_l
+    if how in (RIGHT, FULL_OUTER):
+        idx_r = jnp.arange(cap_r, dtype=jnp.int32)
+        r_un = (r_cnt == 0) & (idx_r < nr)
+        r_un_rank = jnp.cumsum(r_un.astype(jnp.int32)) - 1
+        n_r_un = jnp.sum(r_un).astype(jnp.int32)
+        dest = jnp.where(r_un, total_l + r_un_rank, cap_out)
+        ri = ri.at[dest].set(idx_r, mode="drop")
+        li = li.at[dest].set(-1, mode="drop")
+        n_out = total_l + n_r_un
+    return li, ri, n_out.astype(jnp.int32)
 
 
 def join_count(
@@ -89,15 +200,7 @@ def join_count(
 ) -> jax.Array:
     """Exact number of output rows for the given join type (scalar int32)."""
     p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
-    inner = jnp.sum(p.cnt)
-    l_un = jnp.sum((p.cnt == 0) & (jnp.arange(cap_l) < nl))
-    r_un = jnp.sum((p.r_cnt == 0) & (jnp.arange(cap_r) < nr))
-    total = inner
-    if how in (LEFT, FULL_OUTER):
-        total = total + l_un
-    if how in (RIGHT, FULL_OUTER):
-        total = total + r_un
-    return total.astype(jnp.int32)
+    return count_from_probe(p.cnt, p.r_cnt, nl, nr, how)
 
 
 def join_emit(
@@ -117,38 +220,7 @@ def join_emit(
     ``cap_out`` must be >= the corresponding :func:`join_count`.
     """
     p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
-    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
-    live_l = idx_l < nl
-    # per-left-row emitted count: outer-left rows emit one null-match row
-    if how in (LEFT, FULL_OUTER):
-        cnt_adj = jnp.where(live_l & (p.cnt == 0), 1, p.cnt)
-    else:
-        cnt_adj = p.cnt
-    offs = jnp.cumsum(cnt_adj) - cnt_adj  # exclusive prefix
-    total_l = jnp.sum(cnt_adj).astype(jnp.int32)
-
-    li = jnp.repeat(idx_l, cnt_adj, total_repeat_length=cap_out)
-    offs_rep = jnp.repeat(offs, cnt_adj, total_repeat_length=cap_out)
-    within = jnp.arange(cap_out, dtype=jnp.int32) - offs_rep
-    has_match = p.cnt[li] > 0
-    rpos = jnp.clip(p.lo[li] + within, 0, cap_r - 1)
-    ri = jnp.where(has_match, p.r_order[rpos], -1)
-    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
-    in_left_part = out_pos < total_l
-    li = jnp.where(in_left_part, li, -1)
-    ri = jnp.where(in_left_part, ri, -1)
-
-    n_out = total_l
-    if how in (RIGHT, FULL_OUTER):
-        idx_r = jnp.arange(cap_r, dtype=jnp.int32)
-        r_un = (p.r_cnt == 0) & (idx_r < nr)
-        r_un_rank = jnp.cumsum(r_un.astype(jnp.int32)) - 1
-        n_r_un = jnp.sum(r_un).astype(jnp.int32)
-        dest = jnp.where(r_un, total_l + r_un_rank, cap_out)  # cap_out = drop
-        ri = ri.at[dest].set(idx_r, mode="drop")
-        li = li.at[dest].set(-1, mode="drop")
-        n_out = total_l + n_r_un
-    return li, ri, n_out.astype(jnp.int32)
+    return emit_from_probe(p.lo, p.cnt, p.r_order, p.r_cnt, nl, nr, how, cap_out)
 
 
 def gather_column(

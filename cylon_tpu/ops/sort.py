@@ -21,27 +21,56 @@ import numpy as np
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]  # (data, valid-or-None)
 
 
-def _norm_key(data: jax.Array, ascending: bool) -> jax.Array:
-    """Normalize one key column into a lane where plain ascending integer /
-    float ordering matches the requested order. Nulls are handled by a
-    separate lane, so NaNs here can be arbitrary."""
+def orderable_key(data: jax.Array) -> jax.Array:
+    """Map a numeric column to an unsigned-integer lane where plain unsigned
+    ordering == value ordering, with total-order float semantics:
+    -inf < ... < -0 == +0 < ... < +inf < NaN (all NaNs equal).
+
+    This is THE canonical key representation: every sort lane, run-detect
+    equality, and join probe uses it, so NaN==NaN and -0.0==+0.0 behave
+    identically across all ops (pandas semantics).
+    """
     dt = data.dtype
     if dt == jnp.bool_:
-        data = data.astype(jnp.int8)
-        dt = data.dtype
-    if not ascending:
-        if jnp.issubdtype(dt, jnp.floating):
-            data = -data
-        elif jnp.issubdtype(dt, jnp.unsignedinteger):
-            data = ~data
-        else:
-            data = ~data  # bitwise-not reverses two's-complement order
+        return data.astype(jnp.uint32)
     if jnp.issubdtype(dt, jnp.floating):
-        # floats sort fine natively except NaN; NaN rows are null rows and
-        # ordered by the null lane, but keep them finite to avoid NaN
-        # comparisons inside the sort network.
-        data = jnp.where(jnp.isnan(data), jnp.zeros_like(data), data)
-    return data
+        if dt == jnp.float16 or dt == jnp.bfloat16:
+            data = data.astype(jnp.float32)
+            dt = jnp.dtype(jnp.float32)
+        # canonicalize: -0.0 -> +0.0, any NaN -> canonical quiet NaN
+        data = jnp.where(data == 0, jnp.zeros_like(data), data)
+        if dt == jnp.float64:
+            b = jax.lax.bitcast_convert_type(data, jnp.uint64)
+            b = jnp.where(jnp.isnan(data), jnp.uint64(0x7FF8000000000000), b)
+            return jnp.where(
+                (b >> jnp.uint64(63)) == 0, b | jnp.uint64(1 << 63), ~b
+            )
+        b = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        b = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), b)
+        return jnp.where((b >> np.uint32(31)) == 0, b | np.uint32(0x80000000), ~b)
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        if np.dtype(dt).itemsize <= 4:
+            return data.astype(jnp.uint32)
+        return data.astype(jnp.uint64)
+    # signed integers: flip sign bit into unsigned order
+    if np.dtype(dt).itemsize <= 4:
+        return (
+            jax.lax.bitcast_convert_type(data.astype(jnp.int32), jnp.uint32)
+            ^ np.uint32(0x80000000)
+        )
+    return (
+        jax.lax.bitcast_convert_type(data.astype(jnp.int64), jnp.uint64)
+        ^ jnp.uint64(1 << 63)
+    )
+
+
+def _norm_key(data: jax.Array, ascending: bool) -> jax.Array:
+    """Normalize one key column into an unsigned lane where plain ascending
+    unsigned ordering matches the requested order (see orderable_key)."""
+    lane = orderable_key(data)
+    if not ascending:
+        lane = ~lane
+    return lane
 
 
 def row_class(
@@ -97,14 +126,12 @@ def rows_differ(
     """
     diff = jnp.zeros((cap,), dtype=bool).at[0].set(True)
     for data, valid in sorted_cols:
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            data = jnp.where(jnp.isnan(data), jnp.zeros_like(data), data)
-        prev = jnp.roll(data, 1)
-        d = data != prev
+        lane = orderable_key(data)
+        prev = jnp.roll(lane, 1)
+        d = lane != prev
         if valid is not None:
             vprev = jnp.roll(valid, 1)
-            # null vs value differs; null vs null equal (value lane zeroed)
+            # null vs value differs; null vs null equal (value lane ignored)
             d = jnp.where(valid & vprev, d, valid != vprev)
-            # both null -> equal
         diff = diff | d
     return diff.at[0].set(True)

@@ -1,0 +1,189 @@
+"""Fully-jittable distributed pipelines with static capacities.
+
+The eager Table ops use a count->emit two-phase with one host sync per op
+(exact sizes, zero overflow). This module is the second execution mode — the
+analog of the reference's streaming op-DAG engine (cpp/src/cylon/ops/:
+DisJoinOP builds partition->shuffle->join graphs executed without
+materializing intermediates, dis_join_op.cpp:26-71): the WHOLE
+partition -> all_to_all -> join -> aggregate chain is one XLA program under
+shard_map, with user-supplied capacity factors instead of host syncs. XLA
+fuses and overlaps the stages (async collectives) the way the reference's
+cooperative scheduler interleaves op execution (ops/execution/execution.hpp).
+
+Capacities: ``bucket_cap`` bounds rows any shard sends to any one target
+(reference sidesteps this with byte-streaming, arrow_all_to_all.cpp:83-141 —
+impossible under XLA static shapes); ``join_cap`` bounds per-shard join
+output. Each step also returns an ``overflow`` flag so callers can detect
+undersized capacities and re-run with bigger ones (two-round respill,
+SURVEY.md §7 hard-parts plan).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+from ..ops import join as _j
+from ..ops import partition as _p
+from ..ops.sort import KeyCol
+from . import shuffle as _sh
+
+
+class ShardTable(NamedTuple):
+    """Per-shard view: list of (data, valid) columns + live-row count."""
+
+    cols: Tuple[KeyCol, ...]
+    n: jax.Array  # scalar int32
+
+
+def shuffle_shard(
+    st: ShardTable,
+    key_idx: Sequence[int],
+    world: int,
+    bucket_cap: int,
+    axis_name: str,
+) -> Tuple[ShardTable, jax.Array]:
+    """Static-capacity hash shuffle of one table (per-shard code).
+
+    Returns (shuffled shard table [world*bucket_cap rows], overflow count).
+    """
+    keys = [st.cols[i] for i in key_idx]
+    pid = _p.hash_partition_ids(keys, st.n, world)
+    cnt = _sh.bucket_counts(pid, world)
+    dest, overflow = _sh.build_send_slots(pid, cnt, world, bucket_cap)
+    sent = jnp.minimum(cnt, bucket_cap)
+    recv_counts = _sh.exchange_counts(sent, axis_name)
+    out_cols = []
+    for data, valid in st.cols:
+        d = _sh.exchange_column(data, dest, world, bucket_cap, axis_name)
+        v = (
+            None
+            if valid is None
+            else _sh.exchange_column(valid, dest, world, bucket_cap, axis_name).astype(bool)
+        )
+        out_cols.append((d, v))
+    mask, total = _sh.received_row_mask(recv_counts, world, bucket_cap)
+    out_cols = _sh.compact_received(out_cols, mask)
+    overflow = jax.lax.psum(overflow, axis_name)
+    return ShardTable(tuple(out_cols), total), overflow
+
+
+def join_shard(
+    left: ShardTable,
+    right: ShardTable,
+    l_key_idx: Sequence[int],
+    r_key_idx: Sequence[int],
+    how: int,
+    join_cap: int,
+) -> Tuple[ShardTable, jax.Array]:
+    """Static-capacity local join (per-shard). Returns (joined table
+    [join_cap rows] = left cols ++ right cols, overflow count)."""
+    lk = [left.cols[i] for i in l_key_idx]
+    rk = [right.cols[i] for i in r_key_idx]
+    cap_l = lk[0][0].shape[0]
+    cap_r = rk[0][0].shape[0]
+    lo, cnt, r_order, r_cnt = _j.probe_arrays(
+        lk, rk, left.n, right.n, cap_l, cap_r
+    )
+    needed = _j.count_from_probe(cnt, r_cnt, left.n, right.n, how)
+    li, ri, n_out = _j.emit_from_probe(
+        lo, cnt, r_order, r_cnt, left.n, right.n, how, join_cap
+    )
+    out = [_j.gather_column(d, v, li) for d, v in left.cols]
+    out += [_j.gather_column(d, v, ri) for d, v in right.cols]
+    overflow = jnp.maximum(needed - join_cap, 0)
+    return ShardTable(tuple(out), jnp.minimum(n_out, join_cap)), overflow
+
+
+def make_distributed_join_step(
+    mesh: Mesh,
+    axis_name: str,
+    l_key_idx: Sequence[int],
+    r_key_idx: Sequence[int],
+    how: int,
+    bucket_cap: int,
+    join_cap: int,
+):
+    """Build the jittable distributed-join step over the mesh.
+
+    Signature of the returned fn (global, row-sharded arrays):
+      (l_cols, l_counts[P], r_cols, r_counts[P]) ->
+      (out_cols [P*join_cap], out_counts [P], overflow [P])
+
+    This is the whole reference DistributedJoin call stack (SURVEY.md §3.2)
+    as ONE compiled XLA program: hash -> scatter -> all_to_all -> sort-join
+    -> gather, with collectives over the mesh axis.
+    """
+    world = mesh.shape[axis_name]
+
+    def step(dp, rep):
+        (l_cols, l_counts, r_cols, r_counts) = dp
+        lt = ShardTable(tuple(l_cols), l_counts[0])
+        rt = ShardTable(tuple(r_cols), r_counts[0])
+        if world > 1:
+            lt, ovl = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name)
+            rt, ovr = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name)
+        else:
+            ovl = ovr = jnp.int32(0)
+        jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
+        overflow = (ovl + ovr + ovj).reshape(1)
+        return list(jt.cols), jt.n.reshape(1), overflow
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis_name), PartitionSpec()),
+            out_specs=PartitionSpec(axis_name),
+        )
+    )
+
+
+def make_join_groupby_step(
+    mesh: Mesh,
+    axis_name: str,
+    l_key_idx: Sequence[int],
+    r_key_idx: Sequence[int],
+    agg_col_idx: int,
+    how: int,
+    bucket_cap: int,
+    join_cap: int,
+    group_cap: int,
+):
+    """Distributed join followed by groupby-sum on the join key and a global
+    psum'd total — the TPC-H Q3-ish fused step used by benchmarks and the
+    multi-chip dry run."""
+    from ..ops import groupby as _g
+
+    world = mesh.shape[axis_name]
+
+    def step(dp, rep):
+        (l_cols, l_counts, r_cols, r_counts) = dp
+        lt = ShardTable(tuple(l_cols), l_counts[0])
+        rt = ShardTable(tuple(r_cols), r_counts[0])
+        if world > 1:
+            lt, _ = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name)
+            rt, _ = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name)
+        jt, _ = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
+        # group on the (left) join key, sum the aggregate column
+        keys = [jt.cols[i] for i in l_key_idx]
+        ids, ng = _g.group_ids(keys, jt.n, join_cap)
+        d, v = jt.cols[agg_col_idx]
+        s, _sv = _g.aggregate_column(_g.SUM, d, v, ids, ng, group_cap)
+        total = s.sum()
+        if world > 1:
+            total = jax.lax.psum(total, axis_name)
+        return s, ng.reshape(1), jt.n.reshape(1), total.reshape(1)
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis_name), PartitionSpec()),
+            out_specs=PartitionSpec(axis_name),
+        )
+    )

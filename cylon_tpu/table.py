@@ -55,12 +55,16 @@ class Table:
         columns: "OrderedDict[str, Column]",
         row_counts: np.ndarray,
         shard_cap: int,
+        index_name: Optional[str] = None,
     ):
         self.ctx = ctx
         self._columns: "OrderedDict[str, Column]" = columns
         self._row_counts = np.asarray(row_counts, np.int64)
         self._shard_cap = int(shard_cap)
         self._counts_dev = None
+        # pandas-style index: None == RangeIndex; else the named column is
+        # the index (reference Set_Index/ResetIndex, table.hpp + indexing/)
+        self.index_name = index_name if index_name in (columns.keys() | {None}) else None
 
     # ------------------------------------------------------------------
     # basic properties
@@ -183,6 +187,7 @@ class Table:
             self._columns if columns is None else columns,
             self._row_counts if row_counts is None else row_counts,
             self._shard_cap if shard_cap is None else shard_cap,
+            index_name=self.index_name,
         )
 
     # ------------------------------------------------------------------
@@ -250,7 +255,10 @@ class Table:
         for (out_name, src_col), (data, valid) in zip(names, flat):
             dic = (dicts or {}).get(out_name, src_col.dictionary)
             cols[out_name] = Column(data, src_col.dtype, valid, dic)
-        return Table(self.ctx, cols, row_counts, cap)
+        # row-subset ops (filter/sort/unique/loc) keep the index; ops that
+        # rename it away (join suffixes) drop it, like pandas
+        idx = self.index_name if self.index_name in cols else None
+        return Table(self.ctx, cols, row_counts, cap, index_name=idx)
 
     def _out_counts(self, per_shard) -> np.ndarray:
         return np.asarray(per_shard).astype(np.int64)
@@ -556,32 +564,34 @@ class Table:
         rk_idx = tuple(right.column_names.index(n) for n in r_names)
         key = ("join", howi, lk_idx, rk_idx, len(lflat), len(rflat))
 
-        def build_count():
+        # phase 1: probe (the sorts) — returns reusable probe state + count
+        def build_probe():
             def kern(dp, rep):
                 (lk, rk, nl, nr) = dp
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
-                return _scalar(
-                    _j.join_count(lk, rk, nl[0], nr[0], cap_l, cap_r, howi)
+                lo, cnt, r_order, r_cnt = _j.probe_arrays(
+                    lk, rk, nl[0], nr[0], cap_l, cap_r
                 )
+                total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
+                return lo, cnt, r_order, r_cnt, _scalar(total)
 
             return kern
 
-        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
-            (lflat_k, rflat_k, left.counts_dev, right.counts_dev), ()
-        )
+        lo, cnt, r_order, r_cnt, cnts = get_kernel(
+            self.ctx, key + ("probe",), build_probe
+        )((lflat_k, rflat_k, left.counts_dev, right.counts_dev), ())
         cnts = self._out_counts(cnts)
         cap_out = round_cap(int(cnts.max()))
 
+        # phase 2: emit + gather, reusing the probe state (no re-sort)
         def build_emit():
             def kern(dp, rep):
-                (lk, rk, lcols, rcols, nl, nr) = dp
+                (lo, cnt, r_order, r_cnt, lcols, rcols, nl, nr) = dp
                 (dummy,) = rep
                 co = dummy.shape[0]
-                cap_l = lk[0][0].shape[0]
-                cap_r = rk[0][0].shape[0]
-                li, ri, n_out = _j.join_emit(
-                    lk, rk, nl[0], nr[0], cap_l, cap_r, howi, co
+                li, ri, n_out = _j.emit_from_probe(
+                    lo, cnt, r_order, r_cnt, nl[0], nr[0], howi, co
                 )
                 out = [_j.gather_column(d, v, li) for d, v in lcols]
                 out += [_j.gather_column(d, v, ri) for d, v in rcols]
@@ -590,7 +600,7 @@ class Table:
             return kern
 
         out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-            (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
+            (lo, cnt, r_order, r_cnt, lflat, rflat, left.counts_dev, right.counts_dev),
             (jnp.zeros((cap_out,), jnp.int8),),
         )
         # output schema: left columns then right columns, suffix on collision
@@ -1023,6 +1033,46 @@ class Table:
             return True
         except AssertionError:
             return False
+
+    # ------------------------------------------------------------------
+    # indexing (reference indexing/ subsystem; pycylon set_index/loc/iloc
+    # surface, data/table.pyx:2057-2333)
+    # ------------------------------------------------------------------
+    def set_index(self, column: Union[str, int], drop: bool = False) -> "Table":
+        """Designate a column as the index (reference Set_Index,
+        table.hpp; HashIndex build indexing/index_utils.cpp). ``drop`` is
+        rejected: the index IS a column here."""
+        if drop:
+            raise ValueError("drop=True unsupported: the index is a live column")
+        name = self._resolve_cols(column)[0]
+        t = self._replace()
+        t.index_name = name
+        return t
+
+    def reset_index(self) -> "Table":
+        t = self._replace()
+        t.index_name = None
+        return t
+
+    @property
+    def index(self):
+        from .indexing import ColumnIndex, RangeIndex
+
+        if self.index_name is None:
+            return RangeIndex(self.row_count)
+        return ColumnIndex(self.index_name)
+
+    @property
+    def loc(self):
+        from .indexing import LocIndexer
+
+        return LocIndexer(self)
+
+    @property
+    def iloc(self):
+        from .indexing import ILocIndexer
+
+        return ILocIndexer(self)
 
     # ------------------------------------------------------------------
     # helpers

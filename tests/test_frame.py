@@ -1,0 +1,151 @@
+"""DataFrame/CylonEnv layer tests vs pandas oracles.
+
+Reference analog: python/test/test_frame.py (construction equivalence),
+test_dist_rl.py (distributed relational algebra via env kwarg).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.frame import CylonEnv, DataFrame, concat
+
+
+@pytest.fixture(scope="module")
+def env(devices):
+    return CylonEnv(config=ct.TPUConfig(devices=devices[:4]))
+
+
+def _pair(rng, n=40, m=30, ks=12):
+    a = pd.DataFrame({"id": rng.integers(0, ks, n), "x": rng.normal(size=n)})
+    b = pd.DataFrame({"id": rng.integers(0, ks, m), "y": rng.normal(size=m)})
+    return a, b
+
+
+def test_construction_equivalence(env, rng):
+    pdf = pd.DataFrame({"a": [1, 2, 3], "b": [0.1, 0.2, 0.3]})
+    for data in (pdf, {"a": [1, 2, 3], "b": [0.1, 0.2, 0.3]}):
+        df = DataFrame(data, ctx=env.context)
+        pd.testing.assert_frame_equal(df.to_pandas(), pdf, check_dtype=False)
+
+
+def test_merge_env_switch(env, rng):
+    a, b = _pair(rng)
+    da = DataFrame(a, ctx=env.context)
+    db = DataFrame(b, ctx=env.context)
+    got = da.merge(db, on="id", how="inner", env=env).to_pandas()
+    exp = a.merge(b, on="id", how="inner")
+    assert len(got) == len(exp)
+    assert set(got.columns) == {"id", "x", "y"}
+    cols = ["id", "x", "y"]
+    pd.testing.assert_frame_equal(
+        got.sort_values(cols).reset_index(drop=True)[cols],
+        exp.sort_values(cols).reset_index(drop=True)[cols],
+        check_dtype=False,
+    )
+
+
+@pytest.mark.parametrize("how", ["left", "right", "outer"])
+def test_merge_outer_coalesce(env, rng, how):
+    a, b = _pair(rng, ks=20)
+    da = DataFrame(a, ctx=env.context)
+    db = DataFrame(b, ctx=env.context)
+    got = da.merge(db, on="id", how=how, env=env).to_pandas()
+    exp = a.merge(b, on="id", how=how)
+    assert len(got) == len(exp)
+    # the coalesced key column must match pandas' key exactly (as a multiset)
+    assert sorted(got["id"].tolist()) == sorted(exp["id"].tolist())
+
+
+def test_sort_values(env, rng):
+    a, _ = _pair(rng, n=77)
+    da = DataFrame(a, ctx=env.context)
+    got = da.sort_values("x", env=env).to_pandas()["x"].to_numpy()
+    assert (np.diff(got) >= 0).all()
+    got_local = da.sort_values("x").to_pandas()  # per-shard only
+    assert len(got_local) == 77
+
+
+def test_drop_duplicates(env, rng):
+    a = pd.DataFrame({"k": rng.integers(0, 8, 60)})
+    da = DataFrame(a, ctx=env.context)
+    got = da.drop_duplicates(env=env).to_pandas()
+    assert sorted(got["k"].tolist()) == sorted(a["k"].drop_duplicates().tolist())
+
+
+def test_groupby_agg(env, rng):
+    a, _ = _pair(rng, n=90)
+    da = DataFrame(a, ctx=env.context)
+    got = (
+        da.groupby("id", env=env)
+        .agg({"x": ["sum", "count"]})
+        .to_pandas()
+        .sort_values("id")
+        .reset_index(drop=True)
+    )
+    exp = (
+        a.groupby("id")["x"]
+        .agg(["sum", "count"])
+        .reset_index()
+        .rename(columns={"sum": "x_sum", "count": "x_count"})
+    )
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_groupby_convenience(env, rng):
+    a, _ = _pair(rng, n=50)
+    da = DataFrame(a, ctx=env.context)
+    got = da.groupby("id", env=env).mean().to_pandas().sort_values("id").reset_index(drop=True)
+    exp = a.groupby("id")["x"].mean().reset_index().rename(columns={"x": "x_mean"})
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_filter_operators(env, rng):
+    a, _ = _pair(rng, n=64)
+    da = DataFrame(a, ctx=env.context)
+    mask = da["x"] > 0.0
+    got = da[mask].to_pandas()
+    exp = a[a["x"] > 0.0]
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        np.sort(got["x"].to_numpy()), np.sort(exp["x"].to_numpy())
+    )
+    # compound masks
+    m2 = (da["x"] > 0.0) & (da["id"] < 6)
+    got2 = da[m2].to_pandas()
+    exp2 = a[(a["x"] > 0.0) & (a["id"] < 6)]
+    assert len(got2) == len(exp2)
+
+
+def test_arithmetic(env, rng):
+    a, _ = _pair(rng, n=32)
+    da = DataFrame(a, ctx=env.context)
+    out = (da["x"] * 2.0 + 1.0).to_pandas()["x"].to_numpy()
+    np.testing.assert_allclose(np.sort(out), np.sort(a["x"].to_numpy() * 2 + 1))
+
+
+def test_concat(env, rng):
+    a, b = _pair(rng)
+    b = b.rename(columns={"y": "x"})
+    da = DataFrame(a, ctx=env.context)
+    db = DataFrame(b, ctx=env.context)
+    got = concat([da, db], env=env).to_pandas()
+    exp = pd.concat([a, b])
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        np.sort(got["x"].to_numpy()), np.sort(exp["x"].to_numpy())
+    )
+
+
+def test_fillna_isnull(env, rng):
+    a = pd.DataFrame({"x": [1.0, np.nan, 3.0, np.nan]})
+    da = DataFrame(a, ctx=env.context)
+    assert da.isnull().to_pandas()["x"].tolist() == [False, True, False, True]
+    filled = da.fillna(9.0).to_pandas()["x"].tolist()
+    assert filled == [1.0, 9.0, 3.0, 9.0]
+
+
+def test_env_properties(env):
+    assert env.world_size == 4
+    assert env.rank == 0
+    env.barrier()

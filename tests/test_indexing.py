@@ -1,0 +1,113 @@
+"""Indexing subsystem tests (reference python/test/test_index.py patterns)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.frame import DataFrame
+
+
+def _tbl(ctx, rng, n=40):
+    df = pd.DataFrame(
+        {
+            "id": np.arange(n, dtype=np.int64),
+            "k": rng.integers(0, 7, n),
+            "v": rng.normal(size=n),
+        }
+    )
+    return df, ct.Table.from_pandas(ctx, df)
+
+
+def test_set_reset_index(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    assert t.index.is_range()
+    ti = t.set_index("id")
+    assert ti.index.name == "id"
+    assert ti.reset_index().index.is_range()
+
+
+def test_loc_value(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    out = ti.loc[7].to_pandas()
+    assert len(out) == 1 and out["id"].iloc[0] == 7
+    out = ti.loc[[3, 5, 11]].to_pandas()
+    assert sorted(out["id"].tolist()) == [3, 5, 11]
+
+
+def test_loc_slice_inclusive(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    out = ti.loc[10:15].to_pandas()
+    assert sorted(out["id"].tolist()) == list(range(10, 16))  # inclusive
+    out = ti.loc[10:15, ["id", "v"]]
+    assert out.column_names == ["id", "v"]
+
+
+def test_loc_missing_values(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    out = ti.loc[[1000, 2000]].to_pandas()
+    assert len(out) == 0
+
+
+def test_loc_requires_index(ctx8, rng):
+    _, t = _tbl(ctx8, rng)
+    with pytest.raises(ValueError):
+        t.loc[3]
+
+
+def test_iloc_scalar_slice_list(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    out = t.iloc[5].to_pandas()
+    assert len(out) == 1 and out["id"].iloc[0] == df.iloc[5]["id"]
+    out = t.iloc[10:20].to_pandas()
+    assert sorted(out["id"].tolist()) == df.iloc[10:20]["id"].tolist()
+    out = t.iloc[[0, 3, 39]].to_pandas()
+    assert sorted(out["id"].tolist()) == [0, 3, 39]
+    out = t.iloc[-1].to_pandas()
+    assert out["id"].iloc[0] == 39
+    out = t.iloc[0:20:2].to_pandas()
+    assert len(out) == 10
+
+
+def test_string_index(ctx8, rng):
+    df = pd.DataFrame({"s": ["a", "b", "c", "d"], "v": [1.0, 2.0, 3.0, 4.0]})
+    t = ct.Table.from_pandas(ctx8, df).set_index("s")
+    out = t.loc[["b", "d"]].to_pandas()
+    assert sorted(out["s"].tolist()) == ["b", "d"]
+    out = t.loc["zzz":"zzz"] if False else t.loc[["nope"]]
+    assert out.row_count == 0
+
+
+def test_dataframe_indexing(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    d = DataFrame(_table=t).set_index("id")
+    out = d.loc[[2, 4]].to_pandas()
+    assert sorted(out["id"].tolist()) == [2, 4]
+    out = d.iloc[0:5].to_pandas()
+    assert len(out) == 5
+
+
+def test_loc_slice_missing_bound_string(ctx8):
+    df = pd.DataFrame({"s": ["a", "b", "d"], "v": [1.0, 2.0, 3.0]})
+    t = ct.Table.from_pandas(ctx8, df).set_index("s")
+    out = t.loc["c":].to_pandas()
+    assert sorted(out["s"].tolist()) == ["d"]
+    out = t.loc[:"c"].to_pandas()
+    assert sorted(out["s"].tolist()) == ["a", "b"]
+
+
+def test_index_preserved_through_filter(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    sub = ti.loc[[3, 5]]
+    assert sub.index_name == "id"
+    again = sub.loc[[5]].to_pandas()
+    assert again["id"].tolist() == [5]
+
+
+def test_iloc_duplicates_and_order(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    out = t.iloc[[3, 1, 1]].to_pandas()
+    assert out["id"].tolist() == [3, 1, 1]

@@ -1,0 +1,128 @@
+"""Join edge cases: fast-path sentinels, NaN semantics, x64-off mode."""
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+
+def test_int32_max_keys(ctx8):
+    """Live keys equal to INT32_MAX canonicalize to the padding sentinel —
+    the probe's count correction must keep them exact."""
+    lmax = np.int32(2**31 - 1)
+    l = pd.DataFrame({"k": np.array([lmax, 0, 5, lmax, 7], np.int32),
+                      "x": np.arange(5.0)})
+    r = pd.DataFrame({"k": np.array([lmax, 5, lmax, lmax, 2], np.int32),
+                      "y": np.arange(5.0) * 10})
+    tl = ct.Table.from_pandas(ctx8, l)
+    tr = ct.Table.from_pandas(ctx8, r)
+    for how in ["inner", "left", "right", "outer"]:
+        got = tl.distributed_join(tr, on="k", how=how)
+        exp = l.merge(r, on="k", how=how)
+        assert got.row_count == len(exp), (how, got.row_count, len(exp))
+    # value check for inner
+    got = tl.distributed_join(tr, on="k", how="inner").to_pandas()
+    exp = l.merge(r, on="k", how="inner")
+    assert sorted(got["x"].tolist()) == sorted(exp["x"].tolist())
+    assert sorted(got["y"].tolist()) == sorted(exp["y"].tolist())
+
+
+def test_nan_keys_match_like_pandas(ctx8):
+    """pandas.merge matches NaN keys to NaN (and never to 0.0)."""
+    l = pd.DataFrame({"k": np.array([np.nan, 0.0, 1.5], np.float64),
+                      "x": [1.0, 2.0, 3.0]})
+    r = pd.DataFrame({"k": np.array([np.nan, 0.0, 2.5], np.float64),
+                      "y": [10.0, 20.0, 30.0]})
+    tl = ct.Table.from_pandas(ctx8, l)
+    tr = ct.Table.from_pandas(ctx8, r)
+    got = tl.distributed_join(tr, on="k", how="inner").to_pandas()
+    exp = l.merge(r, on="k", how="inner")
+    assert got.shape[0] == exp.shape[0]
+    assert sorted(got["x"].tolist()) == sorted(exp["x"].tolist())
+
+
+def test_multi_key_join(ctx8, rng):
+    l = pd.DataFrame({
+        "a": rng.integers(0, 5, 40),
+        "b": rng.integers(0, 4, 40),
+        "x": rng.normal(size=40),
+    })
+    r = pd.DataFrame({
+        "a": rng.integers(0, 5, 35),
+        "b": rng.integers(0, 4, 35),
+        "y": rng.normal(size=35),
+    })
+    tl = ct.Table.from_pandas(ctx8, l)
+    tr = ct.Table.from_pandas(ctx8, r)
+    for how in ["inner", "left", "outer"]:
+        got = tl.distributed_join(tr, on=["a", "b"], how=how)
+        exp = l.merge(r, on=["a", "b"], how=how)
+        assert got.row_count == len(exp), how
+
+
+def test_left_on_right_on(ctx8, rng):
+    l = pd.DataFrame({"ka": rng.integers(0, 10, 30), "x": rng.normal(size=30)})
+    r = pd.DataFrame({"kb": rng.integers(0, 10, 25), "y": rng.normal(size=25)})
+    tl = ct.Table.from_pandas(ctx8, l)
+    tr = ct.Table.from_pandas(ctx8, r)
+    got = tl.distributed_join(tr, left_on=["ka"], right_on=["kb"], how="inner")
+    exp = l.merge(r, left_on="ka", right_on="kb", how="inner")
+    assert got.row_count == len(exp)
+    assert got.column_names == ["ka", "x", "kb", "y"]
+
+
+NO_X64_SCRIPT = r"""
+import os
+os.environ["CYLON_TPU_NO_X64"] = "1"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, pandas as pd
+import cylon_tpu as ct
+rng = np.random.default_rng(0)
+l = pd.DataFrame({"k": rng.integers(0, 50, 300).astype(np.int32),
+                  "x": rng.normal(size=300).astype(np.float32)})
+r = pd.DataFrame({"k": rng.integers(0, 50, 200).astype(np.int32),
+                  "y": rng.normal(size=200).astype(np.float32)})
+ctx = ct.CylonContext.init_distributed(ct.TPUConfig())
+tl = ct.Table.from_pandas(ctx, l); tr = ct.Table.from_pandas(ctx, r)
+got = tl.distributed_join(tr, on="k", how="inner")
+exp = l.merge(r, on="k", how="inner")
+assert got.row_count == len(exp), (got.row_count, len(exp))
+gs = np.sort(got.to_pandas()["x"].to_numpy()); es = np.sort(exp["x"].to_numpy())
+assert np.allclose(gs, es)
+print("NO_X64_JOIN_OK", got.row_count)
+"""
+
+
+def test_join_without_x64():
+    """The benchmark config: x64 disabled, int32 keys — the fast path must
+    not rely on int64 existing (regression for the live-bit packing bug)."""
+    out = subprocess.run(
+        [sys.executable, "-c", NO_X64_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "NO_X64_JOIN_OK" in out.stdout
+
+
+def test_mixed_dtype_keys(ctx8):
+    """int32 vs uint32 keys must promote before canonicalization."""
+    l = pd.DataFrame({"k": np.array([1, 2, 3, 5], np.int32), "x": [1.0, 2.0, 3.0, 4.0]})
+    r = pd.DataFrame({"k": np.array([1, 2, 3, 4], np.uint32), "y": [1.0, 2.0, 3.0, 4.0]})
+    tl = ct.Table.from_pandas(ctx8, l)
+    tr = ct.Table.from_pandas(ctx8, r)
+    got = tl.distributed_join(tr, on="k", how="inner")
+    assert got.row_count == 3
+    # int32 min vs uint32 0 must NOT match
+    l2 = pd.DataFrame({"k": np.array([-(2**31)], np.int32), "x": [1.0]})
+    r2 = pd.DataFrame({"k": np.array([0], np.uint32), "y": [1.0]})
+    got2 = ct.Table.from_pandas(ctx8, l2).distributed_join(
+        ct.Table.from_pandas(ctx8, r2), on="k", how="inner"
+    )
+    assert got2.row_count == 0
