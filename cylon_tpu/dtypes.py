@@ -132,6 +132,33 @@ class DataType:
         return f"DataType({self.type.name})"
 
 
+def promote_key_dtypes(a, b):
+    """Common dtype for cross-dtype key comparison, by NUMPY promotion rules.
+
+    jnp.promote_types under x64-off silently narrows (int32 x uint32 ->
+    int32, wrapping uint32 2**31 onto -2**31); numpy's answer (int64) exposes
+    that the comparison genuinely needs 64 bits, which we then reject if x64
+    is disabled. Returns a numpy/jnp dtype safe to ``astype`` to."""
+    import jax
+
+    try:
+        common = np.promote_types(np.dtype(a), np.dtype(b))
+    except TypeError:
+        # bfloat16 & friends: fall back to jax rules (never produce 64-bit
+        # out of sub-32-bit inputs)
+        import jax.numpy as jnp
+
+        return jnp.promote_types(a, b)
+    if common.itemsize == 8 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            f"comparing {np.dtype(a)} keys with {np.dtype(b)} keys requires "
+            f"promotion to {common}, but 64-bit dtypes are disabled "
+            "(jax_enable_x64=False / CYLON_TPU_NO_X64). Cast the key columns "
+            "to a common 32-bit dtype first."
+        )
+    return common
+
+
 def bool_() -> DataType:
     return DataType(Type.BOOL)
 

@@ -91,6 +91,8 @@ class LocIndexer:
         else:
             scalar = np.isscalar(rows) or isinstance(rows, str)
             vals = [rows] if scalar else list(rows)
+            if len(vals) == 0:
+                return t.filter(jnp.zeros(col.data.shape, bool))
             enc = np.sort(_encode_values(col, vals))
             dev = jnp.asarray(enc)
             pos = jnp.searchsorted(dev, col.data)
@@ -126,6 +128,9 @@ class ILocIndexer:
         else:
             vals = np.asarray(list(rows), np.int64)
             vals = np.where(vals < 0, vals + n, vals)
+            if len(vals) == 0:
+                mask = jnp.zeros(gpos.shape, bool)
+                return t.filter(mask)
             if len(vals) > 1 and not (np.diff(vals) > 0).all():
                 # duplicates / reordering: pandas iloc repeats and reorders
                 # rows — fall back to the host gather path

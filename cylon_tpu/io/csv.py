@@ -4,17 +4,23 @@ Reference analog: io/arrow_io.cpp:33-61 (Arrow csv::TableReader over mmap),
 CSVReadOptions builder (io/csv_read_config.hpp), WriteCSV row-wise printer
 (table.cpp:244-253), and multi-file concurrent reads (table.cpp:791-829).
 
-Device data never round-trips through CSV parsing: pyarrow's multithreaded
-native reader produces host columns that are padded + device_put once.
+Primary path is the native C++ codec (cylon_tpu/native/csv.cpp: mmap +
+multithreaded tokenize + typed parse + dictionary-encoded strings) — host
+columns arrive already in the Table's physical encoding and are padded +
+device_put once. pyarrow is the fallback when the native lib can't build.
 """
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Any, Dict, List, Optional, Sequence, Union
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from .. import native
+from ..column import Column
 from ..context import CylonContext
+from ..dtypes import DataType, Type
 from ..table import Table
 
 
@@ -58,6 +64,88 @@ class CSVWriteOptions:
         return self
 
 
+# native ColType -> logical DataType
+_CT_TO_DTYPE = {
+    native.CT_INT64: DataType(Type.INT64),
+    native.CT_FLOAT64: DataType(Type.DOUBLE),
+    native.CT_BOOL: DataType(Type.BOOL),
+    native.CT_STRING: DataType(Type.STRING),
+}
+
+Encoded = Tuple[np.ndarray, Optional[np.ndarray], DataType, Optional[np.ndarray]]
+
+
+def _read_one_native(path: str, options: CSVReadOptions) -> "OrderedDict[str, Encoded]":
+    cols = native.read_csv(
+        path,
+        delimiter=options._delimiter,
+        skip_rows=options._skip_rows,
+        has_header=options._column_names is None,
+        num_threads=0 if options._use_threads else 1,
+    )
+    out: "OrderedDict[str, Encoded]" = OrderedDict()
+    for i, c in enumerate(cols):
+        name = (
+            options._column_names[i]
+            if options._column_names is not None and i < len(options._column_names)
+            else c.name
+        )
+        out[name] = (c.data, c.valid, _CT_TO_DTYPE[c.ctype], c.dictionary)
+    return out
+
+
+def _promote_shard_types(shards: List["OrderedDict[str, Encoded]"]) -> None:
+    """When per-file type inference disagrees for a column, promote every
+    file to a common logical type (numeric mix -> float64; any string ->
+    string, with numbers re-formatted). Without this, one file's dictionary
+    codes would concatenate against another file's integer values."""
+    if not shards:
+        return
+    for name in list(shards[0].keys()):
+        types = {s[name][2].type for s in shards}
+        if len(types) == 1:
+            continue
+        if Type.STRING in types:
+            for s in shards:
+                data, valid, dtype, _d = s[name]
+                if dtype.type == Type.STRING:
+                    continue
+                if dtype.type == Type.BOOL:
+                    vals = np.where(data.astype(bool), "true", "false")
+                elif dtype.type == Type.DOUBLE:
+                    vals = np.array([repr(float(x)) for x in data])
+                else:
+                    vals = np.array([str(int(x)) for x in data])
+                dic, codes = np.unique(np.asarray(vals, str), return_inverse=True)
+                s[name] = (codes.astype(np.int32), valid, DataType(Type.STRING), dic)
+        else:
+            for s in shards:
+                data, valid, dtype, _d = s[name]
+                if dtype.type == Type.DOUBLE:
+                    continue
+                s[name] = (data.astype(np.float64), valid, DataType(Type.DOUBLE), None)
+
+
+def _unify_shard_dicts(shards: List["OrderedDict[str, Encoded]"]) -> None:
+    """Remap per-file dictionary codes onto the union dictionary so string
+    columns from different shard files compare/hash consistently (the analog
+    of each rank's Arrow table sharing a schema)."""
+    if not shards:
+        return
+    for name in list(shards[0].keys()):
+        if not shards[0][name][2].is_dictionary:
+            continue
+        dicts = [s[name][3] for s in shards]
+        union = dicts[0]
+        for d in dicts[1:]:
+            union = np.union1d(union, d)
+        for s in shards:
+            data, valid, dtype, d = s[name]
+            remap = np.searchsorted(union, d).astype(np.int32)
+            codes = remap[data] if len(d) else data
+            s[name] = (codes, valid, dtype, union)
+
+
 def _read_one(path: str, options: CSVReadOptions) -> Dict[str, np.ndarray]:
     from pyarrow import csv as pacsv
 
@@ -90,6 +178,33 @@ def read_csv(
       multi-file read, table.cpp:791-829 — here a thread pool).
     """
     options = options or CSVReadOptions()
+    if native.available():
+        if isinstance(paths, (list, tuple)):
+            with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
+                shards = list(ex.map(lambda p: _read_one_native(p, options), paths))
+            _promote_shard_types(shards)
+            _unify_shard_dicts(shards)
+            names = list(shards[0].keys())
+            merged: "OrderedDict[str, Encoded]" = OrderedDict()
+            for n in names:
+                data = np.concatenate([s[n][0] for s in shards])
+                if any(s[n][1] is not None for s in shards):
+                    valid = np.concatenate(
+                        [
+                            s[n][1] if s[n][1] is not None else np.ones(len(s[n][0]), bool)
+                            for s in shards
+                        ]
+                    )
+                else:
+                    valid = None
+                merged[n] = (data, valid, shards[0][n][2], shards[0][n][3])
+            counts = (
+                np.array([len(next(iter(s.values()))[0]) for s in shards], np.int64)
+                if len(shards) == ctx.world_size
+                else None  # concat then re-split evenly
+            )
+            return Table.from_encoded(ctx, merged, counts=counts)
+        return Table.from_encoded(ctx, _read_one_native(paths, options))
     if isinstance(paths, (list, tuple)):
         with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
             shards = list(ex.map(lambda p: _read_one(p, options), paths))
@@ -107,6 +222,28 @@ def read_csv(
 def write_csv(
     table: Table, path: str, options: Optional[CSVWriteOptions] = None
 ) -> None:
-    """Reference WriteCSV (table.cpp:244-253)."""
+    """Reference WriteCSV (table.cpp:244-253). Uses the native buffered
+    row-wise writer (csv.cpp ct_csv_write) when available; temporal columns
+    (which need string formatting) fall back to pandas."""
     options = options or CSVWriteOptions()
+    if native.available():
+        names = table.column_names
+        cols = []
+        for name in names:
+            col = table.column(name)
+            t = col.dtype.type
+            data_np, valid_np = table._host_physical(name)
+            if col.dtype.is_dictionary:
+                cols.append((native.CT_STRING, data_np, valid_np, col.dictionary))
+            elif t == Type.BOOL:
+                cols.append((native.CT_BOOL, data_np, valid_np, None))
+            elif col.dtype.is_floating:
+                cols.append((native.CT_FLOAT64, data_np, valid_np, None))
+            elif col.dtype.is_numeric:
+                cols.append((native.CT_INT64, data_np, valid_np, None))
+            else:
+                break  # temporal -> pandas fallback
+        else:
+            native.write_csv(path, names, cols, delimiter=options._delimiter)
+            return
     table.to_pandas().to_csv(path, index=False, sep=options._delimiter)

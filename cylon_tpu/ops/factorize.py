@@ -62,7 +62,12 @@ def factorize_two(
     cap = cap_l + cap_r
     cat_cols: list[KeyCol] = []
     for (ld, lv), (rd, rv) in zip(l_cols, r_cols):
-        common = jnp.promote_types(ld.dtype, rd.dtype)
+        if ld.dtype == rd.dtype:
+            common = ld.dtype
+        else:
+            from ..dtypes import promote_key_dtypes
+
+            common = promote_key_dtypes(ld.dtype, rd.dtype)
         data = jnp.concatenate([ld.astype(common), rd.astype(common)])
         if lv is None and rv is None:
             valid = None

@@ -49,14 +49,35 @@ def _to_words(data: jax.Array) -> Tuple[jax.Array, ...]:
     if dt == jnp.bool_:
         return (data.astype(jnp.uint32),)
     if dt in (jnp.float32,):
-        data = jax.lax.bitcast_convert_type(data, jnp.uint32)
-        return (data,)
+        # canonicalize -0 -> +0 and NaN payloads so hash equality matches
+        # orderable_key equality (else equal keys partition to different shards)
+        data = jnp.where(data == 0, jnp.zeros_like(data), data)
+        w = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        w = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), w)
+        return (w,)
     if dt in (jnp.float64,):
-        bits = jax.lax.bitcast_convert_type(data, jnp.uint64)
-        return (bits.astype(jnp.uint32), (bits >> np.uint64(32)).astype(jnp.uint32))
+        # TPU can't bitcast f64 (x64-rewrite limitation): hash a double-float
+        # (hi, lo) f32 split instead. Equal doubles always produce equal
+        # words; doubles differing below ~2^-48 relative may collide, which
+        # only skews partition balance, never correctness.
+        x = jnp.where(data == 0, jnp.zeros_like(data), data)  # -0 -> +0
+        nanm = jnp.isnan(x)
+        hi = jnp.where(nanm, jnp.float32(jnp.nan), x.astype(jnp.float32))
+        lo = jnp.where(
+            nanm | jnp.isinf(hi),
+            jnp.float32(0),
+            (x - hi.astype(jnp.float64)).astype(jnp.float32),
+        )
+        return (
+            jax.lax.bitcast_convert_type(hi, jnp.uint32),
+            jax.lax.bitcast_convert_type(lo, jnp.uint32),
+        )
     if dt in (jnp.float16, jnp.bfloat16):
         data = data.astype(jnp.float32)
-        return (jax.lax.bitcast_convert_type(data, jnp.uint32),)
+        data = jnp.where(data == 0, jnp.zeros_like(data), data)
+        w = jax.lax.bitcast_convert_type(data, jnp.uint32)
+        w = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), w)
+        return (w,)
     itemsize = np.dtype(dt).itemsize
     if itemsize <= 4:
         # sign-extend to int32 then reinterpret, so that e.g. int8 -1 and

@@ -82,7 +82,9 @@ def _probe(
         and len(r_key_cols) == 1
         and l_key_cols[0][0].dtype != r_key_cols[0][0].dtype
     ):
-        common = jnp.promote_types(l_key_cols[0][0].dtype, r_key_cols[0][0].dtype)
+        from ..dtypes import promote_key_dtypes
+
+        common = promote_key_dtypes(l_key_cols[0][0].dtype, r_key_cols[0][0].dtype)
         l_key_cols = [(l_key_cols[0][0].astype(common), l_key_cols[0][1])]
         r_key_cols = [(r_key_cols[0][0].astype(common), r_key_cols[0][1])]
     if _fast_path_ok(l_key_cols) and _fast_path_ok(r_key_cols):

@@ -22,9 +22,16 @@ KeyCol = Tuple[jax.Array, Optional[jax.Array]]  # (data, valid-or-None)
 
 
 def orderable_key(data: jax.Array) -> jax.Array:
-    """Map a numeric column to an unsigned-integer lane where plain unsigned
-    ordering == value ordering, with total-order float semantics:
-    -inf < ... < -0 == +0 < ... < +inf < NaN (all NaNs equal).
+    """Map a numeric column to a canonical sort/equality lane.
+
+    For everything except float64 the lane is an unsigned integer where plain
+    unsigned ordering == value ordering (total-order float semantics for f32:
+    -inf < ... < -0 == +0 < ... < +inf < NaN, all NaNs equal). float64 keeps a
+    canonicalized *float* lane (-0 -> +0): the TPU X64-rewrite pass cannot
+    lower 64-bit ``bitcast_convert``, and XLA's float sort comparator is
+    already a total order with all NaNs greatest. Because the f64 lane is a
+    float, equality checks on lanes must go through :func:`lanes_differ`
+    (NaN-aware) rather than ``!=``.
 
     This is THE canonical key representation: every sort lane, run-detect
     equality, and join probe uses it, so NaN==NaN and -0.0==+0.0 behave
@@ -37,14 +44,10 @@ def orderable_key(data: jax.Array) -> jax.Array:
         if dt == jnp.float16 or dt == jnp.bfloat16:
             data = data.astype(jnp.float32)
             dt = jnp.dtype(jnp.float32)
-        # canonicalize: -0.0 -> +0.0, any NaN -> canonical quiet NaN
+        # canonicalize: -0.0 -> +0.0
         data = jnp.where(data == 0, jnp.zeros_like(data), data)
         if dt == jnp.float64:
-            b = jax.lax.bitcast_convert_type(data, jnp.uint64)
-            b = jnp.where(jnp.isnan(data), jnp.uint64(0x7FF8000000000000), b)
-            return jnp.where(
-                (b >> jnp.uint64(63)) == 0, b | jnp.uint64(1 << 63), ~b
-            )
+            return data
         b = jax.lax.bitcast_convert_type(data, jnp.uint32)
         b = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), b)
         return jnp.where((b >> np.uint32(31)) == 0, b | np.uint32(0x80000000), ~b)
@@ -52,24 +55,32 @@ def orderable_key(data: jax.Array) -> jax.Array:
         if np.dtype(dt).itemsize <= 4:
             return data.astype(jnp.uint32)
         return data.astype(jnp.uint64)
-    # signed integers: flip sign bit into unsigned order
+    # signed integers: flip the sign bit into unsigned order (64-bit path via
+    # wrapping convert — bit pattern preserved — since TPU can't bitcast x64)
     if np.dtype(dt).itemsize <= 4:
         return (
             jax.lax.bitcast_convert_type(data.astype(jnp.int32), jnp.uint32)
             ^ np.uint32(0x80000000)
         )
-    return (
-        jax.lax.bitcast_convert_type(data.astype(jnp.int64), jnp.uint64)
-        ^ jnp.uint64(1 << 63)
-    )
+    return data.astype(jnp.uint64) ^ (jnp.uint64(1) << jnp.uint64(63))
+
+
+def lanes_differ(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Elementwise lane inequality; NaN == NaN on float (f64) lanes."""
+    d = a != b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        d = d & ~(jnp.isnan(a) & jnp.isnan(b))
+    return d
 
 
 def _norm_key(data: jax.Array, ascending: bool) -> jax.Array:
-    """Normalize one key column into an unsigned lane where plain ascending
-    unsigned ordering matches the requested order (see orderable_key)."""
+    """Normalize one key column into a lane where plain ascending ordering
+    matches the requested order (see orderable_key)."""
     lane = orderable_key(data)
     if not ascending:
-        lane = ~lane
+        # float (f64) lane: negate; NaNs remain greatest under XLA's
+        # comparator so they sort last in either direction
+        lane = -lane if jnp.issubdtype(lane.dtype, jnp.floating) else ~lane
     return lane
 
 
@@ -128,7 +139,7 @@ def rows_differ(
     for data, valid in sorted_cols:
         lane = orderable_key(data)
         prev = jnp.roll(lane, 1)
-        d = lane != prev
+        d = lanes_differ(lane, prev)
         if valid is not None:
             vprev = jnp.roll(valid, 1)
             # null vs value differs; null vs null equal (value lane ignored)

@@ -110,21 +110,30 @@ class Table:
     # construction
     # ------------------------------------------------------------------
     @classmethod
-    def from_pydict(cls, ctx: CylonContext, data: Dict[str, Any]) -> "Table":
-        """Build a row-sharded table from host columnar data (dict of
-        name -> array-like). Mirrors pycylon ``Table.from_pydict``
-        (data/table.pyx:768-909)."""
-        arrays = {k: np.asarray(v) if not isinstance(v, np.ndarray) else v for k, v in data.items()}
-        n = len(next(iter(arrays.values()))) if arrays else 0
-        for k, v in arrays.items():
-            if len(v) != n:
-                raise ValueError("all columns must have equal length")
+    def from_encoded(
+        cls,
+        ctx: CylonContext,
+        encoded: Dict[str, Tuple[np.ndarray, Optional[np.ndarray], DataType, Optional[np.ndarray]]],
+        counts: Optional[np.ndarray] = None,
+    ) -> "Table":
+        """Build a table from already-encoded host columns
+        (physical data, valid, dtype, sorted dictionary) — the direct ingest
+        path for the native CSV codec. ``counts=None`` splits rows evenly;
+        otherwise row blocks of sizes ``counts[i]`` go to shard i."""
         world = ctx.world_size
-        counts, cap = shard_caps(n, world)
-        cols: "OrderedDict[str, Column]" = OrderedDict()
+        n = len(next(iter(encoded.values()))[0]) if encoded else 0
+        if counts is None:
+            counts, cap = shard_caps(n, world)
+        else:
+            counts = np.asarray(counts, np.int64)
+            if len(counts) != world or counts.sum() != n:
+                raise ValueError("bad shard counts")
+            cap = round_cap(int(counts.max()) if world else 0)
         offs = np.concatenate([[0], np.cumsum(counts)])
-        for name, values in arrays.items():
-            phys, valid, dtype, dictionary = Column.encode_host(np.asarray(values))
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for name, (phys, valid, dtype, dictionary) in encoded.items():
+            if len(phys) != n:
+                raise ValueError("all columns must have equal length")
             buf = np.zeros((world * cap,), dtype=phys.dtype)
             vbuf = np.ones((world * cap,), dtype=bool) if valid is not None else None
             for i in range(world):
@@ -136,6 +145,22 @@ class Table:
             valid_dev = jax.device_put(vbuf, ctx.sharding) if vbuf is not None else None
             cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
         return cls(ctx, cols, counts, cap)
+
+    @classmethod
+    def from_pydict(cls, ctx: CylonContext, data: Dict[str, Any]) -> "Table":
+        """Build a row-sharded table from host columnar data (dict of
+        name -> array-like). Mirrors pycylon ``Table.from_pydict``
+        (data/table.pyx:768-909)."""
+        arrays = {k: np.asarray(v) if not isinstance(v, np.ndarray) else v for k, v in data.items()}
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        for k, v in arrays.items():
+            if len(v) != n:
+                raise ValueError("all columns must have equal length")
+        encoded = OrderedDict(
+            (name, Column.encode_host(np.asarray(values)))
+            for name, values in arrays.items()
+        )
+        return cls.from_encoded(ctx, encoded)
 
     @classmethod
     def from_pandas(cls, ctx: CylonContext, df) -> "Table":
@@ -161,25 +186,12 @@ class Table:
             raise ValueError(f"need {world} shards, got {len(shards)}")
         names = list(shards[0].keys())
         counts = np.array([len(next(iter(s.values()))) if s else 0 for s in shards], np.int64)
-        cap = round_cap(int(counts.max()))
-        cols: "OrderedDict[str, Column]" = OrderedDict()
-        for name in names:
+        encoded = OrderedDict(
             # encode all shards together so dictionaries are global
-            concat = np.concatenate([np.asarray(s[name]) for s in shards])
-            phys, valid, dtype, dictionary = Column.encode_host(concat)
-            buf = np.zeros((world * cap,), dtype=phys.dtype)
-            vbuf = np.ones((world * cap,), dtype=bool) if valid is not None else None
-            off = 0
-            for i in range(world):
-                c = int(counts[i])
-                buf[i * cap : i * cap + c] = phys[off : off + c]
-                if vbuf is not None:
-                    vbuf[i * cap : i * cap + c] = valid[off : off + c]
-                off += c
-            data_dev = jax.device_put(buf, ctx.sharding)
-            valid_dev = jax.device_put(vbuf, ctx.sharding) if vbuf is not None else None
-            cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
-        return cls(ctx, cols, counts, cap)
+            (name, Column.encode_host(np.concatenate([np.asarray(s[name]) for s in shards])))
+            for name in names
+        )
+        return cls.from_encoded(ctx, encoded, counts=counts)
 
     def _replace(self, columns=None, row_counts=None, shard_cap=None) -> "Table":
         return Table(
@@ -193,7 +205,9 @@ class Table:
     # ------------------------------------------------------------------
     # host conversion
     # ------------------------------------------------------------------
-    def _host_column(self, name: str):
+    def _host_physical(self, name: str):
+        """Concatenated live rows of a column in physical encoding:
+        (data ndarray, valid ndarray | None)."""
         col = self._columns[name]
         world, cap = self.ctx.world_size, self._shard_cap
         data = np.asarray(col.data).reshape(world, cap)
@@ -206,7 +220,11 @@ class Table:
                 vparts.append(valid[i, :c])
         data_np = np.concatenate(parts) if parts else np.empty((0,), data.dtype)
         valid_np = np.concatenate(vparts) if valid is not None else None
-        return col.decode_host(data_np, valid_np)
+        return data_np, valid_np
+
+    def _host_column(self, name: str):
+        data_np, valid_np = self._host_physical(name)
+        return self._columns[name].decode_host(data_np, valid_np)
 
     def to_pydict(self) -> Dict[str, np.ndarray]:
         return {name: self._host_column(name) for name in self.column_names}
@@ -622,6 +640,10 @@ class Table:
             other, kwargs.get("on"), kwargs.get("left_on"), kwargs.get("right_on")
         )
         left, right = _unify_dict_pair(self, other, l_names, r_names)
+        # promote key dtype pairs BEFORE hashing: the shuffle hashes each side
+        # independently, and murmur words depend on the physical dtype — an
+        # int32 5 and int64 5 would otherwise land on different shards
+        left, right = _promote_key_pair(left, right, l_names, r_names)
         ls = left._shuffle_impl(kind="hash", key_names=l_names)
         rs = right._shuffle_impl(kind="hash", key_names=r_names)
         return ls.join(rs, **kwargs)
@@ -1148,6 +1170,37 @@ def _unify_dict_pair(
         union, map_a, map_b = unify_dictionaries(ca, cb)
         new_a[an] = _remap_codes(ca, map_a, union)
         new_b[bn] = _remap_codes(cb, map_b, union)
+        changed = True
+    if not changed:
+        return a, b
+    return a._replace(columns=new_a), b._replace(columns=new_b)
+
+
+def _promote_key_pair(
+    a: "Table", b: "Table", a_cols: Sequence[str], b_cols: Sequence[str]
+) -> Tuple["Table", "Table"]:
+    """Cast paired numeric key columns to their common promoted dtype so both
+    sides hash/compare identically (the reference instead *requires* matching
+    key types — arrow type validation; promotion here is a superset)."""
+    from .dtypes import promote_key_dtypes
+
+    new_a = OrderedDict(a._columns)
+    new_b = OrderedDict(b._columns)
+    changed = False
+    for an, bn in zip(a_cols, b_cols):
+        ca, cb = a._columns[an], b._columns[bn]
+        if ca.dtype.is_dictionary or cb.dtype.is_dictionary:
+            if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
+                raise ValueError(
+                    f"cannot join string key {an!r} with numeric key {bn!r}"
+                )
+            continue
+        if ca.data.dtype == cb.data.dtype:
+            continue
+        common = promote_key_dtypes(ca.data.dtype, cb.data.dtype)
+        dt = DataType.from_numpy_dtype(np.dtype(common))
+        new_a[an] = Column(ca.data.astype(common), dt, ca.valid, None)
+        new_b[bn] = Column(cb.data.astype(common), dt, cb.valid, None)
         changed = True
     if not changed:
         return a, b

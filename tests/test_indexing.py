@@ -111,3 +111,10 @@ def test_iloc_duplicates_and_order(ctx8, rng):
     df, t = _tbl(ctx8, rng)
     out = t.iloc[[3, 1, 1]].to_pandas()
     assert out["id"].tolist() == [3, 1, 1]
+
+
+def test_iloc_loc_empty_list(ctx8, rng):
+    t = ct.Table.from_pydict(ctx8, {"a": rng.integers(0, 10, 40), "b": rng.normal(size=40)})
+    assert t.iloc[[]].row_count == 0
+    ti = t.set_index("a")
+    assert ti.loc[[]].row_count == 0

@@ -126,3 +126,45 @@ def test_mixed_dtype_keys(ctx8):
         ct.Table.from_pandas(ctx8, r2), on="k", how="inner"
     )
     assert got2.row_count == 0
+
+
+def test_f32_zero_sign_distributed(ctx8):
+    """-0.0 and +0.0 float32 keys must match across the shuffle (hash lane
+    canonicalization, ops/hash.py f32 branch)."""
+    import pandas as pd
+
+    l = {"k": np.array([-0.0, 1.0], np.float32), "v": np.array([1, 2], np.int32)}
+    r = {"k": np.array([0.0, 2.0], np.float32), "w": np.array([3, 4], np.int32)}
+    lt = ct.Table.from_pydict(ctx8, l)
+    rt = ct.Table.from_pydict(ctx8, r)
+    out = lt.distributed_join(rt, on="k", how="inner")
+    expect = pd.DataFrame(l).merge(pd.DataFrame(r), on="k")
+    assert out.row_count == len(expect) == 1
+
+
+def test_mixed_width_int_keys_distributed(ctx8, rng):
+    """int32 vs int64 keys promote BEFORE the shuffle so equal values hash to
+    the same shard (table.py _promote_key_pair)."""
+    import pandas as pd
+
+    kl = rng.integers(0, 100, 300).astype(np.int32)
+    kr = rng.integers(0, 100, 200).astype(np.int64)
+    lt = ct.Table.from_pydict(ctx8, {"k": kl, "v": rng.normal(size=300)})
+    rt = ct.Table.from_pydict(ctx8, {"k": kr, "w": rng.normal(size=200)})
+    out = lt.distributed_join(rt, on="k", how="inner")
+    expect = pd.DataFrame({"k": kl.astype(np.int64)}).merge(
+        pd.DataFrame({"k": kr}), on="k"
+    )
+    assert out.row_count == len(expect)
+
+
+def test_mixed_sign_promotion_requires_x64(ctx8):
+    """int32 x uint32 promotes to int64; with x64 disabled that must raise
+    (silent wrap would fabricate matches, e.g. 2**31 == -2**31)."""
+    import jax
+
+    lt = ct.Table.from_pydict(ctx8, {"k": np.array([-(2**31)], np.int32)})
+    rt = ct.Table.from_pydict(ctx8, {"k": np.array([2**31], np.uint32)})
+    with jax.enable_x64(False):
+        with pytest.raises(ValueError, match="64-bit"):
+            lt.join(rt, on="k", how="inner")
