@@ -239,10 +239,11 @@ def write_csv(
                 cols.append((native.CT_BOOL, data_np, valid_np, None))
             elif col.dtype.is_floating:
                 cols.append((native.CT_FLOAT64, data_np, valid_np, None))
-            elif col.dtype.is_numeric:
+            elif col.dtype.is_numeric and data_np.dtype != np.uint64:
+                # uint64 values >= 2^63 don't fit the writer's int64 lane
                 cols.append((native.CT_INT64, data_np, valid_np, None))
             else:
-                break  # temporal -> pandas fallback
+                break  # temporal / uint64 -> pandas fallback
         else:
             native.write_csv(path, names, cols, delimiter=options._delimiter)
             return

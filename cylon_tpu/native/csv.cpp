@@ -663,8 +663,9 @@ int32_t ct_csv_write(const char* path, char delim, int64_t nrows, int32_t ncols,
         }
         case CT_FLOAT64: {
           auto v = static_cast<const double*>(data[c])[r];
-          int n = snprintf(tmp, sizeof(tmp), "%.17g", v);
-          buf.append(tmp, n);
+          // shortest round-trip form, matching what pandas/python repr emit
+          auto res = std::to_chars(tmp, tmp + sizeof(tmp), v);
+          buf.append(tmp, res.ptr - tmp);
           break;
         }
         case CT_BOOL:

@@ -152,6 +152,15 @@ def count_from_probe(cnt, r_cnt, nl, nr, how: int) -> jax.Array:
     return total.astype(jnp.int32)
 
 
+def count_overflow_check(cnt, r_cnt) -> jax.Array:
+    """float32 shadow of the inner-join total: the int32 count wraps silently
+    past 2^31 (e.g. 65536^2 matches on one key wraps to 0); the float32 sum
+    keeps the right magnitude, so ``shadow > 2^31`` (or a negative int32
+    total) detects the wrap. Outputs that large can't be allocated anyway —
+    callers raise."""
+    return jnp.sum(cnt.astype(jnp.float32))
+
+
 def emit_from_probe(
     lo, cnt, r_order, r_cnt, nl, nr, how: int, cap_out: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

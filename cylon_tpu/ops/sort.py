@@ -78,9 +78,17 @@ def _norm_key(data: jax.Array, ascending: bool) -> jax.Array:
     matches the requested order (see orderable_key)."""
     lane = orderable_key(data)
     if not ascending:
-        # float (f64) lane: negate; NaNs remain greatest under XLA's
-        # comparator so they sort last in either direction
-        lane = -lane if jnp.issubdtype(lane.dtype, jnp.floating) else ~lane
+        if jnp.issubdtype(lane.dtype, jnp.floating):
+            # f64 lane: negate; NaNs remain greatest under XLA's comparator
+            # so they sort last in either direction
+            lane = -lane
+        else:
+            lane = ~lane
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                # bit-inversion would send the canonical-NaN lane near the
+                # bottom; pin NaNs to the top so f32 matches the f64 rule
+                # (NaN last in either direction)
+                lane = jnp.where(jnp.isnan(data), np.uint32(0xFFFFFFFF), lane)
     return lane
 
 

@@ -40,6 +40,12 @@ from .parallel import shuffle as _sh
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
+# single-dispatch speculative join (see Table.join); CYLON_TPU_EXACT_JOIN=1
+# forces the exact two-phase count->emit path
+import os as _os
+
+_SPECULATIVE_JOIN = _os.environ.get("CYLON_TPU_EXACT_JOIN", "0") != "1"
+
 
 def _scalar(x) -> jax.Array:
     """Per-shard [1] arrays carry scalars through shard_map."""
@@ -280,6 +286,35 @@ class Table:
 
     def _out_counts(self, per_shard) -> np.ndarray:
         return np.asarray(per_shard).astype(np.int64)
+
+    def _compact(self, new_cap: int) -> "Table":
+        """Slice every column's physical buffer down to ``new_cap`` rows per
+        shard (all live rows must fit). One cheap jitted slice, no host sync."""
+        if new_cap >= self._shard_cap:
+            return self
+        flat = self._flat_cols()
+        key = ("compact", len(flat))
+
+        def build():
+            def kern(dp, rep):
+                (cols,) = dp
+                (dummy,) = rep
+                co = dummy.shape[0]
+                return [
+                    (d[:co], None if v is None else v[:co]) for d, v in cols
+                ]
+
+            return kern
+
+        out = get_kernel(self.ctx, key, build)(
+            (flat,), (jnp.zeros((new_cap,), jnp.int8),)
+        )
+        return self._rebuild_cols(
+            list(zip(self.column_names, self._columns.values())),
+            out,
+            self._row_counts,
+            new_cap,
+        )
 
     # ------------------------------------------------------------------
     # column-level ops (no shard_map needed: elementwise / global reduce)
@@ -582,6 +617,57 @@ class Table:
         rk_idx = tuple(right.column_names.index(n) for n in r_names)
         key = ("join", howi, lk_idx, rk_idx, len(lflat), len(rflat))
 
+        # Speculative single-dispatch path: fuse probe+count+emit into ONE
+        # program with a capacity-factor output (cap_l+cap_r covers every
+        # outer-join minimum and ~1-match-per-key workloads). One dispatch +
+        # one host sync instead of two of each — on a remote-attached TPU the
+        # per-dispatch latency dominates small joins. Overflow (exact count >
+        # speculative cap) falls back to the exact two-phase path below.
+        out_names = _suffix_names(left.column_names, right.column_names, suffixes)
+        src_cols = list(left._columns.values()) + list(right._columns.values())
+        cap_l = left.shard_cap
+        cap_r = right.shard_cap
+        if _SPECULATIVE_JOIN:
+            spec_cap = round_cap(cap_l + cap_r)
+
+            def build_spec():
+                def kern(dp, rep):
+                    (lk, rk, lcols, rcols, nl, nr) = dp
+                    (dummy,) = rep
+                    co = dummy.shape[0]
+                    cl = lk[0][0].shape[0]
+                    cr = rk[0][0].shape[0]
+                    lo, cnt, r_order, r_cnt = _j.probe_arrays(
+                        lk, rk, nl[0], nr[0], cl, cr
+                    )
+                    total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
+                    shadow = _j.count_overflow_check(cnt, r_cnt)
+                    li, ri, _ = _j.emit_from_probe(
+                        lo, cnt, r_order, r_cnt, nl[0], nr[0], howi, co
+                    )
+                    out = [_j.gather_column(d, v, li) for d, v in lcols]
+                    out += [_j.gather_column(d, v, ri) for d, v in rcols]
+                    return out, _scalar(total), _scalar(shadow)
+
+                return kern
+
+            out, totals, shadows = get_kernel(self.ctx, key + ("spec",), build_spec)(
+                (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
+                (jnp.zeros((spec_cap,), jnp.int8),),
+            )
+            totals = self._out_counts(totals)
+            _check_join_count(totals, np.asarray(shadows))
+            if totals.max() <= spec_cap:
+                res = self._rebuild_cols(
+                    list(zip(out_names, src_cols)), out, totals, spec_cap
+                )
+                # compact when the speculative cap overshot by >=2 buckets so
+                # downstream ops don't pay for dead padding
+                tight = round_cap(int(totals.max()))
+                if tight * 4 <= spec_cap:
+                    res = res._compact(tight)
+                return res
+
         # phase 1: probe (the sorts) — returns reusable probe state + count
         def build_probe():
             def kern(dp, rep):
@@ -592,14 +678,16 @@ class Table:
                     lk, rk, nl[0], nr[0], cap_l, cap_r
                 )
                 total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
-                return lo, cnt, r_order, r_cnt, _scalar(total)
+                shadow = _j.count_overflow_check(cnt, r_cnt)
+                return lo, cnt, r_order, r_cnt, _scalar(total), _scalar(shadow)
 
             return kern
 
-        lo, cnt, r_order, r_cnt, cnts = get_kernel(
+        lo, cnt, r_order, r_cnt, cnts, shadows = get_kernel(
             self.ctx, key + ("probe",), build_probe
         )((lflat_k, rflat_k, left.counts_dev, right.counts_dev), ())
         cnts = self._out_counts(cnts)
+        _check_join_count(cnts, np.asarray(shadows))
         cap_out = round_cap(int(cnts.max()))
 
         # phase 2: emit + gather, reusing the probe state (no re-sort)
@@ -623,8 +711,6 @@ class Table:
         )
         # output schema: left columns then right columns, suffix on collision
         # (reference join_utils.cpp:28-160 suffix renaming)
-        out_names = _suffix_names(left.column_names, right.column_names, suffixes)
-        src_cols = list(left._columns.values()) + list(right._columns.values())
         return self._rebuild_cols(
             list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
         )
@@ -1129,6 +1215,16 @@ class Table:
 # module-level helpers
 # ----------------------------------------------------------------------
 
+def _check_join_count(totals: np.ndarray, shadows: np.ndarray) -> None:
+    """Reject joins whose per-shard output count wrapped int32 (see
+    ops.join.count_overflow_check)."""
+    if (totals < 0).any() or (shadows > 2.0**31 - 1).any():
+        raise ValueError(
+            "join output exceeds 2^31 rows on at least one shard; "
+            "repartition the inputs (distributed_join) or reduce the skew"
+        )
+
+
 def _suffix_names(lnames, rnames, suffixes):
     overlap = set(lnames) & set(rnames)
     out = [n + suffixes[0] if n in overlap else n for n in lnames]
@@ -1160,6 +1256,10 @@ def _unify_dict_pair(
     changed = False
     for an, bn in zip(a_cols, b_cols):
         ca, cb = a._columns[an], b._columns[bn]
+        if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
+            # without this, dictionary CODES would compare against numeric
+            # VALUES (reference: arrow type validation rejects the pair)
+            raise ValueError(f"cannot join string key {an!r} with numeric key {bn!r}")
         if not (ca.dtype.is_dictionary and cb.dtype.is_dictionary):
             continue
         if ca.dictionary is cb.dictionary or (
@@ -1190,10 +1290,7 @@ def _promote_key_pair(
     for an, bn in zip(a_cols, b_cols):
         ca, cb = a._columns[an], b._columns[bn]
         if ca.dtype.is_dictionary or cb.dtype.is_dictionary:
-            if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
-                raise ValueError(
-                    f"cannot join string key {an!r} with numeric key {bn!r}"
-                )
+            # mixed string/numeric pairs are rejected by _unify_dict_pair
             continue
         if ca.data.dtype == cb.data.dtype:
             continue

@@ -118,3 +118,14 @@ def test_iloc_loc_empty_list(ctx8, rng):
     assert t.iloc[[]].row_count == 0
     ti = t.set_index("a")
     assert ti.loc[[]].row_count == 0
+
+
+def test_descending_nan_last_f32_and_f64(local_ctx):
+    """Unmasked NaNs sort LAST in descending order for both f32 and f64 keys
+    (ops/sort.py _norm_key NaN pinning)."""
+    vals = np.array([3.0, np.nan, 1.0, 2.0])
+    for dt in (np.float32, np.float64):
+        t = ct.Table.from_pydict(local_ctx, {"x": vals.astype(dt)})
+        out = np.asarray(t.sort("x", ascending=False).to_pandas()["x"])
+        assert np.isnan(out[-1]), (dt, out)
+        assert list(out[:3]) == [3.0, 2.0, 1.0], (dt, out)

@@ -168,3 +168,68 @@ def test_mixed_sign_promotion_requires_x64(ctx8):
     with jax.enable_x64(False):
         with pytest.raises(ValueError, match="64-bit"):
             lt.join(rt, on="k", how="inner")
+
+
+def test_speculative_overflow_falls_back(world_ctx, rng):
+    """Join output larger than the speculative cap (cap_l+cap_r): the
+    single-dispatch path must detect overflow and rerun the exact two-phase
+    count->emit (table.py Table.join speculative block)."""
+    import pandas as pd
+
+    # 64 rows per side, all the same key -> 4096 output rows >> 64+64
+    k = np.zeros(64, np.int32)
+    lt = ct.Table.from_pydict(world_ctx, {"k": k, "v": np.arange(64, dtype=np.int32)})
+    rt = ct.Table.from_pydict(world_ctx, {"k": k, "w": np.arange(64, dtype=np.int32)})
+    out = lt.join(rt, on="k", how="inner")
+    assert out.row_counts.sum() == sum(
+        int(n) * int(m) for n, m in zip(lt.row_counts, rt.row_counts)
+    )
+    dout = lt.distributed_join(rt, on="k", how="inner")
+    assert dout.row_counts.sum() == 64 * 64
+    expect = pd.DataFrame({"k": k, "v": np.arange(64)}).merge(
+        pd.DataFrame({"k": k, "w": np.arange(64)}), on="k"
+    )
+    got = (
+        dout.to_pandas()[["k_x", "v", "w"]]
+        .rename(columns={"k_x": "k"})
+        .sort_values(["v", "w"])
+        .reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(
+        got, expect.sort_values(["v", "w"]).reset_index(drop=True), check_dtype=False
+    )
+
+
+def test_join_compacts_tiny_output(ctx8, rng):
+    """A selective join output is compacted below the speculative cap."""
+    n = 3000
+    lt = ct.Table.from_pydict(
+        ctx8, {"k": np.arange(n, dtype=np.int32), "v": rng.normal(size=n)}
+    )
+    rt = ct.Table.from_pydict(
+        ctx8, {"k": np.array([7], np.int32), "w": np.array([1.0], np.float32)}
+    )
+    out = lt.distributed_join(rt, on="k", how="inner")
+    assert out.row_count == 1
+    assert out.shard_cap <= 64  # not the speculative cap_l+cap_r
+
+
+def test_local_string_vs_numeric_key_raises(local_ctx):
+    """Mixed string/numeric key pairs are rejected in the LOCAL join too —
+    otherwise dictionary codes would compare against numeric values
+    (table.py _unify_dict_pair guard)."""
+    lt = ct.Table.from_pydict(local_ctx, {"k": ["a", "b", "c"]})
+    rt = ct.Table.from_pydict(local_ctx, {"k": np.array([0, 1, 9], np.int32)})
+    with pytest.raises(ValueError, match="string key"):
+        lt.join(rt, on="k", how="inner")
+
+
+def test_join_count_int32_wrap_raises(local_ctx):
+    """65536 x 65536 rows on one key = 2^32 matches: the int32 count wraps to
+    0, the float32 shadow catches it (ops/join.py count_overflow_check)."""
+    n = 65536
+    k = np.zeros(n, np.int32)
+    lt = ct.Table.from_pydict(local_ctx, {"k": k})
+    rt = ct.Table.from_pydict(local_ctx, {"k": k})
+    with pytest.raises(ValueError, match="2\\^31"):
+        lt.join(rt, on="k", how="inner")
