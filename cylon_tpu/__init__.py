@@ -38,12 +38,28 @@ from .io import (  # noqa: E402
 from .frame import CylonEnv, DataFrame  # noqa: E402
 from .frame import concat as concat_frames  # noqa: E402
 from .table import Table, concat, merge  # noqa: E402
+from . import compute  # noqa: E402
+from .series import Series  # noqa: E402
+from .indexing.index import (  # noqa: E402
+    CategoricalIndex,
+    Index,
+    IntegerIndex,
+    NumericIndex,
+    PyRangeIndex,
+)
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "CategoricalIndex",
     "Column",
     "CommConfig",
+    "Index",
+    "IntegerIndex",
+    "NumericIndex",
+    "PyRangeIndex",
+    "Series",
+    "compute",
     "CommType",
     "CPUConfig",
     "CSVReadOptions",

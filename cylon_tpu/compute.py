@@ -1,0 +1,299 @@
+"""Elementwise / columnar compute on device-resident tables.
+
+Reference analog: the pycylon compute layer (python/pycylon/data/compute.pyx:
+table_compare_op :198, is_null :210, invert :226, neg :246, math_op :441,
+division_op :267, unique :454, nunique :463, is_in :688, drop_na :714,
+infer_map :792). There each op loops per-element via numpy/arrow on the host;
+here every op is a jitted elementwise XLA computation over the sharded column
+buffers — sharding propagates, nothing moves off device, and XLA fuses chains
+of these ops into single kernels.
+
+Null semantics (Arrow-style): null propagates through comparisons and math
+(result null if any operand null); ``is_null``/``notnull`` read the validity
+mask itself.
+"""
+from __future__ import annotations
+
+import operator
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .column import Column
+from .dtypes import DataType, Type
+from .table import Table
+
+__all__ = [
+    "table_compare_op", "is_null", "not_null", "invert", "neg", "abs_",
+    "math_op", "division_op", "unique", "nunique", "is_in", "drop_na",
+    "map_columns",
+]
+
+_BOOL = DataType(Type.BOOL)
+
+
+def _and_masks(*masks: Optional[jax.Array]) -> Optional[jax.Array]:
+    out = None
+    for m in masks:
+        if m is None:
+            continue
+        out = m if out is None else (out & m)
+    return out
+
+
+def _dict_scalar_compare(col: Column, value: str, op: Callable) -> jax.Array:
+    """Compare a dictionary-encoded column against a scalar string by
+    comparing CODES: the dictionary is kept sorted (column.py encode_host), so
+    ``code < pos(value)`` etc. is order-equivalent to the string comparison."""
+    d = col.dictionary
+    pos = int(np.searchsorted(d, value))
+    present = pos < len(d) and d[pos] == value
+    c = col.data
+    if op is operator.eq:
+        return (c == pos) if present else jnp.zeros(c.shape, bool)
+    if op is operator.ne:
+        return (c != pos) if present else jnp.ones(c.shape, bool)
+    # ordering ops work off the insertion position whether or not the value
+    # is present: codes < pos are strictly smaller strings
+    if op is operator.lt:
+        return c < pos
+    if op is operator.ge:
+        return c >= pos
+    if op is operator.le:
+        return (c <= pos) if present else (c < pos)
+    if op is operator.gt:
+        return (c > pos) if present else (c >= pos)
+    raise ValueError(f"unsupported dictionary comparison {op}")
+
+
+def _pair_columns(table: Table, other: Table):
+    """Positionally pair columns of two equal-width tables."""
+    if table.column_count != other.column_count:
+        raise ValueError("tables must have the same number of columns")
+    return zip(table._columns.items(), other._columns.values())
+
+
+def table_compare_op(table: Table, other: Any, op: Callable) -> Table:
+    """Elementwise comparison -> boolean table (reference table_compare_op,
+    compute.pyx:198; engine kwarg dropped — there is one engine, XLA)."""
+    new = OrderedDict()
+    if isinstance(other, Table):
+        from .table import _unify_dict_pair
+
+        if table.column_count != other.column_count:
+            raise ValueError("tables must have the same number of columns")
+        other_names = list(other.column_names)
+        for (name, c), oname in zip(table._columns.items(), other_names):
+            oc = other._columns[oname]
+            if c.dtype.is_dictionary != oc.dtype.is_dictionary:
+                raise ValueError(f"cannot compare string and numeric column {name!r}")
+            if c.dtype.is_dictionary:
+                # remap both code spaces onto the union dictionary first
+                a, b = _unify_dict_pair(
+                    table.project([name]), other.project([oname]), [name], [oname]
+                )
+                c, oc = a._columns[name], b._columns[oname]
+            data = op(c.data, oc.data)
+            new[name] = Column(data, _BOOL, _and_masks(c.valid, oc.valid))
+        return table._replace(columns=new)
+    for name, c in table._columns.items():
+        if c.dtype.is_dictionary:
+            if not isinstance(other, str):
+                raise ValueError(f"cannot compare string column {name!r} with {type(other)}")
+            data = _dict_scalar_compare(c, other, op)
+        else:
+            data = op(c.data, other)
+        new[name] = Column(data, _BOOL, c.valid)
+    return table._replace(columns=new)
+
+
+def is_null(table: Table) -> Table:
+    """Boolean table marking nulls (reference is_null, compute.pyx:210)."""
+    return table.isnull()
+
+
+def not_null(table: Table) -> Table:
+    return table.notnull()
+
+
+def invert(table: Table) -> Table:
+    """Elementwise NOT on boolean columns (reference invert, compute.pyx:226)."""
+    new = OrderedDict()
+    for name, c in table._columns.items():
+        if c.data.dtype != jnp.bool_:
+            raise ValueError(f"invert expects boolean columns, got {c.dtype}")
+        new[name] = Column(~c.data, _BOOL, c.valid)
+    return table._replace(columns=new)
+
+
+def neg(table: Table) -> Table:
+    """Elementwise negation (reference neg, compute.pyx:246)."""
+    return map_columns(table, jnp.negative)
+
+
+def abs_(table: Table) -> Table:
+    return map_columns(table, jnp.abs)
+
+
+_MATH_OPS: Dict[str, Callable] = {
+    "add": operator.add, "+": operator.add,
+    "sub": operator.sub, "subtract": operator.sub, "-": operator.sub,
+    "mul": operator.mul, "multiply": operator.mul, "*": operator.mul,
+    "div": operator.truediv, "divide": operator.truediv, "/": operator.truediv,
+    "floordiv": operator.floordiv, "//": operator.floordiv,
+    "mod": operator.mod, "%": operator.mod,
+    "pow": operator.pow, "**": operator.pow,
+}
+
+
+def math_op(table: Table, op: Union[str, Callable], value: Any) -> Table:
+    """Elementwise arithmetic against a scalar or an equal-width table
+    (reference math_op, compute.pyx:441 + division_op :267)."""
+    fn = _MATH_OPS[op] if isinstance(op, str) else op
+    new = OrderedDict()
+    if isinstance(value, Table):
+        for (name, c), oc in _pair_columns(table, value):
+            if c.dtype.is_dictionary or oc.dtype.is_dictionary:
+                raise ValueError(f"arithmetic is not defined on string column {name!r}")
+            data = fn(c.data, oc.data)
+            new[name] = Column(
+                data, DataType.from_numpy_dtype(np.dtype(data.dtype)),
+                _and_masks(c.valid, oc.valid),
+            )
+        return table._replace(columns=new)
+    for name, c in table._columns.items():
+        if c.dtype.is_dictionary:
+            raise ValueError(f"arithmetic is not defined on string column {name!r}")
+        data = fn(c.data, value)
+        new[name] = Column(
+            data, DataType.from_numpy_dtype(np.dtype(data.dtype)), c.valid
+        )
+    return table._replace(columns=new)
+
+
+def division_op(table: Table, op: str, value: Any) -> Table:
+    """Reference division_op (compute.pyx:267): truediv/floordiv/mod with a
+    zero-divisor guard."""
+    if (
+        np.isscalar(value)
+        and not isinstance(value, str)
+        and value == 0
+        and op in ("/", "div", "divide", "//", "floordiv", "%", "mod")
+    ):
+        raise ZeroDivisionError("division by zero")
+    return math_op(table, op, value)
+
+
+def map_columns(table: Table, fn: Callable[[jax.Array], jax.Array]) -> Table:
+    """Apply a jax-traceable elementwise function to every (numeric) column —
+    the XLA-native analog of the reference's row-wise infer_map
+    (compute.pyx:792), which calls a Python lambda per element."""
+    new = OrderedDict()
+    for name, c in table._columns.items():
+        if c.dtype.is_dictionary:
+            raise ValueError(f"map is not defined on string column {name!r}")
+        data = fn(c.data)
+        new[name] = Column(
+            data, DataType.from_numpy_dtype(np.dtype(data.dtype)), c.valid
+        )
+    return table._replace(columns=new)
+
+
+def unique(table: Table) -> Table:
+    """Distinct rows (reference compute.pyx:454 -> Table.Unique)."""
+    return table.unique()
+
+
+def nunique(table: Table) -> Dict[str, int]:
+    """Per-column distinct count over live rows (reference compute.pyx:463).
+    One sort-based unique pass per column; nulls are excluded like pandas'
+    default ``nunique(dropna=True)``."""
+    out = {}
+    for name in table.column_names:
+        sub = table.project([name])
+        col = sub._columns[name]
+        if col.valid is not None:
+            sub = sub.filter(Column(col.valid, _BOOL))
+        out[name] = int(sub.unique().row_count)
+    return out
+
+
+def _probe_targets(values, col_dtype: np.dtype) -> np.ndarray:
+    """Deduplicate + convert host values into a sorted probe array in the
+    COLUMN's domain. Integer columns probe in the integer domain (no lossy
+    float round-trip); values not exactly representable in the column dtype
+    can never match and are dropped."""
+    nums = [v for v in values if not isinstance(v, str) and v is not None]
+    if col_dtype.kind in "iu":
+        kept = []
+        info = np.iinfo(col_dtype)
+        for v in nums:
+            if isinstance(v, (int, np.integer)) or (
+                isinstance(v, bool) is False and float(v).is_integer()
+            ):
+                # exact ints stay ints; floats only pass if integral
+                iv = int(v)
+                if info.min <= iv <= info.max:
+                    kept.append(iv)
+        return np.sort(np.array(kept, col_dtype))
+    return np.sort(np.array([float(v) for v in nums], col_dtype))
+
+
+def is_in(
+    table: Table, values: Sequence, skip_null: bool = True
+) -> Table:
+    """Membership test against a host-side value list (reference is_in,
+    compute.pyx:688). Values are staged to device once; the test is a sorted
+    searchsorted probe (vectorized, no per-element Python)."""
+    new = OrderedDict()
+    vals = list(values)
+    str_vals = np.array(
+        sorted(str(v) for v in vals if isinstance(v, str)), dtype=object
+    )
+    for name, c in table._columns.items():
+        if c.dtype.is_dictionary:
+            # object-dtype probe: fixed-width string casts would truncate
+            member = np.isin(c.dictionary.astype(object), str_vals)
+            data = jnp.asarray(member)[jnp.clip(c.data, 0, len(c.dictionary) - 1)]
+        else:
+            tgt_h = _probe_targets(vals, np.dtype(c.data.dtype))
+            if len(tgt_h) == 0:
+                data = jnp.zeros(c.data.shape, bool)
+            else:
+                tgt = jnp.asarray(tgt_h)
+                pos = jnp.clip(jnp.searchsorted(tgt, c.data), 0, len(tgt_h) - 1)
+                data = tgt[pos] == c.data
+        mask = c.valid
+        if mask is not None and skip_null:
+            data = data & mask
+            mask = None  # null -> False, not null
+        new[name] = Column(data, _BOOL, mask)
+    return table._replace(columns=new)
+
+
+def drop_na(table: Table, how: str = "any", axis: int = 0) -> Table:
+    """Drop rows (axis=0) or columns (axis=1) containing nulls (reference
+    drop_na, compute.pyx:714)."""
+    if how not in ("any", "all"):
+        raise ValueError("how must be 'any' or 'all'")
+    if axis == 0:
+        masks = [c.valid_mask() for c in table._columns.values()]
+        stacked = jnp.stack(masks, axis=0)
+        keep = jnp.all(stacked, axis=0) if how == "any" else jnp.any(stacked, axis=0)
+        return table.filter(keep)
+    if axis == 1:
+        # column decision needs per-column null counts over LIVE rows
+        live = table._live_mask()
+        drop = []
+        for name, c in table._columns.items():
+            if c.valid is None:
+                continue
+            n_null = int(jnp.sum(~c.valid & live))
+            n_live = int(table.row_count)
+            if (how == "any" and n_null > 0) or (how == "all" and n_null == n_live):
+                drop.append(name)
+        return table.drop(drop) if drop else table
+    raise ValueError("axis must be 0 or 1")

@@ -65,3 +65,68 @@ class ColumnIndex(BaseIndex):
 
     def __repr__(self):
         return f"ColumnIndex({self._name!r})"
+
+
+# --- python-facing index hierarchy (reference python/pycylon/index.py:26-126:
+# Index / NumericIndex / IntegerIndex / RangeIndex(start,stop,step) /
+# CategoricalIndex / ColumnIndex). These wrap host-side index VALUES the way
+# the reference's python layer does; the device-side row addressing above is
+# what the kernels use. ---------------------------------------------------
+
+class Index:
+    def __init__(self, data=None):
+        self._values = None if data is None else np.asarray(data)
+
+    @property
+    def index(self):
+        return self._values
+
+    @property
+    def index_values(self):
+        return self._values
+
+    def __len__(self):
+        return 0 if self._values is None else len(self._values)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._values!r})"
+
+
+class NumericIndex(Index):
+    def __init__(self, data=None):
+        super().__init__(data)
+        if self._values is not None and self._values.dtype.kind not in "iuf":
+            raise ValueError("NumericIndex requires numeric values")
+
+
+class IntegerIndex(NumericIndex):
+    def __init__(self, data=None):
+        super().__init__(data)
+        if self._values is not None and self._values.dtype.kind not in "iu":
+            raise ValueError("IntegerIndex requires integer values")
+
+
+class PyRangeIndex(IntegerIndex):
+    """start/stop/step range (reference index.py:66-108). Named PyRangeIndex
+    to keep it distinct from the device-side :class:`RangeIndex` (implicit
+    positions) that Table uses internally."""
+
+    def __init__(self, data=None, start: int = 0, stop: int = 0, step: int = 1):
+        if data is not None:
+            r = np.asarray(data, dtype=np.int64)
+            step_ = int(r[1] - r[0]) if len(r) >= 2 else 1
+            if step_ == 0 or (len(r) >= 2 and (np.diff(r) != step_).any()):
+                raise ValueError("PyRangeIndex data must be an arithmetic range")
+            super().__init__(r)
+            self.start = int(r[0]) if len(r) else 0
+            self.step = step_
+            self.stop = self.start + step_ * len(r)
+        else:
+            step = step or 1
+            super().__init__(np.arange(start, stop, step, dtype=np.int64))
+            self.start, self.stop, self.step = start, stop, step
+
+
+class CategoricalIndex(Index):
+    def __init__(self, data=None):
+        super().__init__(None if data is None else np.asarray(data, dtype=object))

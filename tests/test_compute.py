@@ -1,0 +1,196 @@
+"""compute module + Series: elementwise ops vs the pandas oracle.
+
+Reference analog: python/test/test_compute.py over data/compute.pyx.
+"""
+import operator
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import compute as cc
+
+
+@pytest.fixture
+def tbl(local_ctx, rng):
+    df = pd.DataFrame({
+        "a": rng.integers(0, 10, 50).astype(np.int64),
+        "b": rng.normal(size=50),
+    })
+    df.loc[3, "b"] = np.nan
+    return ct.Table.from_pandas(local_ctx, df), df
+
+
+def test_compare_scalar(tbl):
+    t, df = tbl
+    for op, pop in [(operator.gt, "gt"), (operator.le, "le"), (operator.eq, "eq")]:
+        out = cc.table_compare_op(t.project(["a"]), 5, op).to_pandas()["a"]
+        exp = getattr(df["a"], pop)(5)
+        assert (out.to_numpy() == exp.to_numpy()).all()
+
+
+def test_compare_table(tbl, local_ctx):
+    t, df = tbl
+    other = ct.Table.from_pandas(local_ctx, pd.DataFrame({"a2": df["a"].to_numpy()[::-1].copy()}))
+    out = cc.table_compare_op(t.project(["a"]), other, operator.lt).to_pandas()["a"]
+    exp = df["a"].to_numpy() < df["a"].to_numpy()[::-1]
+    assert (out.to_numpy() == exp).all()
+
+
+def test_string_scalar_compare(local_ctx):
+    t = ct.Table.from_pydict(local_ctx, {"s": ["b", "a", "c", "b"]})
+    eq = cc.table_compare_op(t, "b", operator.eq).to_pandas()["s"]
+    assert eq.tolist() == [True, False, False, True]
+    lt = cc.table_compare_op(t, "b", operator.lt).to_pandas()["s"]
+    assert lt.tolist() == [False, True, False, False]
+    # absent value: ordering still works off insertion position
+    ge = cc.table_compare_op(t, "ab", operator.ge).to_pandas()["s"]
+    assert ge.tolist() == [True, False, True, True]
+
+
+def test_math_scalar_and_table(tbl, local_ctx):
+    t, df = tbl
+    out = cc.math_op(t.project(["b"]), "mul", 2.5).to_pandas()["b"]
+    exp = df["b"] * 2.5
+    assert np.allclose(out.to_numpy(), exp.to_numpy(), equal_nan=True)
+    other = ct.Table.from_pandas(local_ctx, pd.DataFrame({"c": np.arange(50) + 1.0}))
+    out2 = cc.math_op(t.project(["b"]), "div", other).to_pandas()["b"]
+    exp2 = df["b"] / (np.arange(50) + 1.0)
+    assert np.allclose(out2.to_numpy(), exp2.to_numpy(), equal_nan=True)
+
+
+def test_division_by_zero_guard(tbl):
+    t, _ = tbl
+    with pytest.raises(ZeroDivisionError):
+        cc.division_op(t.project(["a"]), "/", 0)
+
+
+def test_neg_invert_isnull(tbl):
+    t, df = tbl
+    out = cc.neg(t.project(["a"])).to_pandas()["a"]
+    assert (out.to_numpy() == -df["a"].to_numpy()).all()
+    b = cc.table_compare_op(t.project(["a"]), 5, operator.lt)
+    inv = cc.invert(b).to_pandas()["a"]
+    assert (inv.to_numpy() == ~(df["a"] < 5).to_numpy()).all()
+    nulls = cc.is_null(t).to_pandas()
+    assert nulls["b"].sum() == 1 and not nulls["a"].any()
+
+
+def test_is_in(tbl, local_ctx):
+    t, df = tbl
+    out = cc.is_in(t.project(["a"]), [1, 3, 7]).to_pandas()["a"]
+    assert (out.to_numpy() == df["a"].isin([1, 3, 7]).to_numpy()).all()
+    ts = ct.Table.from_pydict(local_ctx, {"s": ["x", "y", "z"]})
+    outs = cc.is_in(ts, ["y", "q"]).to_pandas()["s"]
+    assert outs.tolist() == [False, True, False]
+
+
+def test_is_in_null_is_false(local_ctx):
+    t = ct.Table.from_pydict(local_ctx, {"v": np.array([1.0, np.nan, 3.0])})
+    out = cc.is_in(t, [1.0, 3.0]).to_pandas()["v"]
+    assert out.tolist() == [True, False, True]
+
+
+def test_drop_na(local_ctx):
+    df = pd.DataFrame({"x": [1.0, np.nan, 3.0], "y": [np.nan, np.nan, 1.0]})
+    t = ct.Table.from_pandas(local_ctx, df)
+    assert cc.drop_na(t, "any", axis=0).row_count == 1
+    assert cc.drop_na(t, "all", axis=0).row_count == 2
+    assert cc.drop_na(t, "any", axis=1).column_names == []
+    t2 = ct.Table.from_pandas(local_ctx, pd.DataFrame({"x": [1.0, 2.0], "y": [np.nan, np.nan]}))
+    assert cc.drop_na(t2, "all", axis=1).column_names == ["x"]
+
+
+def test_nunique_and_unique(tbl):
+    t, df = tbl
+    nu = cc.nunique(t)
+    assert nu["a"] == df["a"].nunique()
+    assert nu["b"] == df["b"].nunique()
+
+
+def test_map_columns(tbl):
+    import jax.numpy as jnp
+
+    t, df = tbl
+    out = cc.map_columns(t.project(["b"]), jnp.exp).to_pandas()["b"]
+    assert np.allclose(out.to_numpy(), np.exp(df["b"].to_numpy()), equal_nan=True)
+
+
+# ---------------------------------------------------------------- Series
+
+def test_series_basic(local_ctx):
+    s = ct.Series([3, 1, 2], name="v", ctx=local_ctx)
+    assert s.name == "v" and s.shape == (3,) and len(s) == 3
+    assert s.sum() == 6 and s.min() == 1 and s.max() == 3
+    assert s.sort_values().to_numpy().tolist() == [1, 2, 3]
+    assert s.sort_values(ascending=False).to_numpy().tolist() == [3, 2, 1]
+
+
+def test_series_ops(local_ctx):
+    s = ct.Series(np.array([1.0, 2.0, 3.0]), name="v", ctx=local_ctx)
+    assert ((s + 1).to_numpy() == np.array([2.0, 3.0, 4.0])).all()
+    assert ((s * s).to_numpy() == np.array([1.0, 4.0, 9.0])).all()
+    m = s > 1.5
+    assert m.to_numpy().tolist() == [False, True, True]
+    assert s[m].to_numpy().tolist() == [2.0, 3.0]
+    assert (-s).to_numpy().tolist() == [-1.0, -2.0, -3.0]
+
+
+def test_series_null_handling(local_ctx):
+    s = ct.Series(np.array([1.0, np.nan, 3.0]), name="v", ctx=local_ctx)
+    assert s.count() == 2
+    assert s.isnull().to_numpy().tolist() == [False, True, False]
+    assert s.fillna(0.0).to_numpy().tolist() == [1.0, 0.0, 3.0]
+    assert s.nunique() == 2
+
+
+def test_series_isin_astype(local_ctx):
+    s = ct.Series(np.array([1, 2, 3], np.int64), name="v", ctx=local_ctx)
+    assert s.isin([2, 9]).to_numpy().tolist() == [False, True, False]
+    assert s.astype(np.float32).to_numpy().dtype == np.float32
+
+
+def test_is_in_no_string_truncation(local_ctx):
+    """Probe strings longer than the dictionary's width must not truncate
+    (compute.py is_in object-dtype probe)."""
+    t = ct.Table.from_pydict(local_ctx, {"s": ["x", "y", "z"]})
+    out = cc.is_in(t, ["xy"]).to_pandas()["s"]
+    assert out.tolist() == [False, False, False]
+
+
+def test_is_in_integer_domain_exact(local_ctx):
+    """Integer membership stays in the integer domain: 2^53+1 and 2^53 are
+    distinct (a float64 round-trip would collapse them)."""
+    big = 2**53
+    t = ct.Table.from_pydict(local_ctx, {"v": np.array([big, big + 1], np.int64)})
+    out = cc.is_in(t, [big + 1]).to_pandas()["v"]
+    assert out.tolist() == [False, True]
+    # float values that are integral still match integer columns
+    t2 = ct.Table.from_pydict(local_ctx, {"v": np.array([1, 2, 3], np.int32)})
+    assert cc.is_in(t2, [2.0]).to_pandas()["v"].tolist() == [False, True, False]
+    # non-integral float can never match an int column
+    assert cc.is_in(t2, [2.5]).to_pandas()["v"].tolist() == [False, False, False]
+
+
+def test_compare_table_width_mismatch(tbl, local_ctx):
+    other = ct.Table.from_pydict(local_ctx, {"z": np.arange(50)})
+    with pytest.raises(ValueError, match="same number"):
+        cc.table_compare_op(tbl[0], other, operator.lt)
+
+
+def test_division_numpy_zero_guard(tbl):
+    with pytest.raises(ZeroDivisionError):
+        cc.division_op(tbl[0].project(["a"]), "/", np.int64(0))
+
+
+def test_pyrange_index():
+    r = ct.PyRangeIndex(start=0, stop=10, step=2)
+    assert r.index_values.tolist() == [0, 2, 4, 6, 8]
+    r2 = ct.PyRangeIndex(data=np.arange(0, 10, 2))
+    assert (r2.start, r2.stop, r2.step) == (0, 10, 2)
+    with pytest.raises(ValueError):
+        ct.PyRangeIndex(data=np.array([1, 2, 4]))
+    assert ct.IntegerIndex(np.array([1, 2])).index_values.tolist() == [1, 2]
+    with pytest.raises(ValueError):
+        ct.IntegerIndex(np.array([1.5]))
