@@ -217,7 +217,10 @@ def nunique(table: Table) -> Dict[str, int]:
         col = sub._columns[name]
         if col.valid is not None:
             sub = sub.filter(Column(col.valid, _BOOL))
-        out[name] = int(sub.unique().row_count)
+        # per-shard unique undercounts nothing but OVERcounts values present
+        # on several shards; dedup across the mesh first
+        uniq = sub.distributed_unique() if sub.world_size > 1 else sub.unique()
+        out[name] = int(uniq.row_count)
     return out
 
 

@@ -113,7 +113,10 @@ class PyRangeIndex(IntegerIndex):
 
     def __init__(self, data=None, start: int = 0, stop: int = 0, step: int = 1):
         if data is not None:
-            r = np.asarray(data, dtype=np.int64)
+            raw = np.asarray(data)
+            if raw.dtype.kind not in "iu":
+                raise ValueError("PyRangeIndex data must be integers")
+            r = raw.astype(np.int64)
             step_ = int(r[1] - r[0]) if len(r) >= 2 else 1
             if step_ == 0 or (len(r) >= 2 and (np.diff(r) != step_).any()):
                 raise ValueError("PyRangeIndex data must be an arithmetic range")

@@ -51,6 +51,24 @@ def group_ids(
     return factorize(key_cols, n, cap)
 
 
+def sorted_group_ids(
+    key_cols: Sequence[KeyCol], n: jax.Array, cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Group ids for input ALREADY sorted by the key columns: a single
+    run-detection pass, no lexsort (reference PipelineGroupBy,
+    groupby/pipeline_groupby.cpp:30-90 — run detection + per-run aggregates
+    over sorted input). Same contract as :func:`group_ids`, and the ids come
+    out in key order by construction."""
+    from .sort import rows_differ
+
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < n
+    boundary = rows_differ(key_cols, cap) & live
+    ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ids = jnp.where(live, ids, jnp.int32(cap))
+    return ids.astype(jnp.int32), jnp.sum(boundary).astype(jnp.int32)
+
+
 def group_representatives(ids: jax.Array, cap_out: int) -> jax.Array:
     """First-occurrence row index of each group id -> [cap_out] int32.
 

@@ -893,12 +893,14 @@ class Table:
         agg: Dict[str, Union[str, int, Sequence[Union[str, int]]]],
         ddof: int = 1,
         quantile: float = 0.5,
+        _sorted: bool = False,
     ) -> "Table":
         """Per-shard groupby-aggregate (reference HashGroupBy,
         groupby/hash_groupby.cpp). ``agg`` maps value column -> op(s) from
         {sum,count,min,max,mean,var,std,nunique,quantile,median}. Output has
         the key columns (sorted key order) then one column per (col, op)
         named ``col_op`` (pycylon naming, data/table.pyx:587-648)."""
+        ids_fn = _g.sorted_group_ids if _sorted else _g.group_ids
         key_names = self._resolve_cols(by)
         # normalize agg spec -> list of (col, op_id, op_name)
         specs: List[Tuple[str, int, str]] = []
@@ -913,7 +915,7 @@ class Table:
         val_idx = tuple(all_names.index(c) for c, _, _ in specs)
         ops_t = tuple(oid for _, oid, _ in specs)
         flat = self._flat_cols()
-        key = ("groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat))
+        key = ("groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat), _sorted)
 
         def build_count():
             def kern(dp, rep):
@@ -921,7 +923,7 @@ class Table:
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                _, ng = _g.group_ids(keys, n, cap)
+                _, ng = ids_fn(keys, n, cap)
                 return _scalar(ng)
 
             return kern
@@ -940,7 +942,7 @@ class Table:
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                ids, ng = _g.group_ids(keys, n, cap)
+                ids, ng = ids_fn(keys, n, cap)
                 rep_rows = _g.group_representatives(ids, co)
                 gmask = jnp.arange(co) < ng
                 rep_idx = jnp.where(gmask, jnp.clip(rep_rows, 0, cap - 1), -1)
@@ -1011,6 +1013,33 @@ class Table:
                 return shuffled.groupby(by, newagg, **kw)
         shuffled = t._shuffle_impl(kind="hash", key_names=key_names)
         return shuffled.groupby(by, agg, **kw)
+
+    def pipeline_groupby(
+        self,
+        by: Union[str, int, Sequence[Union[str, int]]],
+        agg: Dict[str, Union[str, int, Sequence[Union[str, int]]]],
+        **kw,
+    ) -> "Table":
+        """Groupby over input ALREADY sorted by the key columns (reference
+        PipelineGroupBy, groupby/pipeline_groupby.cpp:30-90): a single
+        run-detection pass replaces the factorize lexsort. The caller is
+        responsible for sortedness, as in the reference."""
+        return self.groupby(by, agg, _sorted=True, **kw)
+
+    def distributed_pipeline_groupby(
+        self,
+        by: Union[str, int, Sequence[Union[str, int]]],
+        agg: Dict[str, Union[str, int, Sequence[Union[str, int]]]],
+        **kw,
+    ) -> "Table":
+        """Reference DistributedPipelineGroupBy (groupby/groupby.cpp:93-137):
+        range-partition shuffle on the keys (global key order across shards),
+        local sort, then the sorted-run pipeline groupby."""
+        key_names = self._resolve_cols(by)
+        if self.world_size == 1:
+            return self.sort(key_names).pipeline_groupby(by, agg, **kw)
+        shuffled = self._shuffle_impl(kind="range", key_names=key_names)
+        return shuffled.sort(key_names).pipeline_groupby(by, agg, **kw)
 
     # ------------------------------------------------------------------
     # scalar aggregates (reference compute::Sum/Count/Min/Max,

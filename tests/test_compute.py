@@ -194,3 +194,15 @@ def test_pyrange_index():
     assert ct.IntegerIndex(np.array([1, 2])).index_values.tolist() == [1, 2]
     with pytest.raises(ValueError):
         ct.IntegerIndex(np.array([1.5]))
+
+
+def test_nunique_distributed(ctx8):
+    """Values present on several shards must count once (compute.py nunique
+    distributed_unique path)."""
+    t = ct.Table.from_pydict(ctx8, {"v": np.tile(np.arange(5), 40)})
+    assert cc.nunique(t)["v"] == 5
+
+
+def test_pyrange_index_rejects_floats():
+    with pytest.raises(ValueError, match="integers"):
+        ct.PyRangeIndex(data=[0.5, 1.5, 2.5])

@@ -1,0 +1,141 @@
+"""Streaming op-DAG engine (parallel/dag.py) vs eager ops as the oracle.
+
+Reference analog: the ops/ graph examples (DisJoinOP/DisUnionOp) validated
+against the eager table API, like cpp's union/join example binaries.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import dag
+
+
+def _chunks(ctx, df, n_chunks):
+    size = (len(df) + n_chunks - 1) // n_chunks
+    return [
+        ct.Table.from_pandas(ctx, df.iloc[i * size:(i + 1) * size].reset_index(drop=True))
+        for i in range(n_chunks)
+        if len(df.iloc[i * size:(i + 1) * size])
+    ]
+
+
+@pytest.fixture
+def join_data(rng):
+    l = pd.DataFrame({"k": rng.integers(0, 40, 200), "x": rng.normal(size=200)})
+    r = pd.DataFrame({"k": rng.integers(0, 40, 150), "y": rng.normal(size=150)})
+    return l, r
+
+
+def test_dis_join_streaming(ctx8, join_data):
+    l, r = join_data
+    g = dag.DisJoinOp(on="k", how="inner")
+    out = g.execute(_chunks(ctx8, l, 3), _chunks(ctx8, r, 2))
+    exp = l.merge(r, on="k", how="inner")
+    assert out.row_count == len(exp)
+    got = np.sort(out.to_pandas()["x"].to_numpy())
+    assert np.allclose(got, np.sort(exp["x"].to_numpy()))
+
+
+def test_dis_join_all_types(ctx8, join_data):
+    l, r = join_data
+    for how in ("left", "right", "outer"):
+        g = dag.DisJoinOp(on="k", how=how)
+        out = g.execute(_chunks(ctx8, l, 2), _chunks(ctx8, r, 2))
+        assert out.row_count == len(l.merge(r, on="k", how=how)), how
+
+
+def test_dis_union_streaming(ctx8, rng):
+    a = pd.DataFrame({"k": rng.integers(0, 20, 80), "v": rng.integers(0, 3, 80)})
+    b = pd.DataFrame({"k": rng.integers(0, 20, 60), "v": rng.integers(0, 3, 60)})
+    g = dag.DisUnionOp(columns=["k", "v"])
+    out = g.execute(_chunks(ctx8, a, 2), _chunks(ctx8, b, 3))
+    exp = pd.concat([a, b]).drop_duplicates()
+    assert out.row_count == len(exp)
+
+
+def test_execution_strategies(local_ctx, join_data):
+    """All four schedulers produce the same result on the same graph shape."""
+    l, r = join_data
+    exp = len(l.merge(r, on="k", how="inner"))
+
+    def build():
+        lp = dag.PartitionOp("pl")
+        rp = dag.PartitionOp("pr")
+        join = dag.JoinOp("join", on="k", how="inner")
+        root = dag.RootOp()
+        lp.add_child(join, edge=0)
+        rp.add_child(join, edge=1)
+        join.add_child(root)
+        return lp, rp, join, root
+
+    for make_exec in (
+        lambda lp, rp, join, root: dag.SequentialExecution(lp, rp),
+        lambda lp, rp, join, root: dag.RoundRobinExecution(lp, rp),
+        lambda lp, rp, join, root: dag.PriorityExecution(lp, rp, priorities={"pl": 2}),
+        lambda lp, rp, join, root: dag.JoinExecution(lp, rp, join, root),
+    ):
+        lp, rp, join, root = build()
+        g = dag._StreamingGraph([lp, rp], root, make_exec(lp, rp, join, root))
+        out = g.execute(_chunks(local_ctx, l, 3), _chunks(local_ctx, r, 2))
+        assert out.row_count == exp, type(g.execution).__name__
+
+
+def test_map_and_merge_ops(local_ctx, rng):
+    df = pd.DataFrame({"v": rng.normal(size=100)})
+    src = dag.MapOp("double", lambda t: ct.compute.math_op(t, "mul", 2.0))
+    merge = dag.MergeOp()
+    root = dag.RootOp()
+    src.add_child(merge)
+    merge.add_child(root)
+    g = dag._StreamingGraph([src], root, dag.SequentialExecution(src))
+    out = g.execute(_chunks(local_ctx, df, 4))
+    assert out.row_count == 100
+    assert np.allclose(
+        np.sort(out.to_pandas()["v"]), np.sort(df["v"].to_numpy() * 2)
+    )
+
+
+def test_stall_detection(local_ctx):
+    """A graph whose source is never FIN'd must raise, not spin."""
+    src = dag.MapOp("id", lambda t: t)
+    root = dag.RootOp()
+    src.add_child(root)
+    ex = dag.RoundRobinExecution(src)
+    src.insert(ct.Table.from_pydict(local_ctx, {"v": np.arange(4)}))
+    # drain the chunk but never call src.finish()
+    with pytest.raises(RuntimeError, match="stalled"):
+        ex.run()
+
+
+def test_insert_after_fin_raises(local_ctx):
+    src = dag.MapOp("id", lambda t: t)
+    src.finish()
+    with pytest.raises(RuntimeError, match="after FIN"):
+        src.insert(ct.Table.from_pydict(local_ctx, {"v": np.arange(2)}))
+
+
+def test_join_left_on_right_on_distributed(ctx8, rng):
+    """DisJoinOp must shuffle each side on ITS key (not column 0) so
+    differently-named keys stay co-partitioned (dag.py DisJoinOp)."""
+    l = pd.DataFrame({"x": rng.normal(size=120), "ka": rng.integers(0, 30, 120)})
+    r = pd.DataFrame({"kb": rng.integers(0, 30, 90), "y": rng.normal(size=90)})
+    g = dag.DisJoinOp(left_on=["ka"], right_on=["kb"], how="inner")
+    out = g.execute(_chunks(ctx8, l, 2), _chunks(ctx8, r, 2))
+    assert out.row_count == len(l.merge(r, left_on="ka", right_on="kb"))
+
+
+def test_empty_stream_rejected(ctx8, join_data):
+    l, r = join_data
+    g = dag.DisJoinOp(on="k")
+    with pytest.raises(ValueError, match="at least one"):
+        g.execute(_chunks(ctx8, l, 2), [])
+
+
+def test_zero_row_chunk_ok(ctx8, join_data):
+    """Zero-row chunks carry schema and join fine."""
+    l, r = join_data
+    empty = ct.Table.from_pandas(ctx8, r.iloc[:0])
+    g = dag.DisJoinOp(on="k", how="left")
+    out = g.execute(_chunks(ctx8, l, 2), [empty])
+    assert out.row_count == len(l)
