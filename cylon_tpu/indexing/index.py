@@ -114,7 +114,7 @@ class PyRangeIndex(IntegerIndex):
     def __init__(self, data=None, start: int = 0, stop: int = 0, step: int = 1):
         if data is not None:
             raw = np.asarray(data)
-            if raw.dtype.kind not in "iu":
+            if len(raw) and raw.dtype.kind not in "iu":
                 raise ValueError("PyRangeIndex data must be integers")
             r = raw.astype(np.int64)
             step_ = int(r[1] - r[0]) if len(r) >= 2 else 1

@@ -43,23 +43,33 @@ def _fmix32(h: jax.Array) -> jax.Array:
     return h ^ (h >> np.uint32(16))
 
 
-def _to_words(data: jax.Array) -> Tuple[jax.Array, ...]:
-    """Reinterpret a numeric column as 1 or 2 uint32 word lanes."""
+def _to_words(data: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Reinterpret a numeric column as exactly TWO uint32 word lanes.
+
+    Always two words so that the SAME VALUE hashes identically regardless of
+    physical width: int8/int32/int64 -1 all produce (0xFFFFFFFF, 0xFFFFFFFF),
+    f32 and f64 5.0 both produce (bits(5.0f), 0). Width-independent hashing is
+    what lets two tables shuffled independently (different chunks, different
+    declared dtypes) stay co-partitioned — the reference instead requires
+    matching key types up front (arrow type validation)."""
     dt = data.dtype
+    zeros = jnp.zeros(data.shape, jnp.uint32)
     if dt == jnp.bool_:
-        return (data.astype(jnp.uint32),)
-    if dt in (jnp.float32,):
+        return (data.astype(jnp.uint32), zeros)
+    if dt in (jnp.float32, jnp.float16, jnp.bfloat16):
         # canonicalize -0 -> +0 and NaN payloads so hash equality matches
         # orderable_key equality (else equal keys partition to different shards)
+        data = data.astype(jnp.float32)
         data = jnp.where(data == 0, jnp.zeros_like(data), data)
         w = jax.lax.bitcast_convert_type(data, jnp.uint32)
         w = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), w)
-        return (w,)
+        return (w, zeros)
     if dt in (jnp.float64,):
         # TPU can't bitcast f64 (x64-rewrite limitation): hash a double-float
         # (hi, lo) f32 split instead. Equal doubles always produce equal
-        # words; doubles differing below ~2^-48 relative may collide, which
-        # only skews partition balance, never correctness.
+        # words (and doubles exactly representable in f32 hash like the f32 —
+        # lo == 0); sub-2^-48 relative differences may collide, which only
+        # skews partition balance, never correctness.
         x = jnp.where(data == 0, jnp.zeros_like(data), data)  # -0 -> +0
         nanm = jnp.isnan(x)
         hi = jnp.where(nanm, jnp.float32(jnp.nan), x.astype(jnp.float32))
@@ -68,25 +78,22 @@ def _to_words(data: jax.Array) -> Tuple[jax.Array, ...]:
             jnp.float32(0),
             (x - hi.astype(jnp.float64)).astype(jnp.float32),
         )
-        return (
-            jax.lax.bitcast_convert_type(hi, jnp.uint32),
-            jax.lax.bitcast_convert_type(lo, jnp.uint32),
-        )
-    if dt in (jnp.float16, jnp.bfloat16):
-        data = data.astype(jnp.float32)
-        data = jnp.where(data == 0, jnp.zeros_like(data), data)
-        w = jax.lax.bitcast_convert_type(data, jnp.uint32)
-        w = jnp.where(jnp.isnan(data), np.uint32(0x7FC00000), w)
-        return (w,)
+        hib = jax.lax.bitcast_convert_type(hi, jnp.uint32)
+        hib = jnp.where(nanm, np.uint32(0x7FC00000), hib)
+        return (hib, jax.lax.bitcast_convert_type(lo, jnp.uint32))
     itemsize = np.dtype(dt).itemsize
     if itemsize <= 4:
-        # sign-extend to int32 then reinterpret, so that e.g. int8 -1 and
-        # int32 -1 hash identically (values, not bit widths, are hashed)
         if np.issubdtype(np.dtype(dt), np.signedinteger):
             w = data.astype(jnp.int32)
-            return (jax.lax.bitcast_convert_type(w, jnp.uint32),)
-        return (data.astype(jnp.uint32),)
-    # 64-bit integers -> two words
+            lo = jax.lax.bitcast_convert_type(w, jnp.uint32)
+            # sign-extension word: 0 or 0xFFFFFFFF, = what the int64 cast
+            # of the same value would put in its high word
+            hi = jax.lax.bitcast_convert_type(
+                w >> jnp.int32(31), jnp.uint32
+            )
+            return (lo, hi)
+        return (data.astype(jnp.uint32), zeros)
+    # 64-bit integers -> (lo, hi)
     u = data.astype(jnp.uint64)
     return (u.astype(jnp.uint32), (u >> np.uint64(32)).astype(jnp.uint32))
 
@@ -99,6 +106,20 @@ def murmur3_column(data: jax.Array, seed: int = 0) -> jax.Array:
         h = _mix_word(h, w)
     h = h ^ np.uint32(4 * len(words))
     return _fmix32(h)
+
+
+def hash_dictionary_host(dictionary: np.ndarray) -> np.ndarray:
+    """uint32 value-hash of each dictionary string (host side, once per
+    dictionary). Substituting ``dict_hash[codes]`` for the code column makes
+    hash partitioning DICTIONARY-INDEPENDENT: equal strings route to the same
+    shard no matter which chunk/table encoded them (the reference hashes the
+    string bytes directly, BinaryHashPartitionKernel,
+    arrow_partition_kernels.cpp:243-305)."""
+    import zlib
+
+    return np.array(
+        [zlib.crc32(s.encode("utf-8")) for s in dictionary], dtype=np.uint32
+    )
 
 
 def hash_columns(

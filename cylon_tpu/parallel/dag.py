@@ -75,11 +75,6 @@ class Op:
         return None
 
     # -- scheduling quanta ----------------------------------------------
-    def has_work(self) -> bool:
-        return any(q for q in self.queues) or (
-            all(self._fin_in) and not self._finalized
-        )
-
     def execute_one(self) -> bool:
         """Run one quantum: process one queued chunk, or finalize. Returns
         True if progress was made (reference Op::Execute + DidSomeWork)."""
@@ -230,15 +225,10 @@ class UnionOp(Op):
         return None
 
     def on_finalize(self) -> Optional[Table]:
-        if not self._acc[0] and not self._acc[1]:
-            return None
-        if not self._acc[0]:
-            return _concat_tables(self._acc[1]).unique()
-        if not self._acc[1]:
-            return _concat_tables(self._acc[0]).unique()
-        left = _concat_tables(self._acc[0])
-        right = _concat_tables(self._acc[1])
-        return left.union(right)
+        # Table.union == concat + unique (table.cpp:531-603 semantics), which
+        # also covers the one-sided cases
+        chunks = self._acc[0] + self._acc[1]
+        return _concat_tables(chunks).unique() if chunks else None
 
 
 # ---------------------------------------------------------------- schedulers

@@ -37,6 +37,7 @@ from .ops import partition as _p
 from .ops import setops as _s
 from .ops.sort import lexsort_rows
 from .parallel import shuffle as _sh
+from .utils.tracing import span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
@@ -453,7 +454,8 @@ class Table:
 
             return kern
 
-        out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
+        with span("sort", rows=int(self.row_count)):
+            out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
         return self._rebuild_cols(
             list(zip(all_names, self._columns.values())), out, self._row_counts, self._shard_cap
         )
@@ -489,6 +491,23 @@ class Table:
             return self
         return self._shuffle_impl(kind="hash", key_names=names)
 
+    def _key_hash_cols(self, key_names: Sequence[str]) -> List[KeyCol]:
+        """Key columns for HASH partitioning, with dictionary columns replaced
+        by their value-hash lane (ops/hash.py hash_dictionary_host): equal
+        strings route identically no matter which table/chunk encoded them."""
+        from .ops.hash import hash_dictionary_host
+
+        out: List[KeyCol] = []
+        for n in key_names:
+            c = self._columns[n]
+            if c.dtype.is_dictionary:
+                hh = jnp.asarray(hash_dictionary_host(c.dictionary))
+                lane = hh[jnp.clip(c.data, 0, len(c.dictionary) - 1)]
+                out.append((lane, c.valid))
+            else:
+                out.append((c.data, c.valid))
+        return out
+
     def _shuffle_impl(
         self,
         kind: str,
@@ -504,13 +523,14 @@ class Table:
         all_names = self.column_names
         key_idx = tuple(all_names.index(n) for n in key_names)
         flat = self._flat_cols()
+        khash = tuple(self._key_hash_cols(key_names))
         ax = ctx.axis_name
         nb = num_bins if num_bins else 16 * world
 
-        def compute_pid(cols, n):
-            keys = [cols[i] for i in key_idx]
+        def compute_pid(cols, kcols, n):
             if kind == "hash":
-                return _p.hash_partition_ids(keys, n, world)
+                return _p.hash_partition_ids(kcols, n, world)
+            keys = [cols[i] for i in key_idx]
             return _p.range_partition_ids(
                 keys[0], n, world, num_bins=nb, axis_name=ax, ascending=asc0
             )
@@ -519,27 +539,28 @@ class Table:
 
         def build_count():
             def kern(dp, rep):
-                (cols, counts) = dp
+                (cols, kcols, counts) = dp
                 n = counts[0]
-                pid = compute_pid(cols, n)
+                pid = compute_pid(cols, kcols, n)
                 return _sh.bucket_counts(pid, world)
 
             return kern
 
-        send_counts = get_kernel(ctx, key + ("count",), build_count)(
-            (flat, self.counts_dev), ()
-        )
-        send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
+        with span("shuffle.count", rows=int(self.row_count)):
+            send_counts = get_kernel(ctx, key + ("count",), build_count)(
+                (flat, khash, self.counts_dev), ()
+            )
+            send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
         bucket_cap = round_cap(int(send_counts.max()))
         new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
 
         def build_emit():
             def kern(dp, rep):
-                (cols, counts) = dp
+                (cols, kcols, counts) = dp
                 (dummy,) = rep
                 bc = dummy.shape[0]
                 n = counts[0]
-                pid = compute_pid(cols, n)
+                pid = compute_pid(cols, kcols, n)
                 cnt = _sh.bucket_counts(pid, world)
                 dest, _overflow = _sh.build_send_slots(pid, cnt, world, bc)
                 recv_counts = _sh.exchange_counts(cnt, ax)
@@ -558,10 +579,11 @@ class Table:
 
             return kern
 
-        out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
-            (flat, self.counts_dev), (jnp.zeros((bucket_cap,), jnp.int8),)
-        )
-        got = self._out_counts(nout)
+        with span("shuffle.exchange", rows=int(self.row_count)):
+            out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
+                (flat, khash, self.counts_dev), (jnp.zeros((bucket_cap,), jnp.int8),)
+            )
+            got = self._out_counts(nout)
         assert (got == new_counts).all(), (got, new_counts)
         return self._rebuild_cols(
             list(zip(all_names, self._columns.values())), out, new_counts, world * bucket_cap
@@ -571,7 +593,7 @@ class Table:
         """Local hash partition into k tables (reference HashPartition,
         table.cpp:384-405). Not a hot path; built on filter()."""
         names = self._resolve_cols(hash_columns)
-        flat = self._flat_cols(names)
+        flat = tuple(self._key_hash_cols(names))
         key = ("hash_partition", tuple(names), num_partitions)
 
         def build():
@@ -651,11 +673,14 @@ class Table:
 
                 return kern
 
-            out, totals, shadows = get_kernel(self.ctx, key + ("spec",), build_spec)(
-                (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
-                (jnp.zeros((spec_cap,), jnp.int8),),
-            )
-            totals = self._out_counts(totals)
+            with span("join.speculative", rows=int(self.row_count)):
+                out, totals, shadows = get_kernel(
+                    self.ctx, key + ("spec",), build_spec
+                )(
+                    (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
+                    (jnp.zeros((spec_cap,), jnp.int8),),
+                )
+                totals = self._out_counts(totals)
             _check_join_count(totals, np.asarray(shadows))
             if totals.max() <= spec_cap:
                 res = self._rebuild_cols(
@@ -957,9 +982,10 @@ class Table:
 
             return kern
 
-        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-            (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
-        )
+        with span("groupby.emit", rows=int(self.row_count)):
+            out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+                (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+            )
         # build output schema
         names_src: List[Tuple[str, Column]] = [
             (n, self._columns[n]) for n in key_names
@@ -1335,17 +1361,16 @@ def _promote_key_pair(
 
 def _concat_tables(tables: Sequence["Table"]) -> "Table":
     """Row-wise concat of same-schema tables, per shard (reference Merge,
-    table.cpp:267-289)."""
+    table.cpp:267-289). Balanced binary-tree fold: O(k log k) copy volume
+    over k chunks instead of the O(k^2) of a linear accumulator fold."""
     assert len(tables) >= 1
-    t0 = tables[0]
     if len(tables) == 1:
-        return t0
-    # fold binary concat; unify dictionaries pairwise first
-    acc = t0
-    for t in tables[1:]:
-        acc2, t2 = _unify_dict_pair(acc, t, acc.column_names, t.column_names)
-        acc = _concat2(acc2, t2)
-    return acc
+        return tables[0]
+    mid = len(tables) // 2
+    a = _concat_tables(tables[:mid])
+    b = _concat_tables(tables[mid:])
+    a2, b2 = _unify_dict_pair(a, b, a.column_names, b.column_names)
+    return _concat2(a2, b2)
 
 
 def _concat2(a: "Table", b: "Table") -> "Table":

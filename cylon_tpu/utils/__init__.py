@@ -1,0 +1,3 @@
+from .tracing import get_trace_report, profile, reset_trace, span, trace_enabled
+
+__all__ = ["get_trace_report", "profile", "reset_trace", "span", "trace_enabled"]

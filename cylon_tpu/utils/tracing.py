@@ -1,0 +1,83 @@
+"""Op-phase tracing and profiling.
+
+Reference analog: the pervasive ad-hoc ``std::chrono`` spans logged via glog —
+shuffle timings (table.cpp:166-176), partition/split timing
+(partition/partition.cpp:58-60,113-114), join phase breakdown
+setup/build/probe (join/hash_join.cpp:286-304), op-level timers
+(ops/partition_op.cpp:78-83) — plus the CYLON_DEBUG compile-time phase timers
+(table.cpp:925-980).
+
+Here the spans are first-class: a process-wide registry aggregates
+(count, total_s, max_s, rows) per span name, ``CYLON_TPU_TRACE=1`` additionally
+logs each span as it closes (glog-style), and :func:`profile` wraps
+``jax.profiler.trace`` so the same run can emit a Perfetto/XPlane device trace
+(SURVEY.md §5: "TPU equivalent: jax.profiler traces + Perfetto, plus the same
+op-phase spans").
+
+Span timings are HOST wall-clock around dispatch, like the reference's
+timers around its (synchronous) kernels. JAX dispatch is async, so a span
+covers trace+dispatch unless the op syncs — exactly the op boundaries where
+the framework syncs (count fetches) are the ones worth seeing.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+_lock = threading.Lock()
+_stats: Dict[str, Dict[str, float]] = defaultdict(
+    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "rows": 0}
+)
+
+
+def trace_enabled() -> bool:
+    return os.environ.get("CYLON_TPU_TRACE", "0") == "1"
+
+
+@contextlib.contextmanager
+def span(name: str, rows: Optional[int] = None) -> Iterator[None]:
+    """Time one op phase; aggregate into the registry (+ log when enabled)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            s = _stats[name]
+            s["count"] += 1
+            s["total_s"] += dt
+            s["max_s"] = max(s["max_s"], dt)
+            if rows is not None:
+                s["rows"] += int(rows)
+        if trace_enabled():
+            extra = f" rows={rows}" if rows is not None else ""
+            print(f"[cylon_tpu] {name}: {dt * 1e3:.2f} ms{extra}", file=sys.stderr)
+
+
+def get_trace_report() -> Dict[str, Dict[str, float]]:
+    """Aggregated span stats: {name: {count, total_s, max_s, rows}}."""
+    with _lock:
+        return {k: dict(v) for k, v in _stats.items()}
+
+
+def reset_trace() -> None:
+    with _lock:
+        _stats.clear()
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a device-level profiler trace (Perfetto/XPlane via
+    jax.profiler) around a block, alongside the host-side spans."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

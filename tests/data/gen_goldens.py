@@ -1,0 +1,66 @@
+"""Deterministic golden-file generator (run from repo root:
+``python tests/data/gen_goldens.py``).
+
+Reference analog: the compile-time EXECUTE toggle that regenerates golden
+CSVs by writing instead of comparing (cpp/test/test_utils.hpp:31-33,111-117).
+Inputs mirror the per-rank ``csv1_{RANK}.csv`` layout (cpp/test/join_test.cpp:
+21-24); goldens are the GLOBAL expected result computed by pandas (the
+oracle), verified in tests via the library's own Subtract — set-equality, the
+reference's verification scheme (test_utils.hpp:37-59).
+"""
+import os
+
+import numpy as np
+import pandas as pd
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RANKS = 4
+ROWS = 64  # per rank
+
+
+def main():
+    rng = np.random.default_rng(2026)
+    alphabet = np.array(["ant", "bee", "cat", "dog", "elk", "fox"])
+    sides = {}
+    for side in (1, 2):
+        parts = []
+        for r in range(RANKS):
+            df = pd.DataFrame({
+                "k": rng.integers(0, 48, ROWS).astype(np.int64),
+                "v": rng.integers(0, 1000, ROWS).astype(np.int64),
+                "s": alphabet[rng.integers(0, len(alphabet), ROWS)],
+            })
+            if side == 2:
+                # overlap a third of side 2's rows with side 1 rows so
+                # intersect/subtract goldens are non-trivial
+                src = sides[1].sample(ROWS // 3, random_state=r, replace=True)
+                df.iloc[: ROWS // 3] = src.to_numpy()
+            df.to_csv(os.path.join(HERE, f"csv{side}_{r}.csv"), index=False)
+            parts.append(df)
+        sides[side] = pd.concat(parts, ignore_index=True)
+
+    a, b = sides[1], sides[2]
+    for how in ("inner", "left", "right", "outer"):
+        g = a.merge(b, on="k", how=how, suffixes=("_x", "_y"))
+        g.to_csv(os.path.join(HERE, f"join_{how}.csv"), index=False)
+    pd.concat([a, b]).drop_duplicates().to_csv(
+        os.path.join(HERE, "union.csv"), index=False
+    )
+    a_rows = a.drop_duplicates()
+    b_keyed = set(map(tuple, b.to_numpy().tolist()))
+    a_rows[~a_rows.apply(tuple, axis=1).isin(b_keyed)].to_csv(
+        os.path.join(HERE, "subtract.csv"), index=False
+    )
+    a_rows[a_rows.apply(tuple, axis=1).isin(b_keyed)].to_csv(
+        os.path.join(HERE, "intersect.csv"), index=False
+    )
+    a.sort_values(["k", "v"]).to_csv(os.path.join(HERE, "sort_kv.csv"), index=False)
+    a.groupby("k", as_index=False).agg(v_sum=("v", "sum")).to_csv(
+        os.path.join(HERE, "groupby_sum.csv"), index=False
+    )
+    a.drop_duplicates().to_csv(os.path.join(HERE, "unique.csv"), index=False)
+    print("goldens written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

@@ -139,3 +139,36 @@ def test_zero_row_chunk_ok(ctx8, join_data):
     g = dag.DisJoinOp(on="k", how="left")
     out = g.execute(_chunks(ctx8, l, 2), [empty])
     assert out.row_count == len(l)
+
+
+def test_string_keys_chunked_distributed(ctx8, rng):
+    """Chunk-local dictionaries must not break shuffle routing: the hash
+    partitioner hashes string VALUES (ops/hash.py hash_dictionary_host), so
+    equal keys from different chunks co-partition."""
+    words = np.array([f"key{i:03d}" for i in range(30)])
+    l = pd.DataFrame({"k": words[rng.integers(0, 30, 140)], "x": rng.normal(size=140)})
+    r = pd.DataFrame({"k": words[rng.integers(0, 30, 100)], "y": rng.normal(size=100)})
+    g = dag.DisJoinOp(on="k", how="inner")
+    out = g.execute(_chunks(ctx8, l, 3), _chunks(ctx8, r, 2))
+    assert out.row_count == len(l.merge(r, on="k"))
+
+
+def test_mixed_width_int_keys_chunked_distributed(ctx8, rng):
+    """int32-vs-int64 keys co-partition without explicit promotion: hashing
+    is width-independent (ops/hash.py _to_words two-word scheme)."""
+    l = pd.DataFrame({"k": rng.integers(0, 30, 120).astype(np.int32),
+                      "x": rng.normal(size=120)})
+    r = pd.DataFrame({"k": rng.integers(0, 30, 90).astype(np.int64),
+                      "y": rng.normal(size=90)})
+    g = dag.DisJoinOp(on="k", how="inner")
+    out = g.execute(_chunks(ctx8, l, 2), _chunks(ctx8, r, 2))
+    assert out.row_count == len(l.merge(r, on="k"))
+
+
+def test_string_union_chunked_distributed(ctx8, rng):
+    words = np.array(["ant", "bee", "cat", "dog"])
+    a = pd.DataFrame({"s": words[rng.integers(0, 4, 60)]})
+    b = pd.DataFrame({"s": words[rng.integers(0, 4, 50)]})
+    g = dag.DisUnionOp(columns=["s"])
+    out = g.execute(_chunks(ctx8, a, 2), _chunks(ctx8, b, 2))
+    assert out.row_count == len(pd.concat([a, b]).drop_duplicates())

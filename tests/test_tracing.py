@@ -1,0 +1,41 @@
+"""Tracing subsystem (utils/tracing.py)."""
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu.utils import get_trace_report, reset_trace, span
+
+
+def test_span_registry():
+    reset_trace()
+    with span("unit.phase", rows=10):
+        pass
+    with span("unit.phase", rows=5):
+        pass
+    rep = get_trace_report()
+    assert rep["unit.phase"]["count"] == 2
+    assert rep["unit.phase"]["rows"] == 15
+    assert rep["unit.phase"]["total_s"] >= 0
+
+
+def test_ops_record_spans(local_ctx, rng):
+    reset_trace()
+    t = ct.Table.from_pydict(local_ctx, {
+        "k": rng.integers(0, 10, 100), "v": rng.normal(size=100)
+    })
+    t.sort("k")
+    t.join(t, on="k")
+    t.groupby("k", {"v": "sum"})
+    rep = get_trace_report()
+    assert rep["sort"]["count"] >= 1
+    assert rep["sort"]["rows"] >= 100
+    assert rep["join.speculative"]["count"] >= 1
+    assert rep["groupby.emit"]["count"] >= 1
+
+
+def test_shuffle_records_spans(ctx8, rng):
+    reset_trace()
+    t = ct.Table.from_pydict(ctx8, {"k": rng.integers(0, 10, 64)})
+    t.shuffle(["k"])
+    rep = get_trace_report()
+    assert rep["shuffle.count"]["count"] == 1
+    assert rep["shuffle.exchange"]["count"] == 1
