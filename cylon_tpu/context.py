@@ -126,6 +126,21 @@ class CylonContext:
     def is_finalized(self) -> bool:
         return self._finalized
 
+    @property
+    def memory_pool(self):
+        """Context-owned native arena pool for host staging buffers
+        (reference ToArrowPool(ctx), ctx/arrow_memory_pool_utils.hpp; here
+        native/runtime.cpp). Lazily created; None if the toolchain is
+        unavailable."""
+        pool = self.__dict__.get("_memory_pool")
+        if pool is None:
+            from .native import MemoryPool, available
+
+            if not available():
+                return None
+            pool = self.__dict__["_memory_pool"] = MemoryPool()
+        return pool
+
     def memory_usage(self) -> int:
         """Total live device memory (bytes) across the mesh, best effort."""
         total = 0

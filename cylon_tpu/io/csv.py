@@ -12,6 +12,7 @@ device_put once. pyarrow is the fallback when the native lib can't build.
 from __future__ import annotations
 
 import concurrent.futures
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -219,6 +220,27 @@ def read_csv(
     return Table.from_pydict(ctx, _read_one(paths, options))
 
 
+_io_pool = None
+_io_pool_lock = threading.Lock()
+
+
+def _stage(data: np.ndarray, want) -> np.ndarray:
+    """Contiguous typed staging copy for the native writer, carved from the
+    io arena pool (native/runtime.cpp; reference memory-pool analog) so
+    repeated writes reuse the same blocks instead of malloc churn."""
+    global _io_pool
+    want = np.dtype(want)
+    if data.dtype == want and data.flags["C_CONTIGUOUS"]:
+        return data
+    if _io_pool is None and native.available():
+        _io_pool = native.MemoryPool(block_bytes=4 << 20)
+    if _io_pool is None:
+        return np.ascontiguousarray(data, dtype=want)
+    out = _io_pool.alloc_array(data.shape, want)
+    np.copyto(out, data, casting="unsafe")
+    return out
+
+
 def write_csv(
     table: Table, path: str, options: Optional[CSVWriteOptions] = None
 ) -> None:
@@ -227,24 +249,31 @@ def write_csv(
     (which need string formatting) fall back to pandas."""
     options = options or CSVWriteOptions()
     if native.available():
-        names = table.column_names
-        cols = []
-        for name in names:
-            col = table.column(name)
-            t = col.dtype.type
-            data_np, valid_np = table._host_physical(name)
-            if col.dtype.is_dictionary:
-                cols.append((native.CT_STRING, data_np, valid_np, col.dictionary))
-            elif t == Type.BOOL:
-                cols.append((native.CT_BOOL, data_np, valid_np, None))
-            elif col.dtype.is_floating:
-                cols.append((native.CT_FLOAT64, data_np, valid_np, None))
-            elif col.dtype.is_numeric and data_np.dtype != np.uint64:
-                # uint64 values >= 2^63 don't fit the writer's int64 lane
-                cols.append((native.CT_INT64, data_np, valid_np, None))
-            else:
-                break  # temporal / uint64 -> pandas fallback
-        else:
-            native.write_csv(path, names, cols, delimiter=options._delimiter)
-            return
+        with _io_pool_lock:
+            if _io_pool is not None:
+                _io_pool.reset()
+            return _write_csv_native(table, path, options)
     table.to_pandas().to_csv(path, index=False, sep=options._delimiter)
+
+
+def _write_csv_native(table: Table, path: str, options: CSVWriteOptions) -> None:
+    names = table.column_names
+    cols = []
+    for name in names:
+        col = table.column(name)
+        t = col.dtype.type
+        data_np, valid_np = table._host_physical(name)
+        if col.dtype.is_dictionary:
+            cols.append((native.CT_STRING, _stage(data_np, np.int32), valid_np, col.dictionary))
+        elif t == Type.BOOL:
+            cols.append((native.CT_BOOL, _stage(data_np, np.uint8), valid_np, None))
+        elif col.dtype.is_floating:
+            cols.append((native.CT_FLOAT64, _stage(data_np, np.float64), valid_np, None))
+        elif col.dtype.is_numeric and data_np.dtype != np.uint64:
+            # uint64 values >= 2^63 don't fit the writer's int64 lane
+            cols.append((native.CT_INT64, _stage(data_np, np.int64), valid_np, None))
+        else:
+            # temporal / uint64 -> pandas fallback
+            table.to_pandas().to_csv(path, index=False, sep=options._delimiter)
+            return
+    native.write_csv(path, names, cols, delimiter=options._delimiter)

@@ -19,7 +19,10 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "csv.cpp")
+_SRC_RT = os.path.join(_HERE, "runtime.cpp")
+_SRC_CAPI = os.path.join(_HERE, "capi.cpp")
 _SO = os.path.join(_HERE, "_cylon_native.so")
+_SO_CAPI = os.path.join(_HERE, "_cylon_capi.so")
 
 _lock = threading.Lock()
 _lib_handle = None
@@ -32,7 +35,7 @@ CT_INT64, CT_FLOAT64, CT_BOOL, CT_STRING = 0, 1, 2, 3
 def _build() -> bool:
     cmd = [
         "g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-pthread",
-        _SRC, "-o", _SO + ".tmp",
+        _SRC, _SRC_RT, "-o", _SO + ".tmp",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
@@ -40,6 +43,31 @@ def _build() -> bool:
         return False
     os.replace(_SO + ".tmp", _SO)
     return True
+
+
+def build_capi() -> Optional[str]:
+    """Compile the C-ABI binding library (capi.cpp — the Java/JNI-binding
+    analog) against the current interpreter. Returns the .so path or None."""
+    import sysconfig
+
+    if os.path.exists(_SO_CAPI) and os.path.getmtime(_SO_CAPI) >= os.path.getmtime(
+        _SRC_CAPI
+    ):
+        return _SO_CAPI
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_python_version()
+    cmd = [
+        "g++", "-std=c++20", "-O2", "-fPIC", "-shared", "-pthread",
+        f"-I{inc}", _SRC_CAPI, "-o", _SO_CAPI + ".tmp",
+        f"-L{libdir}", f"-lpython{ver}",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
+        return None
+    os.replace(_SO_CAPI + ".tmp", _SO_CAPI)
+    return _SO_CAPI
 
 
 def _bind(lib):
@@ -78,6 +106,26 @@ def _bind(lib):
         c.POINTER(c.c_char_p), c.POINTER(c.c_int32),
         c.POINTER(c.c_void_p), c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),
     ]
+    # runtime.cpp: pool + murmur3
+    lib.ct_pool_create.restype = c.c_void_p
+    lib.ct_pool_create.argtypes = [c.c_int64]
+    lib.ct_pool_alloc.restype = c.c_void_p
+    lib.ct_pool_alloc.argtypes = [c.c_void_p, c.c_int64]
+    for name in ("ct_pool_in_use", "ct_pool_peak", "ct_pool_reserved", "ct_pool_allocs"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p]
+    lib.ct_pool_reset.restype = None
+    lib.ct_pool_reset.argtypes = [c.c_void_p]
+    lib.ct_pool_destroy.restype = None
+    lib.ct_pool_destroy.argtypes = [c.c_void_p]
+    lib.ct_murmur3_32.restype = c.c_uint32
+    lib.ct_murmur3_32.argtypes = [c.c_void_p, c.c_int64, c.c_uint32]
+    lib.ct_murmur3_batch.restype = None
+    lib.ct_murmur3_batch.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_uint32,
+        c.POINTER(c.c_uint32),
+    ]
     return lib
 
 
@@ -93,21 +141,150 @@ def get_lib():
             _load_failed = True
             return None
         try:
+            src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_SRC_RT))
             need_build = (not os.path.exists(_SO)) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+                os.path.getmtime(_SO) < src_mtime
             )
             if need_build and not _build():
                 _load_failed = True
                 return None
             _lib_handle = _bind(ctypes.CDLL(_SO))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: stale .so missing newly-bound symbols — rebuild
+            try:
+                if _build():
+                    _lib_handle = _bind(ctypes.CDLL(_SO))
+                    return _lib_handle
+            except (OSError, AttributeError):
+                pass
+            _lib_handle = None
             _load_failed = True
             return None
     return _lib_handle
 
 
+def get_lib_if_loaded():
+    """The library handle only if already loaded — never triggers a g++
+    build (keeps compile latency off the join/groupby hot path)."""
+    return _lib_handle
+
+
 def available() -> bool:
     return get_lib() is not None
+
+
+class MemoryPool:
+    """Arena allocator for host staging buffers (reference memory-pool
+    analog, ctx/memory_pool.hpp:69). ``alloc_array`` returns a numpy view
+    into pool memory — valid until ``reset``/``close``."""
+
+    def __init__(self, block_bytes: int = 1 << 20):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.ct_pool_create(block_bytes)
+
+    def alloc_array(self, shape, dtype) -> np.ndarray:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) * dt.itemsize
+        ptr = self._lib.ct_pool_alloc(self._h, max(n, 1))
+        buf = (ctypes.c_char * max(n, 1)).from_address(ptr)
+        # the view's base chain (array -> ctypes buf -> pool) keeps the pool
+        # alive while any allocation is referenced; reset()/close() are the
+        # explicit arena-invalidation points (documented contract)
+        buf._pool = self
+        return np.frombuffer(buf, dtype=dt, count=int(np.prod(shape))).reshape(shape)
+
+    def reset(self) -> None:
+        self._lib.ct_pool_reset(self._h)
+
+    @property
+    def bytes_in_use(self) -> int:
+        return self._lib.ct_pool_in_use(self._h)
+
+    @property
+    def bytes_peak(self) -> int:
+        return self._lib.ct_pool_peak(self._h)
+
+    @property
+    def bytes_reserved(self) -> int:
+        return self._lib.ct_pool_reserved(self._h)
+
+    @property
+    def alloc_count(self) -> int:
+        return self._lib.ct_pool_allocs(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ct_pool_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_M32 = 0xFFFFFFFF
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
+    """Pure-python MurmurHash3_x86_32, bit-identical to runtime.cpp's
+    ct_murmur3_32. Both implementations MUST agree: in a multi-host mesh the
+    hash decides shuffle routing, so a host without the native build has to
+    produce the same lanes as one with it."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i: 4 * i + 4], "little")
+        k = (k * c1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * c2) & _M32
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M32
+        h = (h * 5 + 0xE6546B64) & _M32
+    tail = data[4 * nblocks:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & _M32
+        k = ((k << 15) | (k >> 17)) & _M32
+        k = (k * c2) & _M32
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    return h ^ (h >> 16)
+
+
+def murmur3_strings(values: np.ndarray, seed: int = 0) -> np.ndarray:
+    """MurmurHash3_x86_32 of each string's UTF-8 bytes (reference
+    util/murmur3.cpp). Uses the native batch only when the library is
+    ALREADY loaded (no g++ build on the join/groupby hot path); the python
+    fallback is bit-identical, so shuffle routing agrees across processes
+    regardless of which path each one took."""
+    enc = [str(s).encode("utf-8") for s in values]
+    lib = get_lib_if_loaded()
+    if lib is not None:
+        offsets = np.zeros(len(enc) + 1, np.int64)
+        np.cumsum([len(b) for b in enc], out=offsets[1:])
+        blob = b"".join(enc)
+        out = np.empty(len(enc), np.uint32)
+        lib.ct_murmur3_batch(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(enc), seed, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        return out
+    return np.array([_murmur3_32_py(b, seed) for b in enc], np.uint32)
 
 
 class NativeColumn:

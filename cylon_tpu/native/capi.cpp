@@ -1,0 +1,266 @@
+// C ABI for the cylon_tpu framework: the foreign-language binding surface.
+//
+// Reference analog: the Java binding chain — Table.java -> JNI ->
+// cylon::Table (java/src/main/java/org/cylondata/cylon/Table.java:63-238,
+// java/src/main/native/src/Table.cpp). There the JVM calls INTO the C++
+// core; here any FFI-capable language (JVM/Go/C/Rust) calls into this C ABI,
+// which drives the framework through an embedded CPython interpreter — the
+// compute itself stays in XLA on the device either way, so the binding layer
+// is a thin handle registry, exactly like the reference's JNI table-id map.
+//
+// Build: g++ -shared -fPIC capi.cpp $(python3-config --includes --ldflags)
+// (done by cylon_tpu.native.build_capi()). In-process use from Python is
+// also supported (the GIL is re-acquired via PyGILState).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+std::mutex g_mu;
+std::map<int64_t, PyObject*> g_tables;  // handle -> cylon_tpu.Table
+int64_t g_next = 1;
+PyObject* g_module = nullptr;  // cylon_tpu
+PyObject* g_ctx = nullptr;     // CylonContext
+std::string g_err;
+bool g_we_initialized = false;
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void set_err_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    g_err = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_err = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+int64_t store(PyObject* table) {
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t h = g_next++;
+  g_tables[h] = table;
+  return h;
+}
+
+PyObject* fetch(int64_t h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_tables.find(h);
+  return it == g_tables.end() ? nullptr : it->second;
+}
+}  // namespace
+
+extern "C" {
+
+const char* ct_api_last_error() { return g_err.c_str(); }
+
+// Initialize the embedded interpreter (no-op when hosted inside Python) and
+// create the framework context. Returns 0 on success.
+int ct_api_init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  Gil gil;
+  if (g_module) return 0;
+  PyObject* mod = PyImport_ImportModule("cylon_tpu");
+  if (!mod) {
+    set_err_from_python();
+    return 1;
+  }
+  PyObject* ctx = PyObject_CallMethod(mod, "CylonContext", nullptr);
+  if (!ctx) {
+    // CylonContext() has no zero-arg ctor; use init()
+    PyErr_Clear();
+    PyObject* cls = PyObject_GetAttrString(mod, "CylonContext");
+    ctx = cls ? PyObject_CallMethod(cls, "init", nullptr) : nullptr;
+    Py_XDECREF(cls);
+  }
+  if (!ctx) {
+    set_err_from_python();
+    Py_DECREF(mod);
+    return 1;
+  }
+  g_module = mod;
+  g_ctx = ctx;
+  return 0;
+}
+
+// Table fromCSV (reference Table.java fromCSV :63). Returns handle or 0.
+int64_t ct_api_read_csv(const char* path) {
+  Gil gil;
+  if (!g_module) {
+    g_err = "ct_api_init not called";
+    return 0;
+  }
+  PyObject* t =
+      PyObject_CallMethod(g_module, "read_csv", "Os", g_ctx, path);
+  if (!t) {
+    set_err_from_python();
+    return 0;
+  }
+  return store(t);
+}
+
+// join (reference Table.java join/distributedJoin :126-171)
+int64_t ct_api_join(int64_t left, int64_t right, const char* on,
+                    const char* how, int distributed) {
+  Gil gil;
+  PyObject* l = fetch(left);
+  PyObject* r = fetch(right);
+  if (!l || !r) {
+    g_err = "invalid table handle";
+    return 0;
+  }
+  PyObject* out = PyObject_CallMethod(
+      l, distributed ? "distributed_join" : "join", "Oss", r, on, how);
+  if (!out) {
+    set_err_from_python();
+    return 0;
+  }
+  return store(out);
+}
+
+// sort (reference Table.java sort :190)
+int64_t ct_api_sort(int64_t h, const char* column, int distributed) {
+  Gil gil;
+  PyObject* t = fetch(h);
+  if (!t) {
+    g_err = "invalid table handle";
+    return 0;
+  }
+  PyObject* out = PyObject_CallMethod(
+      t, distributed ? "distributed_sort" : "sort", "s", column);
+  if (!out) {
+    set_err_from_python();
+    return 0;
+  }
+  return store(out);
+}
+
+// select/project by column names, comma separated (Table.java select :217)
+int64_t ct_api_project(int64_t h, const char* columns_csv) {
+  Gil gil;
+  PyObject* t = fetch(h);
+  if (!t) {
+    g_err = "invalid table handle";
+    return 0;
+  }
+  PyObject* list = PyList_New(0);
+  std::string s(columns_csv);
+  size_t pos = 0;
+  while (pos != std::string::npos) {
+    size_t c = s.find(',', pos);
+    std::string name =
+        c == std::string::npos ? s.substr(pos) : s.substr(pos, c - pos);
+    PyObject* u = PyUnicode_FromString(name.c_str());
+    if (!u || PyList_Append(list, u) != 0) {
+      Py_XDECREF(u);
+      Py_DECREF(list);
+      set_err_from_python();
+      return 0;
+    }
+    Py_DECREF(u);  // PyList_Append took its own reference
+    pos = c == std::string::npos ? c : c + 1;
+  }
+  PyObject* out = PyObject_CallMethod(t, "project", "O", list);
+  Py_DECREF(list);
+  if (!out) {
+    set_err_from_python();
+    return 0;
+  }
+  return store(out);
+}
+
+int64_t ct_api_row_count(int64_t h) {
+  Gil gil;
+  PyObject* t = fetch(h);
+  if (!t) {
+    g_err = "invalid table handle";
+    return -1;
+  }
+  PyObject* n = PyObject_GetAttrString(t, "row_count");
+  if (!n) {
+    set_err_from_python();
+    return -1;
+  }
+  int64_t v = PyLong_AsLongLong(n);
+  Py_DECREF(n);
+  return v;
+}
+
+int32_t ct_api_column_count(int64_t h) {
+  Gil gil;
+  PyObject* t = fetch(h);
+  if (!t) return -1;
+  PyObject* n = PyObject_GetAttrString(t, "column_count");
+  if (!n) {
+    set_err_from_python();
+    return -1;
+  }
+  int32_t v = (int32_t)PyLong_AsLong(n);
+  Py_DECREF(n);
+  return v;
+}
+
+int ct_api_write_csv(int64_t h, const char* path) {
+  Gil gil;
+  PyObject* t = fetch(h);
+  if (!t) {
+    g_err = "invalid table handle";
+    return 1;
+  }
+  PyObject* out = PyObject_CallMethod(g_module, "write_csv", "Os", t, path);
+  if (!out) {
+    set_err_from_python();
+    return 1;
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+void ct_api_release(int64_t h) {
+  Gil gil;
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_tables.find(h);
+  if (it != g_tables.end()) {
+    Py_DECREF(it->second);
+    g_tables.erase(it);
+  }
+}
+
+void ct_api_shutdown() {
+  // Py_Finalize requires the caller to HOLD the GIL, so the acquire/release
+  // is managed by hand here instead of the Gil RAII guard.
+  PyGILState_STATE st = PyGILState_Ensure();
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto& kv : g_tables) Py_DECREF(kv.second);
+    g_tables.clear();
+    Py_XDECREF(g_ctx);
+    Py_XDECREF(g_module);
+    g_ctx = nullptr;
+    g_module = nullptr;
+  }
+  if (g_we_initialized) {
+    g_we_initialized = false;
+    Py_Finalize();  // consumes the interpreter; no matching Release
+  } else {
+    PyGILState_Release(st);
+  }
+}
+
+}  // extern "C"

@@ -114,12 +114,12 @@ def hash_dictionary_host(dictionary: np.ndarray) -> np.ndarray:
     hash partitioning DICTIONARY-INDEPENDENT: equal strings route to the same
     shard no matter which chunk/table encoded them (the reference hashes the
     string bytes directly, BinaryHashPartitionKernel,
-    arrow_partition_kernels.cpp:243-305)."""
-    import zlib
+    arrow_partition_kernels.cpp:243-305). murmur3_x86_32 either way — native
+    batch when the lib is already loaded, bit-identical python otherwise —
+    so every process in a multi-host mesh computes the same routing."""
+    from ..native import murmur3_strings
 
-    return np.array(
-        [zlib.crc32(s.encode("utf-8")) for s in dictionary], dtype=np.uint32
-    )
+    return murmur3_strings(dictionary)
 
 
 def hash_columns(
