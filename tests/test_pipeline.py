@@ -1,0 +1,250 @@
+"""Tests for the fused distributed pipeline (parallel/pipeline.py).
+
+This is the code path the driver's multichip dryrun and the benchmarks run:
+make_distributed_join_step / make_join_groupby_step — the whole
+partition -> all_to_all -> join -> aggregate chain as ONE jitted shard_map
+program (reference analog: the op-DAG DisJoinOP graph,
+cpp/src/cylon/ops/dis_join_op.cpp:26-71). Verified against pandas on the
+global (all-shard) data, at mesh sizes {1,2,4,8}, including the overflow
+flags for undersized capacities.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from cylon_tpu.ops import join as _j
+from cylon_tpu.parallel.pipeline import (
+    make_distributed_join_step,
+    make_join_groupby_step,
+)
+
+
+def _mk_mesh(devices, n):
+    return Mesh(np.array(devices[:n]), ("dp",))
+
+
+def _put(mesh, arr):
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, PartitionSpec("dp")))
+
+
+def _mk_table(mesh, rng, world, shard_cap, n_per_shard, keyspace, with_nulls=False):
+    """Build (cols, counts_dev) plus the equivalent global pandas frame."""
+    key = rng.integers(0, keyspace, world * shard_cap).astype(np.int32)
+    val = rng.normal(size=world * shard_cap).astype(np.float32)
+    valid = None
+    if with_nulls:
+        valid = rng.random(world * shard_cap) > 0.25
+    counts = np.asarray(n_per_shard, np.int32)
+    assert counts.shape == (world,)
+    live_k, live_v, live_m = [], [], []
+    for i in range(world):
+        lo = i * shard_cap
+        c = int(counts[i])
+        live_k.append(key[lo : lo + c])
+        live_v.append(val[lo : lo + c])
+        if with_nulls:
+            live_m.append(valid[lo : lo + c])
+    gk = np.concatenate(live_k)
+    gv = np.concatenate(live_v).astype(np.float64)
+    if with_nulls:
+        gm = np.concatenate(live_m)
+        gv = np.where(gm, gv, np.nan)
+    df = pd.DataFrame({"k": gk, "v": gv})
+    cols = [
+        (_put(mesh, key), None),
+        (_put(mesh, val), _put(mesh, valid) if with_nulls else None),
+    ]
+    counts_dev = _put(mesh, counts)
+    return cols, counts_dev, df
+
+
+def _collect_rows(out_cols, out_counts, world, cap):
+    """Live rows per shard chunk -> dict of column-name -> global ndarray."""
+    res = []
+    for data, valid in out_cols:
+        d = np.asarray(data).reshape(world, cap)
+        v = None if valid is None else np.asarray(valid).reshape(world, cap)
+        parts = []
+        cnt = np.asarray(out_counts).reshape(-1)
+        for i in range(world):
+            c = int(cnt[i])
+            x = d[i, :c].astype(np.float64)
+            if v is not None:
+                x = np.where(v[i, :c], x, np.nan)
+            parts.append(x)
+        res.append(np.concatenate(parts))
+    return res
+
+
+def _multiset_equal(cols_a, cols_b):
+    """Order-independent row-multiset comparison of column lists (NaN==NaN)."""
+    a = np.stack([np.nan_to_num(c, nan=1.5e300) for c in cols_a], 1)
+    b = np.stack([np.nan_to_num(c, nan=1.5e300) for c in cols_b], 1)
+    if a.shape != b.shape:
+        return False
+    order_a = np.lexsort(a.T)
+    order_b = np.lexsort(b.T)
+    return np.allclose(a[order_a], b[order_b], rtol=1e-5, atol=1e-6)
+
+
+HOWS = [("inner", _j.INNER), ("left", _j.LEFT), ("right", _j.RIGHT), ("outer", _j.FULL_OUTER)]
+
+
+@pytest.mark.parametrize("world", [1, 2, 4, 8])
+@pytest.mark.parametrize("how_name,how", HOWS)
+def test_distributed_join_step_vs_pandas(devices, rng, world, how_name, how):
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n_l = rng.integers(10, shard_cap, world).astype(np.int32)
+    n_r = rng.integers(10, shard_cap, world).astype(np.int32)
+    l_cols, l_counts, l_df = _mk_table(mesh, rng, world, shard_cap, n_l, keyspace=12)
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n_r, keyspace=12)
+
+    step = make_distributed_join_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), how=how,
+        bucket_cap=world * shard_cap, join_cap=4096,
+    )
+    out_cols, out_counts, overflow = step((l_cols, l_counts, r_cols, r_counts), ())
+    jax.block_until_ready(out_counts)
+    assert int(np.asarray(overflow).sum()) == 0
+
+    got_lk, got_lv, got_rk, got_rv = _collect_rows(out_cols, out_counts, world, 4096)
+    # outer-join null sides: gather_column gives valid=False -> NaN via _collect
+    exp = l_df.merge(r_df, on="k", how=how_name, suffixes=("_l", "_r"),
+                     indicator=True)
+    exp_lk = np.where(exp["_merge"] == "right_only", np.nan, exp["k"])
+    exp_rk = np.where(exp["_merge"] == "left_only", np.nan, exp["k"])
+    exp_lv = exp["v_l"].to_numpy(np.float64)
+    exp_rv = exp["v_r"].to_numpy(np.float64)
+
+    assert int(np.asarray(out_counts).sum()) == len(exp)
+    assert _multiset_equal(
+        [got_lk, got_lv, got_rk, got_rv],
+        [np.asarray(exp_lk, np.float64), exp_lv, np.asarray(exp_rk, np.float64), exp_rv],
+    )
+
+
+@pytest.mark.parametrize("world", [2, 8])
+def test_join_step_nullable_value_columns(devices, rng, world):
+    """Null masks must survive the all_to_all exchange (shuffle_shard's
+    valid-column branch) and the join gather."""
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n = np.full((world,), 28, np.int32)
+    l_cols, l_counts, l_df = _mk_table(mesh, rng, world, shard_cap, n,
+                                       keyspace=10, with_nulls=True)
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n,
+                                       keyspace=10, with_nulls=True)
+    step = make_distributed_join_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=8192,
+    )
+    out_cols, out_counts, overflow = step((l_cols, l_counts, r_cols, r_counts), ())
+    assert int(np.asarray(overflow).sum()) == 0
+    got_lk, got_lv, got_rk, got_rv = _collect_rows(out_cols, out_counts, world, 8192)
+    exp = l_df.merge(r_df, on="k", how="inner", suffixes=("_l", "_r"))
+    assert int(np.asarray(out_counts).sum()) == len(exp)
+    assert _multiset_equal(
+        [got_lk, got_lv, got_rv],
+        [exp["k"].to_numpy(np.float64), exp["v_l"].to_numpy(np.float64),
+         exp["v_r"].to_numpy(np.float64)],
+    )
+
+
+@pytest.mark.parametrize("world", [2, 8])
+def test_distributed_join_step_matches_eager_table(devices, rng, world):
+    """Cross-check the fused path against the eager Table.distributed_join."""
+    import cylon_tpu as ct
+
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:world]))
+    n = 200
+    lk = rng.integers(0, 40, n).astype(np.int32)
+    lv = rng.normal(size=n).astype(np.float32)
+    rk = rng.integers(0, 40, n).astype(np.int32)
+    rv = rng.normal(size=n).astype(np.float32)
+    lt = ct.Table.from_pydict(ctx, {"k": lk, "v": lv})
+    rt = ct.Table.from_pydict(ctx, {"k": rk, "w": rv})
+    eager = lt.distributed_join(rt, on="k", how="inner").to_pandas()
+
+    mesh = ctx.mesh
+    cap = lt.shard_cap
+    l_cols = [(c.data, c.valid) for c in lt._columns.values()]
+    r_cols = [(c.data, c.valid) for c in rt._columns.values()]
+    step = make_distributed_join_step(
+        mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,), how=_j.INNER,
+        bucket_cap=world * cap, join_cap=8192,
+    )
+    out_cols, out_counts, overflow = step(
+        (l_cols, lt.counts_dev, r_cols, rt.counts_dev), ()
+    )
+    assert int(np.asarray(overflow).sum()) == 0
+    got_lk, got_lv, got_rk, got_rv = _collect_rows(out_cols, out_counts, world, 8192)
+    assert _multiset_equal(
+        [got_lk, got_lv, got_rk, got_rv],
+        [eager["k_x"].to_numpy(np.float64), eager["v"].to_numpy(np.float64),
+         eager["k_y"].to_numpy(np.float64), eager["w"].to_numpy(np.float64)],
+    )
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_join_groupby_step_total(devices, rng, world):
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n_l = np.full((world,), 24, np.int32)
+    n_r = np.full((world,), 20, np.int32)
+    l_cols, l_counts, l_df = _mk_table(mesh, rng, world, shard_cap, n_l, keyspace=16)
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n_r, keyspace=16)
+
+    step = make_join_groupby_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), agg_col_idx=1, how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=world * shard_cap * 8, group_cap=64,
+    )
+    sums, ng, n_join, total = step((l_cols, l_counts, r_cols, r_counts), ())
+    t = np.asarray(total)
+    assert np.allclose(t, t[0], rtol=1e-5)
+
+    exp = l_df.merge(r_df, on="k", how="inner", suffixes=("_l", "_r"))
+    assert int(np.asarray(n_join).sum()) == len(exp)
+    assert np.isclose(t[0], exp["v_l"].sum(), rtol=1e-4)
+
+
+def test_join_step_overflow_flags(devices, rng):
+    """Undersized bucket_cap / join_cap must raise the overflow flag, not
+    silently truncate counts."""
+    world = 4
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n = np.full((world,), 32, np.int32)
+    # all rows share one key -> every shard sends everything to one bucket
+    key = np.zeros(world * shard_cap, np.int32)
+    val = rng.normal(size=world * shard_cap).astype(np.float32)
+    cols = [(_put(mesh, key), None), (_put(mesh, val), None)]
+    counts = _put(mesh, n)
+
+    step = make_distributed_join_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), how=_j.INNER,
+        bucket_cap=8, join_cap=64,  # way too small for 128 rows on one target
+    )
+    out_cols, out_counts, overflow = step((cols, counts, cols, counts), ())
+    assert int(np.asarray(overflow).sum()) > 0
+
+    # properly sized: no overflow, exact count (128*128 inner matches won't
+    # fit small join_cap; use adequate caps)
+    step2 = make_distributed_join_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=16384,
+    )
+    _, out_counts2, overflow2 = step2((cols, counts, cols, counts), ())
+    assert int(np.asarray(overflow2).sum()) == 0
+    assert int(np.asarray(out_counts2).sum()) == (world * shard_cap) ** 2
+
+
+def test_graft_entry_dryrun_smoke():
+    """The driver contract: dryrun_multichip(8) completes in-process."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
