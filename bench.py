@@ -1,4 +1,4 @@
-"""Headline benchmark: distributed inner join throughput on TPU.
+"""Headline benchmark: distributed inner join throughput.
 
 Mirrors the reference's flagship benchmark (distributed inner join, strong
 scaling — docs/docs/arch.md:148-160; driver
@@ -7,10 +7,22 @@ Cylon joins 2x200M-row tables in 141.5 s on 1 CPU worker (BASELINE.md)
 -> 400e6/141.5 = 2.827e6 input rows/sec/worker. ``vs_baseline`` is our
 per-chip input-row rate over that.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Fail-soft design (round-1 postmortem: the TPU backend init in this image can
+hang indefinitely or die with UNAVAILABLE, and round 1 produced no number at
+all): the TPU backend is probed in a SUBPROCESS with a timeout + retries;
+on failure the benchmark falls back to the host CPU backend so a valid JSON
+line exists either way, with "platform"/"device" fields recording what
+actually ran. Any late error still emits JSON with an "error" field.
+
+Env knobs: BENCH_ROWS, BENCH_REPS, BENCH_INIT_TIMEOUT (s), BENCH_INIT_TRIES,
+BENCH_FORCE_CPU=1.
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -19,17 +31,79 @@ import numpy as np
 # is on int keys that fit int32
 os.environ.setdefault("CYLON_TPU_NO_X64", "1")
 
-import jax  # noqa: E402
+BASELINE_ROWS_PER_SEC = 400e6 / 141.5  # cylon 1-worker input rows/sec
 
-import cylon_tpu as ct  # noqa: E402
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def probe_tpu(timeout_s: float, tries: int) -> bool:
+    """Can the default (TPU) backend initialize? Checked in a child process
+    because a hung backend init cannot be interrupted in-process."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "print(d[0].platform, d[0].device_kind, sep='|')"
+    )
+    for attempt in range(tries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                plat = r.stdout.strip().splitlines()[-1]
+                print(f"bench: TPU probe ok ({plat})", file=sys.stderr)
+                return True
+            print(
+                f"bench: TPU probe attempt {attempt + 1}/{tries} failed "
+                f"(rc={r.returncode}): {r.stderr.strip()[-300:]}",
+                file=sys.stderr,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench: TPU probe attempt {attempt + 1}/{tries} timed out "
+                f"after {timeout_s:.0f}s",
+                file=sys.stderr,
+            )
+        if attempt + 1 < tries:
+            time.sleep(min(10.0 * (attempt + 1), 30.0))
+    return False
 
 
 def main():
     n = int(os.environ.get("BENCH_ROWS", 4_000_000))
     reps = int(os.environ.get("BENCH_REPS", 3))
-    rng = np.random.default_rng(0)
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
+    init_tries = int(os.environ.get("BENCH_INIT_TRIES", 2))
 
-    ctx = ct.CylonContext.init_distributed(ct.TPUConfig())
+    force_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
+    use_tpu = not force_cpu and probe_tpu(init_timeout, init_tries)
+    if not use_tpu:
+        # fall back to host CPU so the round still gets a measured number
+        import __graft_entry__ as ge
+
+        ge._force_cpu_mesh(1)
+        n = min(n, int(os.environ.get("BENCH_CPU_ROWS", 1_000_000)))
+        print("bench: falling back to CPU backend", file=sys.stderr)
+
+    import jax
+
+    import cylon_tpu as ct
+
+    dev = jax.devices()[0]
+    info = {
+        "platform": dev.platform,
+        "device": getattr(dev, "device_kind", "unknown"),
+        "rows": n,
+    }
+
+    rng = np.random.default_rng(0)
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=jax.devices()[:1])
+    )
     keyspace = n  # ~1 match per key on average, like the reference generator
     left = ct.Table.from_pydict(
         ctx,
@@ -46,9 +120,11 @@ def main():
         },
     )
 
-    # warmup (compile)
+    # warmup (compile) — measured separately so the JSON records both
+    t0 = time.perf_counter()
     out = left.distributed_join(right, on="k", how="inner")
     _ = out.row_count
+    compile_s = time.perf_counter() - t0
 
     best = float("inf")
     for _ in range(reps):
@@ -58,19 +134,34 @@ def main():
         dt = time.perf_counter() - t0
         best = min(best, dt)
 
-    rate = 2 * n / best / ctx.world_size  # per-chip (1 on the bench host)
-    baseline = 400e6 / 141.5  # cylon 1-worker input rows/sec
-    print(
-        json.dumps(
-            {
-                "metric": "dist_inner_join_input_rows_per_sec_per_chip",
-                "value": round(rate),
-                "unit": "rows/s",
-                "vs_baseline": round(rate / baseline, 3),
-            }
-        )
+    rate = 2 * n / best / ctx.world_size  # per-chip
+    emit(
+        {
+            "metric": "dist_inner_join_input_rows_per_sec_per_chip",
+            "value": round(rate),
+            "unit": "rows/s",
+            "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
+            "warm_s": round(best, 4),
+            "compile_s": round(compile_s, 2),
+            **info,
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # fail-soft: a parseable line beats a traceback
+        import traceback
+
+        traceback.print_exc()
+        emit(
+            {
+                "metric": "dist_inner_join_input_rows_per_sec_per_chip",
+                "value": 0,
+                "unit": "rows/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}"[:400],
+            }
+        )
+        sys.exit(0)

@@ -242,7 +242,20 @@ def _probe_targets(values, col_dtype: np.dtype) -> np.ndarray:
                 if info.min <= iv <= info.max:
                     kept.append(iv)
         return np.sort(np.array(kept, col_dtype))
-    return np.sort(np.array([float(v) for v in nums], col_dtype))
+    # float columns: a probe that does not round-trip through the column
+    # dtype (e.g. 0.1 probed against float32) can never equal any stored
+    # value — pandas compares in float64 and returns False there too, so
+    # dropping it preserves pandas semantics. NaN probes are also dropped:
+    # NaN != NaN under IEEE and column NaNs load as nulls (divergence from
+    # pandas isin([nan]), which matches stored NaNs).
+    kept = []
+    for v in nums:
+        fv = float(v)
+        if np.isnan(fv):
+            continue
+        if float(col_dtype.type(fv)) == fv:
+            kept.append(fv)
+    return np.sort(np.array(kept, col_dtype))
 
 
 def is_in(

@@ -56,12 +56,28 @@ class TPUConfig(CommConfig):
 
     This is the user-visible switch replacing the reference's ``MPIConfig``
     (python/pycylon/net/mpi_config.pyx): ``CylonEnv(config=TPUConfig())``.
+
+    Multi-host: pass ``coordinator_address`` (+ ``num_processes``/
+    ``process_id``) to run ``jax.distributed.initialize`` before the mesh is
+    built — the analog of mpirun launching N ranks (reference
+    net/mpi/mpi_communicator.cpp:51-66, lazy MPI_Init). On TPU pods the three
+    values are auto-detected when left None.
     """
 
-    def __init__(self, devices: Optional[Sequence[Any]] = None, axis_name: str = "dp"):
+    def __init__(
+        self,
+        devices: Optional[Sequence[Any]] = None,
+        axis_name: str = "dp",
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ):
         super().__init__()
         self.devices = devices
         self.axis_name = axis_name
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
 
     def comm_type(self) -> CommType:
         return CommType.TPU

@@ -56,6 +56,16 @@ class CylonContext:
             raise ValueError(
                 f"distributed init requires TPUConfig/CPUConfig, got {type(config)}"
             )
+        if config.coordinator_address is not None:
+            # multi-host: one jax process per host, devices global across the
+            # mesh (the mpirun-rank analog; reference mpi_communicator.cpp:51
+            # lazily calls MPI_Init the same way)
+            if not jax._src.distributed.global_state.client:
+                jax.distributed.initialize(
+                    coordinator_address=config.coordinator_address,
+                    num_processes=config.num_processes,
+                    process_id=config.process_id,
+                )
         devices = config.devices if config.devices is not None else jax.devices()
         mesh = Mesh(np.asarray(devices), (config.axis_name,))
         return cls(mesh, config.axis_name, config.comm_type())

@@ -95,56 +95,10 @@ def _read_one_native(path: str, options: CSVReadOptions) -> "OrderedDict[str, En
     return out
 
 
-def _promote_shard_types(shards: List["OrderedDict[str, Encoded]"]) -> None:
-    """When per-file type inference disagrees for a column, promote every
-    file to a common logical type (numeric mix -> float64; any string ->
-    string, with numbers re-formatted). Without this, one file's dictionary
-    codes would concatenate against another file's integer values."""
-    if not shards:
-        return
-    for name in list(shards[0].keys()):
-        types = {s[name][2].type for s in shards}
-        if len(types) == 1:
-            continue
-        if Type.STRING in types:
-            for s in shards:
-                data, valid, dtype, _d = s[name]
-                if dtype.type == Type.STRING:
-                    continue
-                if dtype.type == Type.BOOL:
-                    vals = np.where(data.astype(bool), "true", "false")
-                elif dtype.type == Type.DOUBLE:
-                    vals = np.array([repr(float(x)) for x in data])
-                else:
-                    vals = np.array([str(int(x)) for x in data])
-                dic, codes = np.unique(np.asarray(vals, str), return_inverse=True)
-                s[name] = (codes.astype(np.int32), valid, DataType(Type.STRING), dic)
-        else:
-            for s in shards:
-                data, valid, dtype, _d = s[name]
-                if dtype.type == Type.DOUBLE:
-                    continue
-                s[name] = (data.astype(np.float64), valid, DataType(Type.DOUBLE), None)
-
-
-def _unify_shard_dicts(shards: List["OrderedDict[str, Encoded]"]) -> None:
-    """Remap per-file dictionary codes onto the union dictionary so string
-    columns from different shard files compare/hash consistently (the analog
-    of each rank's Arrow table sharing a schema)."""
-    if not shards:
-        return
-    for name in list(shards[0].keys()):
-        if not shards[0][name][2].is_dictionary:
-            continue
-        dicts = [s[name][3] for s in shards]
-        union = dicts[0]
-        for d in dicts[1:]:
-            union = np.union1d(union, d)
-        for s in shards:
-            data, valid, dtype, d = s[name]
-            remap = np.searchsorted(union, d).astype(np.int32)
-            codes = remap[data] if len(d) else data
-            s[name] = (codes, valid, dtype, union)
+# shared shard-unification helpers (promotion + dictionary union) live on
+# Table's module so every per-shard ingest path uses the same rules
+from ..table import promote_encoded_shards as _promote_shard_types  # noqa: E402
+from ..table import unify_encoded_shards as _unify_shards  # noqa: E402
 
 
 def _read_one(path: str, options: CSVReadOptions) -> Dict[str, np.ndarray]:
@@ -183,8 +137,11 @@ def read_csv(
         if isinstance(paths, (list, tuple)):
             with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
                 shards = list(ex.map(lambda p: _read_one_native(p, options), paths))
-            _promote_shard_types(shards)
-            _unify_shard_dicts(shards)
+            _unify_shards(shards)
+            if len(shards) == ctx.world_size:
+                # file i -> shard i, staged per device with NO global concat
+                return Table.from_encoded_shards(ctx, shards)
+            # file count != mesh size: concat then re-split evenly
             names = list(shards[0].keys())
             merged: "OrderedDict[str, Encoded]" = OrderedDict()
             for n in names:
@@ -199,12 +156,7 @@ def read_csv(
                 else:
                     valid = None
                 merged[n] = (data, valid, shards[0][n][2], shards[0][n][3])
-            counts = (
-                np.array([len(next(iter(s.values()))[0]) for s in shards], np.int64)
-                if len(shards) == ctx.world_size
-                else None  # concat then re-split evenly
-            )
-            return Table.from_encoded(ctx, merged, counts=counts)
+            return Table.from_encoded(ctx, merged)
         return Table.from_encoded(ctx, _read_one_native(paths, options))
     if isinstance(paths, (list, tuple)):
         with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
@@ -242,27 +194,64 @@ def _stage(data: np.ndarray, want) -> np.ndarray:
 
 
 def write_csv(
-    table: Table, path: str, options: Optional[CSVWriteOptions] = None
+    table: Table,
+    path: Union[str, Sequence[str]],
+    options: Optional[CSVWriteOptions] = None,
 ) -> None:
     """Reference WriteCSV (table.cpp:244-253). Uses the native buffered
     row-wise writer (csv.cpp ct_csv_write) when available; temporal columns
-    (which need string formatting) fall back to pandas."""
+    (which need string formatting) fall back to pandas.
+
+    ``path`` may be a list of world_size paths: shard i's rows are written
+    to path[i], each shard fetched individually (no global gather — the
+    per-rank write analog of the reference's per-rank reads)."""
     options = options or CSVWriteOptions()
+    if isinstance(path, (list, tuple)):
+        if len(path) != table.world_size:
+            raise ValueError(
+                f"need {table.world_size} paths, got {len(path)}"
+            )
+        for i, p in enumerate(path):
+            _write_csv_one(table, p, options, shard=i)
+        return
+    _write_csv_one(table, path, options, shard=None)
+
+
+def _write_csv_one(
+    table: Table, path: str, options: CSVWriteOptions, shard: Optional[int]
+) -> None:
     if native.available():
         with _io_pool_lock:
             if _io_pool is not None:
                 _io_pool.reset()
-            return _write_csv_native(table, path, options)
-    table.to_pandas().to_csv(path, index=False, sep=options._delimiter)
+            return _write_csv_native(table, path, options, shard)
+    _shard_pandas(table, shard).to_csv(path, index=False, sep=options._delimiter)
 
 
-def _write_csv_native(table: Table, path: str, options: CSVWriteOptions) -> None:
+def _shard_pandas(table: Table, shard: Optional[int]):
+    if shard is None:
+        return table.to_pandas()
+    import pandas as pd
+
+    data = {}
+    for name in table.column_names:
+        d, v = table._host_physical_shard(name, shard)
+        data[name] = table.column(name).decode_host(d, v)
+    return pd.DataFrame(data)
+
+
+def _write_csv_native(
+    table: Table, path: str, options: CSVWriteOptions, shard: Optional[int] = None
+) -> None:
     names = table.column_names
     cols = []
     for name in names:
         col = table.column(name)
         t = col.dtype.type
-        data_np, valid_np = table._host_physical(name)
+        if shard is None:
+            data_np, valid_np = table._host_physical(name)
+        else:
+            data_np, valid_np = table._host_physical_shard(name, shard)
         if col.dtype.is_dictionary:
             cols.append((native.CT_STRING, _stage(data_np, np.int32), valid_np, col.dictionary))
         elif t == Type.BOOL:
@@ -274,6 +263,6 @@ def _write_csv_native(table: Table, path: str, options: CSVWriteOptions) -> None
             cols.append((native.CT_INT64, _stage(data_np, np.int64), valid_np, None))
         else:
             # temporal / uint64 -> pandas fallback
-            table.to_pandas().to_csv(path, index=False, sep=options._delimiter)
+            _shard_pandas(table, shard).to_csv(path, index=False, sep=options._delimiter)
             return
     native.write_csv(path, names, cols, delimiter=options._delimiter)

@@ -1,16 +1,26 @@
 """Parquet ingest/egress (reference io/arrow_io.cpp:63-116, gated there by
-BUILD_CYLON_PARQUET; always available here via pyarrow)."""
+BUILD_CYLON_PARQUET; always available here via pyarrow).
+
+Typed end to end: reads go through the arrow type bridge
+(Table.from_arrow / table._encode_arrow_array — dictionary codes, integer
+nulls and validity bitmaps survive, no pandas float64 bounce), and writes
+export per shard when given a list of paths (the per-rank IO analog of the
+reference's per-rank CSV reads, table.cpp:791-829 — no global gather).
+"""
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional, Sequence, Union
 
 import numpy as np
 
 from ..context import CylonContext
-from ..table import Table
+from ..table import Table, _encode_arrow_array, unify_encoded_shards
 
 
 def read_parquet(ctx: CylonContext, paths: Union[str, Sequence[str]]) -> Table:
+    """Read parquet file(s); a list of world_size paths maps file i to
+    shard i (per-rank ingest, O(one shard) host staging)."""
     import pyarrow.parquet as pq
 
     if isinstance(paths, (list, tuple)):
@@ -18,21 +28,41 @@ def read_parquet(ctx: CylonContext, paths: Union[str, Sequence[str]]) -> Table:
         for p in paths:
             at = pq.read_table(p)
             shards.append(
-                {n: at.column(n).to_numpy(zero_copy_only=False) for n in at.column_names}
+                OrderedDict(
+                    (n, _encode_arrow_array(at.column(n))) for n in at.column_names
+                )
             )
+        unify_encoded_shards(shards)
         if len(shards) == ctx.world_size:
-            return Table.from_shards(ctx, shards)
+            return Table.from_encoded_shards(ctx, shards)
+        # file count != mesh size: concat then re-split evenly
         names = list(shards[0].keys())
-        merged = {n: np.concatenate([s[n] for s in shards]) for n in names}
-        return Table.from_pydict(ctx, merged)
-    at = pq.read_table(paths)
-    return Table.from_pydict(
-        ctx, {n: at.column(n).to_numpy(zero_copy_only=False) for n in at.column_names}
-    )
+        merged = OrderedDict()
+        for n in names:
+            data = np.concatenate([s[n][0] for s in shards])
+            if any(s[n][1] is not None for s in shards):
+                valid = np.concatenate(
+                    [
+                        s[n][1] if s[n][1] is not None else np.ones(len(s[n][0]), bool)
+                        for s in shards
+                    ]
+                )
+            else:
+                valid = None
+            merged[n] = (data, valid, shards[0][n][2], shards[0][n][3])
+        return Table.from_encoded(ctx, merged)
+    return Table.from_arrow(ctx, pq.read_table(paths))
 
 
-def write_parquet(table: Table, path: str) -> None:
-    import pyarrow as pa
+def write_parquet(table: Table, path: Union[str, Sequence[str]]) -> None:
+    """Write parquet. A list of world_size paths writes shard i to path[i],
+    fetching each shard's device buffers individually (no global gather)."""
     import pyarrow.parquet as pq
 
+    if isinstance(path, (list, tuple)):
+        if len(path) != table.world_size:
+            raise ValueError(f"need {table.world_size} paths, got {len(path)}")
+        for i, p in enumerate(path):
+            pq.write_table(table.to_arrow(shard=i), p)
+        return
     pq.write_table(table.to_arrow(), path)

@@ -9,6 +9,7 @@ missing the callers fall back to pyarrow/pandas paths.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -21,8 +22,25 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "csv.cpp")
 _SRC_RT = os.path.join(_HERE, "runtime.cpp")
 _SRC_CAPI = os.path.join(_HERE, "capi.cpp")
-_SO = os.path.join(_HERE, "_cylon_native.so")
-_SO_CAPI = os.path.join(_HERE, "_cylon_capi.so")
+
+
+def _src_hash(*paths: str) -> str:
+    h = hashlib.sha256()
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+def _so_path() -> str:
+    # the source hash is in the filename: glibc dlopen caches by pathname, so
+    # a rebuild after a source edit must land at a NEW path to actually map
+    # fresh symbols in-process
+    return os.path.join(_HERE, f"_cylon_native-{_src_hash(_SRC, _SRC_RT)}.so")
+
+
+def _so_capi_path() -> str:
+    return os.path.join(_HERE, f"_cylon_capi-{_src_hash(_SRC_CAPI)}.so")
 
 _lock = threading.Lock()
 _lib_handle = None
@@ -32,16 +50,16 @@ _load_failed = False
 CT_INT64, CT_FLOAT64, CT_BOOL, CT_STRING = 0, 1, 2, 3
 
 
-def _build() -> bool:
+def _build(so: str) -> bool:
     cmd = [
         "g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-pthread",
-        _SRC, _SRC_RT, "-o", _SO + ".tmp",
+        _SRC, _SRC_RT, "-o", so + ".tmp",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
         return False
-    os.replace(_SO + ".tmp", _SO)
+    os.replace(so + ".tmp", so)
     return True
 
 
@@ -50,24 +68,23 @@ def build_capi() -> Optional[str]:
     analog) against the current interpreter. Returns the .so path or None."""
     import sysconfig
 
-    if os.path.exists(_SO_CAPI) and os.path.getmtime(_SO_CAPI) >= os.path.getmtime(
-        _SRC_CAPI
-    ):
-        return _SO_CAPI
+    so = _so_capi_path()
+    if os.path.exists(so):
+        return so
     inc = sysconfig.get_path("include")
     libdir = sysconfig.get_config_var("LIBDIR") or ""
     ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_python_version()
     cmd = [
         "g++", "-std=c++20", "-O2", "-fPIC", "-shared", "-pthread",
-        f"-I{inc}", _SRC_CAPI, "-o", _SO_CAPI + ".tmp",
+        f"-I{inc}", _SRC_CAPI, "-o", so + ".tmp",
         f"-L{libdir}", f"-lpython{ver}",
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
         return None
-    os.replace(_SO_CAPI + ".tmp", _SO_CAPI)
-    return _SO_CAPI
+    os.replace(so + ".tmp", so)
+    return so
 
 
 def _bind(lib):
@@ -141,22 +158,14 @@ def get_lib():
             _load_failed = True
             return None
         try:
-            src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_SRC_RT))
-            need_build = (not os.path.exists(_SO)) or (
-                os.path.getmtime(_SO) < src_mtime
-            )
-            if need_build and not _build():
+            # hash-named .so: a source edit changes the path, so there is no
+            # stale-mtime case and no dlopen-same-path staleness
+            so = _so_path()
+            if not os.path.exists(so) and not _build(so):
                 _load_failed = True
                 return None
-            _lib_handle = _bind(ctypes.CDLL(_SO))
+            _lib_handle = _bind(ctypes.CDLL(so))
         except (OSError, AttributeError):
-            # AttributeError: stale .so missing newly-bound symbols — rebuild
-            try:
-                if _build():
-                    _lib_handle = _bind(ctypes.CDLL(_SO))
-                    return _lib_handle
-            except (OSError, AttributeError):
-                pass
             _lib_handle = None
             _load_failed = True
             return None

@@ -37,12 +37,14 @@ struct Gil {
 void set_err_from_python() {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
+  g_err = "unknown python error";
   if (value) {
     PyObject* s = PyObject_Str(value);
-    g_err = s ? PyUnicode_AsUTF8(s) : "unknown python error";
-    Py_XDECREF(s);
-  } else {
-    g_err = "unknown python error";
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);  // may fail -> nullptr
+      if (u) g_err = u;
+      Py_DECREF(s);
+    }
   }
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -56,11 +58,25 @@ int64_t store(PyObject* table) {
   return h;
 }
 
+// Returns a NEW reference (incref'd under the lock): a concurrent
+// ct_api_release on the same handle can Py_DECREF the registry's reference
+// the moment g_mu is dropped, so handing out the borrowed pointer would be a
+// use-after-free. Callers own the returned reference.
 PyObject* fetch(int64_t h) {
   std::lock_guard<std::mutex> g(g_mu);
   auto it = g_tables.find(h);
-  return it == g_tables.end() ? nullptr : it->second;
+  if (it == g_tables.end()) return nullptr;
+  Py_INCREF(it->second);
+  return it->second;
 }
+
+// RAII owner for fetch() results.
+struct Ref {
+  PyObject* p;
+  explicit Ref(PyObject* o) : p(o) {}
+  ~Ref() { Py_XDECREF(p); }
+  explicit operator bool() const { return p != nullptr; }
+};
 }  // namespace
 
 extern "C" {
@@ -119,14 +135,14 @@ int64_t ct_api_read_csv(const char* path) {
 int64_t ct_api_join(int64_t left, int64_t right, const char* on,
                     const char* how, int distributed) {
   Gil gil;
-  PyObject* l = fetch(left);
-  PyObject* r = fetch(right);
+  Ref l(fetch(left));
+  Ref r(fetch(right));
   if (!l || !r) {
     g_err = "invalid table handle";
     return 0;
   }
   PyObject* out = PyObject_CallMethod(
-      l, distributed ? "distributed_join" : "join", "Oss", r, on, how);
+      l.p, distributed ? "distributed_join" : "join", "Oss", r.p, on, how);
   if (!out) {
     set_err_from_python();
     return 0;
@@ -137,13 +153,13 @@ int64_t ct_api_join(int64_t left, int64_t right, const char* on,
 // sort (reference Table.java sort :190)
 int64_t ct_api_sort(int64_t h, const char* column, int distributed) {
   Gil gil;
-  PyObject* t = fetch(h);
+  Ref t(fetch(h));
   if (!t) {
     g_err = "invalid table handle";
     return 0;
   }
   PyObject* out = PyObject_CallMethod(
-      t, distributed ? "distributed_sort" : "sort", "s", column);
+      t.p, distributed ? "distributed_sort" : "sort", "s", column);
   if (!out) {
     set_err_from_python();
     return 0;
@@ -154,7 +170,7 @@ int64_t ct_api_sort(int64_t h, const char* column, int distributed) {
 // select/project by column names, comma separated (Table.java select :217)
 int64_t ct_api_project(int64_t h, const char* columns_csv) {
   Gil gil;
-  PyObject* t = fetch(h);
+  Ref t(fetch(h));
   if (!t) {
     g_err = "invalid table handle";
     return 0;
@@ -176,7 +192,7 @@ int64_t ct_api_project(int64_t h, const char* columns_csv) {
     Py_DECREF(u);  // PyList_Append took its own reference
     pos = c == std::string::npos ? c : c + 1;
   }
-  PyObject* out = PyObject_CallMethod(t, "project", "O", list);
+  PyObject* out = PyObject_CallMethod(t.p, "project", "O", list);
   Py_DECREF(list);
   if (!out) {
     set_err_from_python();
@@ -187,12 +203,12 @@ int64_t ct_api_project(int64_t h, const char* columns_csv) {
 
 int64_t ct_api_row_count(int64_t h) {
   Gil gil;
-  PyObject* t = fetch(h);
+  Ref t(fetch(h));
   if (!t) {
     g_err = "invalid table handle";
     return -1;
   }
-  PyObject* n = PyObject_GetAttrString(t, "row_count");
+  PyObject* n = PyObject_GetAttrString(t.p, "row_count");
   if (!n) {
     set_err_from_python();
     return -1;
@@ -204,9 +220,9 @@ int64_t ct_api_row_count(int64_t h) {
 
 int32_t ct_api_column_count(int64_t h) {
   Gil gil;
-  PyObject* t = fetch(h);
+  Ref t(fetch(h));
   if (!t) return -1;
-  PyObject* n = PyObject_GetAttrString(t, "column_count");
+  PyObject* n = PyObject_GetAttrString(t.p, "column_count");
   if (!n) {
     set_err_from_python();
     return -1;
@@ -218,12 +234,12 @@ int32_t ct_api_column_count(int64_t h) {
 
 int ct_api_write_csv(int64_t h, const char* path) {
   Gil gil;
-  PyObject* t = fetch(h);
+  Ref t(fetch(h));
   if (!t) {
     g_err = "invalid table handle";
     return 1;
   }
-  PyObject* out = PyObject_CallMethod(g_module, "write_csv", "Os", t, path);
+  PyObject* out = PyObject_CallMethod(g_module, "write_csv", "Os", t.p, path);
   if (!out) {
     set_err_from_python();
     return 1;

@@ -46,29 +46,51 @@ def shuffle_shard(
     world: int,
     bucket_cap: int,
     axis_name: str,
+    respill: int = 1,
 ) -> Tuple[ShardTable, jax.Array]:
     """Static-capacity hash shuffle of one table (per-shard code).
 
-    Returns (shuffled shard table [world*bucket_cap rows], overflow count).
+    ``respill`` extra exchange rounds drain buckets hotter than
+    ``bucket_cap`` without any host sync (SURVEY.md §7 two-round-respill
+    plan): round r moves each bucket's rows [r*cap, (r+1)*cap), so the
+    overflow flag only trips when a bucket exceeds (1+respill)*cap.
+
+    Returns (shuffled shard table [(1+respill)*world*bucket_cap rows],
+    overflow count = rows still unsent after the final round, psum'd).
     """
     keys = [st.cols[i] for i in key_idx]
     pid = _p.hash_partition_ids(keys, st.n, world)
     cnt = _sh.bucket_counts(pid, world)
-    dest, overflow = _sh.build_send_slots(pid, cnt, world, bucket_cap)
-    sent = jnp.minimum(cnt, bucket_cap)
-    recv_counts = _sh.exchange_counts(sent, axis_name)
-    out_cols = []
-    for data, valid in st.cols:
-        d = _sh.exchange_column(data, dest, world, bucket_cap, axis_name)
-        v = (
-            None
-            if valid is None
-            else _sh.exchange_column(valid, dest, world, bucket_cap, axis_name).astype(bool)
+    rounds = 1 + respill
+    parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
+    masks = []
+    total = jnp.int32(0)
+    leftover = jnp.int32(0)
+    for r in range(rounds):
+        dest, leftover = _sh.build_send_slots_round(pid, cnt, world, bucket_cap, r)
+        recv_counts = _sh.exchange_counts(
+            _sh.round_counts(cnt, bucket_cap, r), axis_name
         )
-        out_cols.append((d, v))
-    mask, total = _sh.received_row_mask(recv_counts, world, bucket_cap)
-    out_cols = _sh.compact_received(out_cols, mask)
-    overflow = jax.lax.psum(overflow, axis_name)
+        for ci, (data, valid) in enumerate(st.cols):
+            d = _sh.exchange_column(data, dest, world, bucket_cap, axis_name)
+            v = (
+                None
+                if valid is None
+                else _sh.exchange_column(
+                    valid, dest, world, bucket_cap, axis_name
+                ).astype(bool)
+            )
+            parts[ci].append((d, v))
+        mask_r, total_r = _sh.received_row_mask(recv_counts, world, bucket_cap)
+        masks.append(mask_r)
+        total = total + total_r
+    cols_cat = []
+    for ci, (_, valid) in enumerate(st.cols):
+        d = jnp.concatenate([p[0] for p in parts[ci]])
+        v = None if valid is None else jnp.concatenate([p[1] for p in parts[ci]])
+        cols_cat.append((d, v))
+    out_cols = _sh.compact_received(cols_cat, jnp.concatenate(masks))
+    overflow = jax.lax.psum(leftover, axis_name)
     return ShardTable(tuple(out_cols), total), overflow
 
 
@@ -107,6 +129,7 @@ def make_distributed_join_step(
     how: int,
     bucket_cap: int,
     join_cap: int,
+    respill: int = 1,
 ):
     """Build the jittable distributed-join step over the mesh.
 
@@ -125,8 +148,8 @@ def make_distributed_join_step(
         lt = ShardTable(tuple(l_cols), l_counts[0])
         rt = ShardTable(tuple(r_cols), r_counts[0])
         if world > 1:
-            lt, ovl = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name)
-            rt, ovr = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name)
+            lt, ovl = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name, respill)
+            rt, ovr = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name, respill)
         else:
             ovl = ovr = jnp.int32(0)
         jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
@@ -153,6 +176,7 @@ def make_join_groupby_step(
     bucket_cap: int,
     join_cap: int,
     group_cap: int,
+    respill: int = 1,
 ):
     """Distributed join followed by groupby-sum on the join key and a global
     psum'd total — the TPC-H Q3-ish fused step used by benchmarks and the
@@ -166,8 +190,8 @@ def make_join_groupby_step(
         lt = ShardTable(tuple(l_cols), l_counts[0])
         rt = ShardTable(tuple(r_cols), r_counts[0])
         if world > 1:
-            lt, _ = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name)
-            rt, _ = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name)
+            lt, _ = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name, respill)
+            rt, _ = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name, respill)
         jt, _ = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
         # group on the (left) join key, sum the aggregate column
         keys = [jt.cols[i] for i in l_key_idx]

@@ -46,30 +46,56 @@ def shuffle_gather_order(pid: jax.Array, num_partitions: int) -> jax.Array:
     return jnp.argsort(pid, stable=True).astype(jnp.int32)
 
 
-def build_send_slots(
-    pid: jax.Array, counts: jax.Array, num_partitions: int, bucket_cap: int
+def build_send_slots_round(
+    pid: jax.Array,
+    counts: jax.Array,
+    num_partitions: int,
+    bucket_cap: int,
+    round_idx,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Destination slot in the [P * bucket_cap] send buffer for every row.
+    """Destination slot in the [P * bucket_cap] send buffer for every row
+    whose within-bucket position falls in round ``round_idx``'s window
+    [r*cap, (r+1)*cap); rows of other rounds are dropped (they are exchanged
+    in their own round — the skew/respill mechanism: a hot bucket drains
+    over ceil(count/cap) rounds instead of forcing a global max-sized cap).
 
-    Returns (dest [cap] int32 with P*bucket_cap meaning drop, overflow scalar
-    = rows that did not fit their bucket; caller guarantees 0 by sizing
-    bucket_cap from the exact counts).
+    ``round_idx`` may be a traced scalar, so ONE compiled program serves
+    every round. Returns (dest [cap] int32 with P*bucket_cap meaning
+    not-this-round, leftover scalar = rows still unsent AFTER this round).
     """
     cap = pid.shape[0]
     order = shuffle_gather_order(pid, num_partitions)
     spid = pid[order]
     starts = jnp.cumsum(counts) - counts  # exclusive prefix per partition
     safe_pid = jnp.clip(spid, 0, num_partitions - 1)
-    slot = jnp.arange(cap, dtype=jnp.int32) - starts[safe_pid]
-    ok = (spid < num_partitions) & (slot < bucket_cap)
+    pos = jnp.arange(cap, dtype=jnp.int32) - starts[safe_pid]  # pos in bucket
+    r = jnp.asarray(round_idx, jnp.int32)
+    slot = pos - r * bucket_cap
+    ok = (spid < num_partitions) & (slot >= 0) & (slot < bucket_cap)
     dest_sorted = jnp.where(
         ok, safe_pid * bucket_cap + slot, num_partitions * bucket_cap
     )
     dest = jnp.full((cap,), num_partitions * bucket_cap, jnp.int32).at[order].set(
         dest_sorted
     )
-    overflow = jnp.sum((spid < num_partitions) & (slot >= bucket_cap)).astype(jnp.int32)
-    return dest, overflow
+    leftover = jnp.sum(
+        (spid < num_partitions) & (pos >= (r + 1) * bucket_cap)
+    ).astype(jnp.int32)
+    return dest, leftover
+
+
+def build_send_slots(
+    pid: jax.Array, counts: jax.Array, num_partitions: int, bucket_cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Round 0 of :func:`build_send_slots_round`: (dest, overflow) where
+    overflow counts rows that did not fit their bucket."""
+    return build_send_slots_round(pid, counts, num_partitions, bucket_cap, 0)
+
+
+def round_counts(counts: jax.Array, bucket_cap: int, round_idx) -> jax.Array:
+    """Per-bucket send counts for one round: clip(counts - r*cap, 0, cap)."""
+    r = jnp.asarray(round_idx, jnp.int32)
+    return jnp.clip(counts - r * bucket_cap, 0, bucket_cap)
 
 
 def exchange_column(

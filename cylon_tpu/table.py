@@ -117,6 +117,88 @@ class Table:
     # construction
     # ------------------------------------------------------------------
     @classmethod
+    def from_encoded_shards(
+        cls,
+        ctx: CylonContext,
+        shards: Sequence[Optional["OrderedDict[str, Tuple]"]],
+        counts: Optional[np.ndarray] = None,
+    ) -> "Table":
+        """Per-shard ingest with NO global host buffer: ``shards[i]`` maps
+        column name -> (physical data, valid, dtype, sorted dictionary) for
+        shard i's rows. Each shard's padded block is staged to its own device
+        (``jax.make_array_from_single_device_arrays``), so peak host memory
+        is O(one shard), not O(global table) — the analog of each MPI rank
+        reading only its partition (reference table.cpp:791-829).
+
+        Under multi-host ``jax.distributed``, entries for non-addressable
+        devices may be None; ``counts`` (global, [world]) is then required.
+        Dictionaries must already be unified across shards
+        (see :func:`unify_encoded_shards`).
+        """
+        world = ctx.world_size
+        if len(shards) != world:
+            raise ValueError(f"need {world} shards, got {len(shards)}")
+        devices = list(ctx.mesh.devices.flat)
+        local = [i for i, d in enumerate(devices) if d.process_index == jax.process_index()]
+        if counts is None:
+            if any(shards[i] is None for i in local):
+                raise ValueError("counts required when local shard data is absent")
+            counts = np.zeros(world, np.int64)
+            for i in local:
+                s = shards[i]
+                counts[i] = len(next(iter(s.values()))[0]) if s else 0
+            if len(local) != world:
+                raise ValueError("counts (global) required under multi-host")
+        counts = np.asarray(counts, np.int64)
+        cap = round_cap(int(counts.max()) if world else 0)
+        ref = next(shards[i] for i in local if shards[i] is not None)
+        names = list(ref.keys())
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for name in names:
+            dtype = ref[name][2]
+            dictionary = ref[name][3]
+            phys_dt = np.result_type(
+                *[shards[i][name][0].dtype for i in local if shards[i] is not None]
+            )
+            has_valid = any(
+                shards[i][name][1] is not None for i in local if shards[i] is not None
+            )
+            blocks, vblocks = [], []
+            for i in local:
+                phys, valid, dt, _dic = shards[i][name]
+                if dt.type != dtype.type:
+                    raise ValueError(
+                        f"shard dtype mismatch for {name!r}: {dt} vs {dtype}"
+                    )
+                if len(phys) != counts[i]:
+                    raise ValueError("column lengths disagree with counts")
+                block = np.zeros((cap,), dtype=phys_dt)
+                block[: len(phys)] = phys
+                blocks.append(jax.device_put(block, devices[i]))
+                # drop the host block immediately: device_put may alias it
+                # (CPU zero-copy) and the whole point of this path is
+                # O(one shard) peak host memory
+                del block
+                if has_valid:
+                    vb = np.ones((cap,), bool)
+                    if valid is not None:
+                        vb[: len(valid)] = valid
+                    vblocks.append(jax.device_put(vb, devices[i]))
+                    del vb
+            data_dev = jax.make_array_from_single_device_arrays(
+                (world * cap,), ctx.sharding, blocks
+            )
+            valid_dev = (
+                jax.make_array_from_single_device_arrays(
+                    (world * cap,), ctx.sharding, vblocks
+                )
+                if has_valid
+                else None
+            )
+            cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
+        return cls(ctx, cols, counts, cap)
+
+    @classmethod
     def from_encoded(
         cls,
         ctx: CylonContext,
@@ -126,32 +208,38 @@ class Table:
         """Build a table from already-encoded host columns
         (physical data, valid, dtype, sorted dictionary) — the direct ingest
         path for the native CSV codec. ``counts=None`` splits rows evenly;
-        otherwise row blocks of sizes ``counts[i]`` go to shard i."""
+        otherwise row blocks of sizes ``counts[i]`` go to shard i. Delegates
+        to :meth:`from_encoded_shards` via zero-copy slices."""
         world = ctx.world_size
         n = len(next(iter(encoded.values()))[0]) if encoded else 0
+        for name, (phys, *_rest) in encoded.items():
+            if len(phys) != n:
+                raise ValueError("all columns must have equal length")
         if counts is None:
-            counts, cap = shard_caps(n, world)
+            counts, _cap = shard_caps(n, world)
         else:
             counts = np.asarray(counts, np.int64)
             if len(counts) != world or counts.sum() != n:
                 raise ValueError("bad shard counts")
-            cap = round_cap(int(counts.max()) if world else 0)
         offs = np.concatenate([[0], np.cumsum(counts)])
-        cols: "OrderedDict[str, Column]" = OrderedDict()
-        for name, (phys, valid, dtype, dictionary) in encoded.items():
-            if len(phys) != n:
-                raise ValueError("all columns must have equal length")
-            buf = np.zeros((world * cap,), dtype=phys.dtype)
-            vbuf = np.ones((world * cap,), dtype=bool) if valid is not None else None
-            for i in range(world):
-                lo, hi = offs[i], offs[i + 1]
-                buf[i * cap : i * cap + (hi - lo)] = phys[lo:hi]
-                if vbuf is not None:
-                    vbuf[i * cap : i * cap + (hi - lo)] = valid[lo:hi]
-            data_dev = jax.device_put(buf, ctx.sharding)
-            valid_dev = jax.device_put(vbuf, ctx.sharding) if vbuf is not None else None
-            cols[name] = Column(data_dev, dtype, valid_dev, dictionary)
-        return cls(ctx, cols, counts, cap)
+        shards = []
+        for i in range(world):
+            lo, hi = int(offs[i]), int(offs[i + 1])
+            shards.append(
+                OrderedDict(
+                    (
+                        name,
+                        (
+                            phys[lo:hi],
+                            None if valid is None else valid[lo:hi],
+                            dtype,
+                            dictionary,
+                        ),
+                    )
+                    for name, (phys, valid, dtype, dictionary) in encoded.items()
+                )
+            )
+        return cls.from_encoded_shards(ctx, shards, counts=counts)
 
     @classmethod
     def from_pydict(cls, ctx: CylonContext, data: Dict[str, Any]) -> "Table":
@@ -179,26 +267,37 @@ class Table:
 
     @classmethod
     def from_arrow(cls, ctx: CylonContext, atable) -> "Table":
-        """From a pyarrow.Table (reference Table::FromArrowTable,
-        table.hpp:67)."""
-        return cls.from_pandas(ctx, atable.to_pandas())
+        """From a pyarrow.Table, typed (reference Table::FromArrowTable,
+        table.hpp:67; arrow_builder.cpp raw-buffer ingest analog): dictionary
+        arrays keep their codes (remapped onto a sorted dictionary), integer
+        columns with nulls stay integral (no pandas float64 bounce), validity
+        bitmaps become the mask column."""
+        encoded = OrderedDict(
+            (name, _encode_arrow_array(atable.column(name)))
+            for name in atable.column_names
+        )
+        return cls.from_encoded(ctx, encoded)
 
     @classmethod
     def from_shards(cls, ctx: CylonContext, shards: Sequence[Dict[str, Any]]) -> "Table":
         """Per-shard construction: shard i's rows come from ``shards[i]`` —
         the analog of each MPI rank loading its own ``csv1_{RANK}.csv``
-        (reference cpp/test/join_test.cpp:21-24)."""
+        (reference cpp/test/join_test.cpp:21-24). Each shard is encoded
+        independently (O(shard) peak host memory), then dictionaries are
+        unified across shards by remapping codes."""
         world = ctx.world_size
         if len(shards) != world:
             raise ValueError(f"need {world} shards, got {len(shards)}")
         names = list(shards[0].keys())
-        counts = np.array([len(next(iter(s.values()))) if s else 0 for s in shards], np.int64)
-        encoded = OrderedDict(
-            # encode all shards together so dictionaries are global
-            (name, Column.encode_host(np.concatenate([np.asarray(s[name]) for s in shards])))
-            for name in names
-        )
-        return cls.from_encoded(ctx, encoded, counts=counts)
+        enc_shards = []
+        for s in shards:
+            enc_shards.append(
+                OrderedDict(
+                    (name, Column.encode_host(np.asarray(s[name]))) for name in names
+                )
+            )
+        unify_encoded_shards(enc_shards)
+        return cls.from_encoded_shards(ctx, enc_shards)
 
     def _replace(self, columns=None, row_counts=None, shard_cap=None) -> "Table":
         return Table(
@@ -229,6 +328,25 @@ class Table:
         valid_np = np.concatenate(vparts) if valid is not None else None
         return data_np, valid_np
 
+    def _host_physical_shard(self, name: str, shard: int):
+        """One shard's live rows in physical encoding, fetched WITHOUT
+        gathering the global array (per-rank IO path: only shard ``shard``'s
+        device buffer crosses to the host)."""
+        col = self._columns[name]
+        cap = self._shard_cap
+        c = int(self._row_counts[shard])
+
+        def block_of(arr):
+            for s in arr.addressable_shards:
+                start = s.index[0].start if s.index[0].start is not None else 0
+                if start == shard * cap:
+                    return np.asarray(s.data)
+            raise ValueError(f"shard {shard} not addressable from this host")
+
+        data = block_of(col.data)[:c]
+        valid = None if col.valid is None else block_of(col.valid)[:c]
+        return data, valid
+
     def _host_column(self, name: str):
         data_np, valid_np = self._host_physical(name)
         return self._columns[name].decode_host(data_np, valid_np)
@@ -246,11 +364,33 @@ class Table:
                 for v in self.to_pydict().values()]
         return np.stack(cols, axis=1) if cols else np.empty((0, 0))
 
-    def to_arrow(self):
+    def to_arrow(self, shard: Optional[int] = None):
+        """Typed pyarrow.Table (no pandas bounce): dictionary columns export
+        as pa.DictionaryArray (codes + dictionary), validity masks as null
+        bitmaps, integers stay integral. ``shard=i`` exports only shard i's
+        rows, fetched without a global gather (per-rank IO)."""
         import pyarrow as pa
 
-        return pa.Table.from_pydict({k: list(v) if v.dtype == object else v
-                                     for k, v in self.to_pydict().items()})
+        arrays, names = [], []
+        for name in self.column_names:
+            col = self._columns[name]
+            if shard is None:
+                data, valid = self._host_physical(name)
+            else:
+                data, valid = self._host_physical_shard(name, shard)
+            mask = None if valid is None else ~valid
+            if col.dtype.is_dictionary:
+                codes = pa.array(np.asarray(data, np.int32), mask=mask)
+                arr = pa.DictionaryArray.from_arrays(
+                    codes, pa.array(col.dictionary.astype(object))
+                )
+            elif col.dtype.type == Type.TIMESTAMP:
+                arr = pa.array(data.astype("datetime64[ns]"), mask=mask)
+            else:
+                arr = pa.array(data, mask=mask)
+            arrays.append(arr)
+            names.append(name)
+        return pa.Table.from_arrays(arrays, names=names)
 
     def __repr__(self):
         head = self.to_pandas()
@@ -421,9 +561,32 @@ class Table:
         return self.filter(mask)
 
     def take(self, indices: np.ndarray) -> "Table":
-        """Host-index gather across the global table (utility)."""
-        df = self.to_pandas().iloc[np.asarray(indices)]
-        return Table.from_pandas(self.ctx, df)
+        """Gather rows by global (live-row-order) indices — a real device
+        gather (reference copy_array_by_indices, util/copy_arrray.cpp), not a
+        pandas round-trip. Cross-shard reads become XLA-inserted collectives;
+        output rows are re-split evenly."""
+        world, cap_in = self.world_size, self._shard_cap
+        idx = np.asarray(indices, np.int64).reshape(-1)
+        n_total = self.row_count
+        idx = np.where(idx < 0, idx + n_total, idx)
+        if len(idx) and (idx.min() < 0 or idx.max() >= n_total):
+            raise IndexError("take index out of range")
+        offs = np.concatenate([[0], np.cumsum(self._row_counts)])
+        src_shard = np.searchsorted(offs[1:], idx, side="right")
+        phys = (src_shard * cap_in + (idx - offs[src_shard])).astype(np.int32)
+        counts, cap_out = shard_caps(len(idx), world)
+        full = np.zeros(world * cap_out, np.int32)
+        o = np.concatenate([[0], np.cumsum(counts)])
+        for i in range(world):
+            full[i * cap_out : i * cap_out + counts[i]] = phys[o[i] : o[i + 1]]
+        idx_dev = jax.device_put(full, self.ctx.sharding)
+        gather = jax.jit(lambda d, i: d[i], out_shardings=self.ctx.sharding)
+        cols: "OrderedDict[str, Column]" = OrderedDict()
+        for n, c in self._columns.items():
+            d = gather(c.data, idx_dev)
+            v = None if c.valid is None else gather(c.valid, idx_dev)
+            cols[n] = Column(d, c.dtype, v, c.dictionary)
+        return Table(self.ctx, cols, counts, cap_out, index_name=self.index_name)
 
     # ------------------------------------------------------------------
     # sort
@@ -551,19 +714,42 @@ class Table:
                 (flat, khash, self.counts_dev), ()
             )
             send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
-        bucket_cap = round_cap(int(send_counts.max()))
         new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
+
+        # Skew-robust capacity (reference sidesteps raggedness by streaming
+        # bytes, arrow_all_to_all.cpp:83-141 — impossible under XLA static
+        # shapes): a single all_to_all must give EVERY (src,dst) bucket the
+        # same capacity, so one hot bucket would inflate the whole exchange
+        # and the output table by P x. Instead the exchange runs in
+        # ceil(max_bucket / C) rounds at a balanced capacity C; hot buckets
+        # drain across rounds (the two-round-respill plan of SURVEY.md §7,
+        # generalized to K rounds with ONE compiled program — the round index
+        # is a traced scalar).
+        max_cnt = int(send_counts.max())
+        mean_bucket = -(-int(send_counts.sum()) // (world * world))  # ceil
+        c_full = round_cap(max_cnt)
+        c_balanced = round_cap(4 * max(mean_bucket, 1))
+        if c_balanced < c_full:
+            bucket_cap = c_balanced
+            n_rounds = -(-max_cnt // bucket_cap)
+            if n_rounds > 16:  # bound dispatch count for extreme skew
+                bucket_cap = round_cap(-(-max_cnt // 16))
+                n_rounds = -(-max_cnt // bucket_cap)
+        else:
+            bucket_cap, n_rounds = c_full, 1
 
         def build_emit():
             def kern(dp, rep):
                 (cols, kcols, counts) = dp
-                (dummy,) = rep
+                (dummy, rnd) = rep
                 bc = dummy.shape[0]
                 n = counts[0]
                 pid = compute_pid(cols, kcols, n)
                 cnt = _sh.bucket_counts(pid, world)
-                dest, _overflow = _sh.build_send_slots(pid, cnt, world, bc)
-                recv_counts = _sh.exchange_counts(cnt, ax)
+                dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
+                recv_counts = _sh.exchange_counts(
+                    _sh.round_counts(cnt, bc, rnd), ax
+                )
                 out_cols = []
                 for data, valid in cols:
                     d = _sh.exchange_column(data, dest, world, bc, ax)
@@ -579,15 +765,34 @@ class Table:
 
             return kern
 
+        src_pairs = list(zip(all_names, self._columns.values()))
+        rounds: List["Table"] = []
         with span("shuffle.exchange", rows=int(self.row_count)):
-            out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
-                (flat, khash, self.counts_dev), (jnp.zeros((bucket_cap,), jnp.int8),)
-            )
-            got = self._out_counts(nout)
-        assert (got == new_counts).all(), (got, new_counts)
-        return self._rebuild_cols(
-            list(zip(all_names, self._columns.values())), out, new_counts, world * bucket_cap
-        )
+            for r in range(n_rounds):
+                out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
+                    (flat, khash, self.counts_dev),
+                    (jnp.zeros((bucket_cap,), jnp.int8), jnp.asarray(r, jnp.int32)),
+                )
+                got = self._out_counts(nout)
+                expect = (
+                    np.clip(send_counts - r * bucket_cap, 0, bucket_cap)
+                    .sum(axis=0)
+                    .astype(np.int64)
+                )
+                if not (got == expect).all():
+                    raise RuntimeError(
+                        f"shuffle round {r}: received row counts {got} != "
+                        f"expected {expect} — internal routing bug"
+                    )
+                rounds.append(
+                    self._rebuild_cols(src_pairs, out, got, world * bucket_cap)
+                )
+        res = rounds[0] if n_rounds == 1 else _concat_tables(rounds)
+        # compact single-round output when the uniform bucket sizing overshot
+        tight = round_cap(int(new_counts.max()))
+        if tight * 2 <= res._shard_cap:
+            res = res._compact(tight)
+        return res
 
     def hash_partition(self, hash_columns: Sequence[Union[str, int]], num_partitions: int) -> Dict[int, "Table"]:
         """Local hash partition into k tables (reference HashPartition,
@@ -1179,23 +1384,73 @@ class Table:
         return self._replace(columns=cols)
 
     def equals(self, other: "Table", ordered: bool = True) -> bool:
-        """Content equality; unordered compares as multisets of rows (the
-        reference tests verify via Subtract-emptiness, test_utils.hpp:37-59)."""
+        """Content equality WITHOUT gathering the global table.
+
+        ordered=True: device-side row-for-row compare (falls back to a host
+        compare only when the two tables' physical layouts differ).
+        ordered=False: exact multiset compare — each table is reduced to
+        (distinct row, multiplicity) via groupby-count, and the counted
+        tables are set-compared by two-way subtract. Stronger than the
+        reference's Subtract-emptiness check (test_utils.hpp:37-59), which
+        ignores duplicate multiplicities.
+        """
         if self.column_names != other.column_names or self.row_count != other.row_count:
             return False
-        a = self.to_pandas()
-        b = other.to_pandas()
-        if not ordered:
-            cols = list(a.columns)
-            a = a.sort_values(cols, kind="stable").reset_index(drop=True)
-            b = b.sort_values(cols, kind="stable").reset_index(drop=True)
-        try:
-            import pandas.testing as pdt
+        if ordered:
+            if (
+                (self._row_counts == other._row_counts).all()
+                and self._shard_cap == other._shard_cap
+            ):
+                return self._device_equal(other)
+            a = self.to_pandas()
+            b = other.to_pandas()
+            try:
+                import pandas.testing as pdt
 
-            pdt.assert_frame_equal(a, b, check_dtype=False)
-            return True
-        except AssertionError:
+                pdt.assert_frame_equal(a, b, check_dtype=False)
+                return True
+            except AssertionError:
+                return False
+        a = self._row_multiset()
+        b = other._row_multiset()
+        if a.row_count != b.row_count:
             return False
+        return (
+            a.distributed_subtract(b).row_count == 0
+            and b.distributed_subtract(a).row_count == 0
+        )
+
+    def _device_equal(self, other: "Table") -> bool:
+        """Row-for-row device compare of identically laid out tables: null
+        rows compare equal regardless of payload; float NaN == NaN."""
+        a, b = _unify_dict_pair(self, other, self.column_names, other.column_names)
+        live = a._live_mask()
+        ok = True
+        for n in a.column_names:
+            ca, cb = a._columns[n], b._columns[n]
+            if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
+                return False
+            va, vb = ca.valid_mask(), cb.valid_mask()
+            same_valid = (va == vb) | ~live
+            same = (ca.data == cb.data)
+            if jnp.issubdtype(ca.data.dtype, jnp.floating):
+                same = same | (jnp.isnan(ca.data) & jnp.isnan(cb.data))
+            same = same | ~live | ~va
+            ok = ok and bool(jnp.all(same_valid & same))
+        return ok
+
+    def _row_multiset(self) -> "Table":
+        """(distinct row, multiplicity) table: groupby-count over ALL
+        columns (a never-null ones column carries the count)."""
+        w = "__row_weight__"
+        ones = Column(
+            jnp.ones(self._shard_cap * self.world_size, jnp.int32),
+            DataType(Type.INT32),
+            None,
+            None,
+        )
+        t = self.add_column(w, ones)
+        return t.distributed_groupby(self.column_names, {w: "count"})
 
     # ------------------------------------------------------------------
     # indexing (reference indexing/ subsystem; pycylon set_index/loc/iloc
@@ -1269,6 +1524,101 @@ class Table:
 # ----------------------------------------------------------------------
 # module-level helpers
 # ----------------------------------------------------------------------
+
+def _encode_arrow_array(chunked):
+    """pyarrow ChunkedArray/Array -> (physical, valid, DataType, dictionary),
+    typed (reference arrow type bridge, arrow/arrow_types.cpp). Dictionary
+    codes are remapped onto the sorted unique dictionary (the Column
+    invariant: code order == value order)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = chunked.combine_chunks() if hasattr(chunked, "combine_chunks") else chunked
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.chunk(0) if arr.num_chunks == 1 else pa.concat_arrays(arr.chunks)
+    valid = None
+    if arr.null_count:
+        valid = ~np.asarray(arr.is_null())
+    t = arr.type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        arr = arr.dictionary_encode()
+        t = arr.type
+    if pa.types.is_dictionary(t):
+        raw_dict = np.asarray(arr.dictionary.to_pylist(), dtype=str)
+        codes = np.asarray(pc.fill_null(arr.indices, 0)).astype(np.int32)
+        sorted_dict, remap = np.unique(raw_dict, return_inverse=True)
+        codes = remap.astype(np.int32)[codes]
+        return codes, valid, DataType(Type.STRING), sorted_dict
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        data = np.asarray(arr.cast(pa.timestamp("ns")).fill_null(0)).astype(np.int64)
+        return data, valid, DataType(Type.TIMESTAMP), None
+    if pa.types.is_boolean(t):
+        data = np.asarray(arr.fill_null(False))
+        return data, valid, DataType(Type.BOOL), None
+    if pa.types.is_floating(t):
+        data = np.asarray(arr.fill_null(0.0))
+        return data, valid, DataType.from_numpy_dtype(data.dtype), None
+    if pa.types.is_integer(t):
+        data = np.asarray(arr.fill_null(0))
+        return data, valid, DataType.from_numpy_dtype(data.dtype), None
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def promote_encoded_shards(shards: List["OrderedDict[str, Tuple]"]) -> None:
+    """When per-shard encoding/inference disagrees on a column's logical
+    type, promote every shard to a common type in place (numeric mix ->
+    float64; any string -> string with numbers re-formatted). Without this,
+    one shard's dictionary codes would sit next to another shard's integer
+    values. (Reference: each rank's Arrow table must share a schema.)"""
+    if not shards:
+        return
+    live = [s for s in shards if s is not None]
+    for name in list(live[0].keys()):
+        types = {s[name][2].type for s in live}
+        if len(types) == 1:
+            continue
+        if Type.STRING in types:
+            for s in live:
+                data, valid, dtype, _d = s[name]
+                if dtype.type == Type.STRING:
+                    continue
+                if dtype.type == Type.BOOL:
+                    vals = np.where(data.astype(bool), "true", "false")
+                elif dtype.type == Type.DOUBLE:
+                    vals = np.array([repr(float(x)) for x in data])
+                else:
+                    vals = np.array([str(int(x)) for x in data])
+                dic, codes = np.unique(np.asarray(vals, str), return_inverse=True)
+                s[name] = (codes.astype(np.int32), valid, DataType(Type.STRING), dic)
+        else:
+            for s in live:
+                data, valid, dtype, _d = s[name]
+                if dtype.type == Type.DOUBLE:
+                    continue
+                s[name] = (data.astype(np.float64), valid, DataType(Type.DOUBLE), None)
+
+
+def unify_encoded_shards(shards: List["OrderedDict[str, Tuple]"]) -> None:
+    """Promote disagreeing types, then remap per-shard dictionary codes onto
+    the union dictionary in place, so string columns from different shards
+    compare/hash consistently."""
+    promote_encoded_shards(shards)
+    live = [s for s in shards if s is not None]
+    if not live:
+        return
+    for name in list(live[0].keys()):
+        if not live[0][name][2].is_dictionary:
+            continue
+        dicts = [s[name][3] for s in live]
+        union = dicts[0]
+        for d in dicts[1:]:
+            union = np.union1d(union, d)
+        for s in live:
+            data, valid, dtype, d = s[name]
+            remap = np.searchsorted(union, d).astype(np.int32)
+            codes = remap[data] if len(d) else data
+            s[name] = (codes, valid, dtype, union)
+
 
 def _check_join_count(totals: np.ndarray, shadows: np.ndarray) -> None:
     """Reject joins whose per-shard output count wrapped int32 (see

@@ -39,6 +39,19 @@ def assert_set_equal(got: ct.Table, expect: ct.Table):
     assert bwd.row_count == 0, f"{bwd.row_count} rows in golden but not result"
 
 
+def assert_multiset_equal(got: ct.Table, expect: ct.Table, columns):
+    """Full multiset comparison (no dedup): sort both frames on all columns
+    and compare exactly, so wrong duplicate multiplicities fail. Stronger
+    than the reference's count+Subtract check (test_utils.hpp:37-59), which
+    a swapped-multiplicity bug could pass."""
+    gp = got.to_pandas()[columns]
+    ep = expect.to_pandas()[columns]
+    assert len(gp) == len(ep), (len(gp), len(ep))
+    gs = gp.sort_values(columns).reset_index(drop=True)
+    es = ep.sort_values(columns).reset_index(drop=True)
+    pd.testing.assert_frame_equal(gs, es, check_dtype=False)
+
+
 @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
 def test_golden_join(world_ctx, how):
     a = _inputs(world_ctx, 1)
@@ -47,13 +60,9 @@ def test_golden_join(world_ctx, how):
     expect = _golden(world_ctx, f"join_{how}")
     # join emits k twice (k_x/k_y); pandas merges them — align schemas
     got = got.rename({"k_x": "k"}).drop(["k_y"]) if "k_x" in got.column_names else got
-    expect = expect[:] if False else expect
     assert got.row_count == expect.row_count
     common = [c for c in expect.column_names if c in got.column_names]
-    assert_set_equal(
-        got.project(common).distributed_unique(),
-        expect.project(common).distributed_unique(),
-    )
+    assert_multiset_equal(got, expect, common)
 
 
 def test_golden_union(world_ctx):

@@ -1,0 +1,173 @@
+"""Per-shard ingestion / per-rank IO / typed Arrow interop tests
+(VERDICT round-1 items 3, 6, 9).
+
+The reference's ingest model is each MPI rank reading only its partition
+(table.cpp:791-829); the round-1 repo materialized the whole global table in
+one host buffer first. These tests pin the O(one shard) staging behavior,
+the per-rank write paths, the typed (no-pandas) Arrow bridge, and the
+device-side take/equals.
+"""
+import os
+import tracemalloc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.io.parquet import read_parquet, write_parquet
+
+
+def test_from_shards_no_global_buffer(devices):
+    """Peak host allocation during per-shard ingest stays O(one shard), not
+    O(global table): 8 shards x 4 MB must not allocate a ~32 MB buffer."""
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:8]))
+    n_per = 500_000  # 4 MB per shard as int64
+    shards = [
+        {"v": np.arange(i * n_per, (i + 1) * n_per, dtype=np.int64)}
+        for i in range(8)
+    ]
+    tracemalloc.start()
+    t = ct.Table.from_shards(ctx, shards)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    global_bytes = 8 * n_per * 8
+    assert peak < global_bytes / 2, f"peak host alloc {peak} ~ global {global_bytes}"
+    assert t.row_count == 8 * n_per
+    assert t.row_counts.tolist() == [n_per] * 8
+    # content spot check per shard
+    assert int(t.min("v")) == 0 and int(t.max("v")) == 8 * n_per - 1
+
+
+def test_from_shards_string_dictionary_unify(devices):
+    """Per-shard encoding with per-shard dictionaries must still rendezvous
+    equal strings (cross-shard dictionary union)."""
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+    shards = [
+        {"s": np.array(["b", "a"] * 3), "v": np.arange(6)},
+        {"s": np.array(["c", "b"] * 3), "v": np.arange(6)},
+        {"s": np.array(["a", "d"] * 3), "v": np.arange(6)},
+        {"s": np.array(["d", "c"] * 3), "v": np.arange(6)},
+    ]
+    t = ct.Table.from_shards(ctx, shards)
+    g = t.distributed_groupby("s", {"v": "count"})
+    gp = g.to_pandas().sort_values("s").reset_index(drop=True)
+    assert gp["s"].tolist() == ["a", "b", "c", "d"]
+    assert gp["v_count"].tolist() == [6, 6, 6, 6]
+
+
+def test_per_rank_csv_write_read_roundtrip(devices, tmp_path, rng):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+    n = 1000
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 50, n).astype(np.int64),
+         "v": rng.normal(size=n),
+         "s": np.array([f"name_{i % 7}" for i in range(n)])},
+    )
+    paths = [str(tmp_path / f"part_{i}.csv") for i in range(4)]
+    ct.write_csv(t, paths)
+    for i, p in enumerate(paths):
+        assert os.path.exists(p)
+        assert len(pd.read_csv(p)) == t.row_counts[i]
+    back = ct.read_csv(ctx, paths)
+    assert back.row_counts.tolist() == t.row_counts.tolist()
+    a = t.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    b = back.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False, rtol=1e-12)
+
+
+def test_per_rank_parquet_write_read_roundtrip(devices, tmp_path, rng):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+    n = 800
+    vals = rng.normal(size=n)
+    vals[::13] = np.nan  # nulls survive parquet round trip
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 50, n).astype(np.int32),
+         "v": vals,
+         "s": np.array([f"s{i % 5}" for i in range(n)])},
+    )
+    paths = [str(tmp_path / f"part_{i}.parquet") for i in range(4)]
+    write_parquet(t, paths)
+    back = read_parquet(ctx, paths)
+    assert back.row_counts.tolist() == t.row_counts.tolist()
+    a = t.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    b = back.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False, rtol=1e-12)
+
+
+def test_typed_arrow_roundtrip(devices):
+    """to_arrow/from_arrow keep types: int64 with nulls stays integral
+    (pandas bounce would float64 it), dictionary columns export codes."""
+    import pyarrow as pa
+
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:2]))
+    at = pa.table(
+        {
+            "i": pa.array([1, None, 3, 4], type=pa.int64()),
+            "f": pa.array([1.5, 2.5, None, 4.5]),
+            "s": pa.array(["x", "y", None, "x"]),
+            "b": pa.array([True, False, True, None]),
+        }
+    )
+    t = ct.Table.from_arrow(ctx, at)
+    assert t.column("i").dtype.is_numeric and not t.column("i").dtype.is_floating
+    back = t.to_arrow()
+    assert back.column("i").type == pa.int64()
+    assert pa.types.is_dictionary(back.column("s").type)
+    assert back.column("i").null_count == 1
+    assert back.column("s").null_count == 1
+    assert back.column("i").to_pylist() == [1, None, 3, 4]
+    assert back.column("s").to_pylist() == ["x", "y", None, "x"]
+    assert back.column("b").to_pylist() == [True, False, True, None]
+
+
+def test_take_device_gather(devices, rng):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+    n = 400
+    v = rng.normal(size=n)
+    s = np.array([f"r{i % 9}" for i in range(n)])
+    t = ct.Table.from_pydict(ctx, {"v": v, "s": s})
+    idx = rng.permutation(n)[:123]
+    got = t.take(idx).to_pandas()
+    exp = pd.DataFrame({"v": v, "s": s}).iloc[idx].reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    # negative indices wrap like numpy
+    got2 = t.take([-1, 0]).to_pandas()
+    assert got2["v"].tolist() == [v[-1], v[0]]
+    with pytest.raises(IndexError):
+        t.take([n])
+
+
+def test_equals_device_paths(devices, rng):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+    n = 300
+    k = rng.integers(0, 20, n).astype(np.int32)
+    v = rng.normal(size=n)
+    t1 = ct.Table.from_pydict(ctx, {"k": k, "v": v})
+    t2 = ct.Table.from_pydict(ctx, {"k": k.copy(), "v": v.copy()})
+    assert t1.equals(t2)
+    # same multiset, different order
+    perm = rng.permutation(n)
+    t3 = ct.Table.from_pydict(ctx, {"k": k[perm], "v": v[perm]})
+    assert not t1.equals(t3)
+    assert t1.equals(t3, ordered=False)
+    # wrong multiplicities must fail the unordered compare: duplicate one
+    # row, drop another occurrence of a different row
+    kk, vv = k.copy(), v.copy()
+    kk[0], vv[0] = kk[1], vv[1]
+    t4 = ct.Table.from_pydict(ctx, {"k": kk, "v": vv})
+    assert not t1.equals(t4, ordered=False)
+
+
+def test_equals_with_nulls(devices, rng):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:2]))
+    v = np.array([1.0, np.nan, 3.0, np.nan])
+    t1 = ct.Table.from_pydict(ctx, {"v": v})
+    t2 = ct.Table.from_pydict(ctx, {"v": v.copy()})
+    assert t1.equals(t2)
+    assert t1.equals(t2, ordered=False)
+    t3 = ct.Table.from_pydict(ctx, {"v": np.array([1.0, np.nan, 4.0, np.nan])})
+    assert not t1.equals(t3)
+    assert not t1.equals(t3, ordered=False)
