@@ -95,9 +95,8 @@ def _read_one_native(path: str, options: CSVReadOptions) -> "OrderedDict[str, En
     return out
 
 
-# shared shard-unification helpers (promotion + dictionary union) live on
+# shared shard-unification helper (promotion + dictionary union) lives on
 # Table's module so every per-shard ingest path uses the same rules
-from ..table import promote_encoded_shards as _promote_shard_types  # noqa: E402
 from ..table import unify_encoded_shards as _unify_shards  # noqa: E402
 
 

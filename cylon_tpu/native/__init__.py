@@ -50,6 +50,19 @@ _load_failed = False
 CT_INT64, CT_FLOAT64, CT_BOOL, CT_STRING = 0, 1, 2, 3
 
 
+def _prune_stale(keep: str, prefix: str) -> None:
+    """Unlink hash-named siblings from earlier source versions (each rebuild
+    lands at a new path — see _so_path — and would otherwise accumulate)."""
+    import glob
+
+    for old in glob.glob(os.path.join(_HERE, f"{prefix}-*.so")):
+        if old != keep:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+
 def _build(so: str) -> bool:
     cmd = [
         "g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-pthread",
@@ -60,6 +73,7 @@ def _build(so: str) -> bool:
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
         return False
     os.replace(so + ".tmp", so)
+    _prune_stale(so, "_cylon_native")
     return True
 
 
@@ -84,6 +98,7 @@ def build_capi() -> Optional[str]:
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
         return None
     os.replace(so + ".tmp", so)
+    _prune_stale(so, "_cylon_capi")
     return so
 
 

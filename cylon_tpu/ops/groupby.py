@@ -174,7 +174,10 @@ def aggregate_column(
         order = jnp.lexsort((d, live_ids))
         sid = live_ids[order]
         sval = d[order]
-        starts = jnp.searchsorted(sid, jnp.arange(cap_out), side="left").astype(jnp.int32)
+        # method='sort': the default 'scan' binary search is ~8x slower on TPU
+        starts = jnp.searchsorted(
+            sid, jnp.arange(cap_out), side="left", method="sort"
+        ).astype(jnp.int32)
         q = quantile
         pos = starts.astype(jnp.float64) + q * jnp.maximum(cnt - 1, 0)
         lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, cap - 1)

@@ -30,6 +30,16 @@ import numpy as np
 from .factorize import factorize_two
 from .sort import KeyCol
 
+
+def _ss(sorted_arr, queries, side):
+    """searchsorted with ``method='sort'``: the default 'scan' method is a
+    22-deep binary-search loop that runs ~8x slower than the sort-based
+    rewrite on TPU (measured 690 ms vs 90 ms per 4M x 4M search on v5e)."""
+    return jnp.searchsorted(sorted_arr, queries, side=side, method="sort").astype(
+        jnp.int32
+    )
+
+
 INNER, LEFT, RIGHT, FULL_OUTER = 0, 1, 2, 3
 _JOIN_TYPES = {"inner": INNER, "left": LEFT, "right": RIGHT, "fullouter": FULL_OUTER,
                "outer": FULL_OUTER, "full_outer": FULL_OUTER}
@@ -72,6 +82,7 @@ def _probe(
     nr: jax.Array,
     cap_l: int,
     cap_r: int,
+    need_rcnt: bool = True,
 ) -> _Probe:
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     idx_r = jnp.arange(cap_r, dtype=jnp.int32)
@@ -103,14 +114,16 @@ def _probe(
         r_ids = jnp.where(idx_r < nr, rk, MAXU)
         r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
         r_sorted = r_ids[r_order]
-        lo = jnp.searchsorted(r_sorted, l_ids, side="left").astype(jnp.int32)
-        hi = jnp.searchsorted(r_sorted, l_ids, side="right").astype(jnp.int32)
+        lo = _ss(r_sorted, l_ids, "left")
+        hi = _ss(r_sorted, l_ids, "right")
         pad_r = (cap_r - nr).astype(jnp.int32)
         cnt = hi - lo - jnp.where(l_ids == MAXU, pad_r, 0)
         cnt = jnp.where(idx_l < nl, jnp.maximum(cnt, 0), 0).astype(jnp.int32)
+        if not need_rcnt:
+            return _Probe(lo, cnt, r_order, jnp.zeros((cap_r,), jnp.int32))
         l_sorted = jnp.sort(l_ids)
-        rlo = jnp.searchsorted(l_sorted, r_ids, side="left").astype(jnp.int32)
-        rhi = jnp.searchsorted(l_sorted, r_ids, side="right").astype(jnp.int32)
+        rlo = _ss(l_sorted, r_ids, "left")
+        rhi = _ss(l_sorted, r_ids, "right")
         pad_l = (cap_l - nl).astype(jnp.int32)
         r_cnt = rhi - rlo - jnp.where(r_ids == MAXU, pad_l, 0)
         r_cnt = jnp.where(idx_r < nr, jnp.maximum(r_cnt, 0), 0).astype(jnp.int32)
@@ -121,22 +134,29 @@ def _probe(
     r_ids = jnp.where(idx_r < nr, r_ids, big)
     r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
     r_sorted = r_ids[r_order]
-    lo = jnp.searchsorted(r_sorted, l_ids, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(r_sorted, l_ids, side="right").astype(jnp.int32)
+    lo = _ss(r_sorted, l_ids, "left")
+    hi = _ss(r_sorted, l_ids, "right")
     cnt = jnp.where(idx_l < nl, hi - lo, 0).astype(jnp.int32)
+    if not need_rcnt:
+        return _Probe(lo, cnt, r_order, jnp.zeros((cap_r,), jnp.int32))
     l_sorted = jnp.sort(l_ids)
-    rlo = jnp.searchsorted(l_sorted, r_ids, side="left").astype(jnp.int32)
-    rhi = jnp.searchsorted(l_sorted, r_ids, side="right").astype(jnp.int32)
+    rlo = _ss(l_sorted, r_ids, "left")
+    rhi = _ss(l_sorted, r_ids, "right")
     r_cnt = jnp.where(idx_r < nr, rhi - rlo, 0).astype(jnp.int32)
     return _Probe(lo, cnt, r_order, r_cnt)
 
 
 def probe_arrays(
-    l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int
+    l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int, how: int = FULL_OUTER
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Phase-1 kernel surface: returns the static-shaped probe state
-    (lo, cnt, r_order, r_cnt) so the emit phase need not recompute the sorts."""
-    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    (lo, cnt, r_order, r_cnt) so the emit phase need not recompute the sorts.
+    For INNER/LEFT joins r_cnt is unused downstream and is returned as zeros,
+    skipping one sort and two sorted searches."""
+    p = _probe(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r,
+        need_rcnt=how in (RIGHT, FULL_OUTER),
+    )
     return (p.lo, p.cnt, p.r_order, p.r_cnt)
 
 
@@ -177,10 +197,11 @@ def emit_from_probe(
     total_l = jnp.sum(cnt_adj).astype(jnp.int32)
 
     li = jnp.repeat(idx_l, cnt_adj, total_repeat_length=cap_out)
-    offs_rep = jnp.repeat(offs, cnt_adj, total_repeat_length=cap_out)
-    within = jnp.arange(cap_out, dtype=jnp.int32) - offs_rep
+    # rpos = lo[li] + (k - offs[li]) = (lo - offs)[li] + k: one gather of the
+    # precombined base instead of a second repeat + a second gather
+    base = lo - offs
     has_match = cnt[li] > 0
-    rpos = jnp.clip(lo[li] + within, 0, cap_r - 1)
+    rpos = jnp.clip(base[li] + jnp.arange(cap_out, dtype=jnp.int32), 0, cap_r - 1)
     ri = jnp.where(has_match, r_order[rpos], -1)
     out_pos = jnp.arange(cap_out, dtype=jnp.int32)
     in_left_part = out_pos < total_l
@@ -210,7 +231,10 @@ def join_count(
     how: int,
 ) -> jax.Array:
     """Exact number of output rows for the given join type (scalar int32)."""
-    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    p = _probe(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r,
+        need_rcnt=how in (RIGHT, FULL_OUTER),
+    )
     return count_from_probe(p.cnt, p.r_cnt, nl, nr, how)
 
 
@@ -230,8 +254,72 @@ def join_emit(
     means "no row on this side" (outer joins). Rows >= n_out are padding.
     ``cap_out`` must be >= the corresponding :func:`join_count`.
     """
-    p = _probe(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    p = _probe(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r,
+        need_rcnt=how in (RIGHT, FULL_OUTER),
+    )
     return emit_from_probe(p.lo, p.cnt, p.r_order, p.r_cnt, nl, nr, how, cap_out)
+
+
+def emit_gather(
+    lo, cnt, r_order, r_cnt,
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl, nr, how: int, cap_out: int,
+) -> Tuple[list, jax.Array]:
+    """Fused emit + payload gather: produce the joined output columns with a
+    minimal number of XLA gathers (the TPU bottleneck — see ops/gather.py).
+
+    INNER/LEFT fast path does exactly three big gathers: the ``jnp.repeat``
+    for li, one packed left-row gather (payload + base/cnt lanes), and one
+    packed right-row gather against the r_order-permuted right payload (which
+    also yields ri through an extra lane). RIGHT/FULL_OUTER falls back to
+    :func:`emit_from_probe` indices + two packed gathers (the unmatched-right
+    scatter does not fuse).
+
+    Returns (out_cols = left ++ right as (data, valid), n_out scalar).
+    """
+    from .gather import pack_gather
+
+    if how in (RIGHT, FULL_OUTER):
+        li, ri, n_out = emit_from_probe(
+            lo, cnt, r_order, r_cnt, nl, nr, how, cap_out
+        )
+        out_l, _ = pack_gather(l_cols, li)
+        out_r, _ = pack_gather(r_cols, ri)
+        return out_l + out_r, n_out
+
+    cap_l = lo.shape[0]
+    cap_r = r_order.shape[0]
+    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
+    live_l = idx_l < nl
+    if how == LEFT:
+        cnt_adj = jnp.where(live_l & (cnt == 0), 1, cnt)
+    else:
+        cnt_adj = cnt
+    offs = jnp.cumsum(cnt_adj) - cnt_adj
+    total_l = jnp.sum(cnt_adj).astype(jnp.int32)
+    base = lo - offs
+
+    li = jnp.repeat(idx_l, cnt_adj, total_repeat_length=cap_out)
+    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
+    li = jnp.where(out_pos < total_l, li, -1)
+    out_l, (base_g, cnt_g) = pack_gather(l_cols, li, extra_lanes=[base, cnt])
+
+    # permute right payload into key-sorted order once (cap_r rows), then one
+    # packed gather at rpos delivers the whole right half of the output row.
+    # r_order is a permutation (all indices >= 0), so columns that had no
+    # validity mask stay mask-free — don't let the all-True ok lane ride
+    # through the second (hot, cap_out-sized) gather.
+    r_sorted_cols, _ = pack_gather(r_cols, r_order)
+    r_sorted_cols = [
+        (d, None if rv is None else v)
+        for (d, v), (_, rv) in zip(r_sorted_cols, r_cols)
+    ]
+    has_match = (li >= 0) & (cnt_g > 0)
+    rpos = jnp.where(has_match, jnp.clip(base_g + out_pos, 0, cap_r - 1), -1)
+    out_r, _ = pack_gather(r_sorted_cols, rpos)
+    return out_l + out_r, total_l
 
 
 def gather_column(

@@ -109,14 +109,13 @@ def join_shard(
     cap_l = lk[0][0].shape[0]
     cap_r = rk[0][0].shape[0]
     lo, cnt, r_order, r_cnt = _j.probe_arrays(
-        lk, rk, left.n, right.n, cap_l, cap_r
+        lk, rk, left.n, right.n, cap_l, cap_r, how
     )
     needed = _j.count_from_probe(cnt, r_cnt, left.n, right.n, how)
-    li, ri, n_out = _j.emit_from_probe(
-        lo, cnt, r_order, r_cnt, left.n, right.n, how, join_cap
+    out, n_out = _j.emit_gather(
+        lo, cnt, r_order, r_cnt, left.cols, right.cols,
+        left.n, right.n, how, join_cap,
     )
-    out = [_j.gather_column(d, v, li) for d, v in left.cols]
-    out += [_j.gather_column(d, v, ri) for d, v in right.cols]
     overflow = jnp.maximum(needed - join_cap, 0)
     return ShardTable(tuple(out), jnp.minimum(n_out, join_cap)), overflow
 

@@ -157,12 +157,21 @@ class Table:
         for name in names:
             dtype = ref[name][2]
             dictionary = ref[name][3]
-            phys_dt = np.result_type(
-                *[shards[i][name][0].dtype for i in local if shards[i] is not None]
-            )
-            has_valid = any(
-                shards[i][name][1] is not None for i in local if shards[i] is not None
-            )
+            if len(local) == world:
+                # single-host: cheap data-dependent choices are safe
+                phys_dt = np.result_type(
+                    *[shards[i][name][0].dtype for i in local if shards[i] is not None]
+                )
+                has_valid = any(
+                    shards[i][name][1] is not None for i in local if shards[i] is not None
+                )
+            else:
+                # multi-host: every process must make IDENTICAL choices or the
+                # global-array construction diverges across hosts (hang /
+                # dtype mismatch), so derive both from the declared DataType,
+                # never from this host's local data
+                phys_dt = dtype.physical_dtype
+                has_valid = True
             blocks, vblocks = [], []
             for i in local:
                 phys, valid, dt, _dic = shards[i][name]
@@ -580,7 +589,13 @@ class Table:
         for i in range(world):
             full[i * cap_out : i * cap_out + counts[i]] = phys[o[i] : o[i + 1]]
         idx_dev = jax.device_put(full, self.ctx.sharding)
-        gather = jax.jit(lambda d, i: d[i], out_shardings=self.ctx.sharding)
+        # one cached jitted gather per context (a fresh jax.jit each call
+        # would retrace + recompile every take())
+        cache = self.ctx.__dict__.setdefault("_jit_cache", {})
+        gather = cache.get(("take_gather",))
+        if gather is None:
+            gather = jax.jit(lambda d, i: d[i], out_shardings=self.ctx.sharding)
+            cache[("take_gather",)] = gather
         cols: "OrderedDict[str, Column]" = OrderedDict()
         for n, c in self._columns.items():
             d = gather(c.data, idx_dev)
@@ -865,28 +880,34 @@ class Table:
                     cl = lk[0][0].shape[0]
                     cr = rk[0][0].shape[0]
                     lo, cnt, r_order, r_cnt = _j.probe_arrays(
-                        lk, rk, nl[0], nr[0], cl, cr
+                        lk, rk, nl[0], nr[0], cl, cr, howi
                     )
                     total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
                     shadow = _j.count_overflow_check(cnt, r_cnt)
-                    li, ri, _ = _j.emit_from_probe(
-                        lo, cnt, r_order, r_cnt, nl[0], nr[0], howi, co
+                    out, _ = _j.emit_gather(
+                        lo, cnt, r_order, r_cnt, lcols, rcols,
+                        nl[0], nr[0], howi, co,
                     )
-                    out = [_j.gather_column(d, v, li) for d, v in lcols]
-                    out += [_j.gather_column(d, v, ri) for d, v in rcols]
-                    return out, _scalar(total), _scalar(shadow)
+                    # pack count + f32 overflow shadow into one [2] i32 lane
+                    # so the host needs a single fetch
+                    stats = jnp.stack(
+                        [total, jax.lax.bitcast_convert_type(shadow, jnp.int32)]
+                    )
+                    return out, stats
 
                 return kern
 
             with span("join.speculative", rows=int(self.row_count)):
-                out, totals, shadows = get_kernel(
+                out, stats = get_kernel(
                     self.ctx, key + ("spec",), build_spec
                 )(
                     (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
                     (jnp.zeros((spec_cap,), jnp.int8),),
                 )
-                totals = self._out_counts(totals)
-            _check_join_count(totals, np.asarray(shadows))
+                stats = np.asarray(stats).reshape(-1, 2)
+                totals = stats[:, 0].astype(np.int64)
+                shadows = stats[:, 1].copy().view(np.float32)
+            _check_join_count(totals, shadows)
             if totals.max() <= spec_cap:
                 res = self._rebuild_cols(
                     list(zip(out_names, src_cols)), out, totals, spec_cap
@@ -905,7 +926,7 @@ class Table:
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
                 lo, cnt, r_order, r_cnt = _j.probe_arrays(
-                    lk, rk, nl[0], nr[0], cap_l, cap_r
+                    lk, rk, nl[0], nr[0], cap_l, cap_r, howi
                 )
                 total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
                 shadow = _j.count_overflow_check(cnt, r_cnt)
@@ -926,11 +947,10 @@ class Table:
                 (lo, cnt, r_order, r_cnt, lcols, rcols, nl, nr) = dp
                 (dummy,) = rep
                 co = dummy.shape[0]
-                li, ri, n_out = _j.emit_from_probe(
-                    lo, cnt, r_order, r_cnt, nl[0], nr[0], howi, co
+                out, n_out = _j.emit_gather(
+                    lo, cnt, r_order, r_cnt, lcols, rcols,
+                    nl[0], nr[0], howi, co,
                 )
-                out = [_j.gather_column(d, v, li) for d, v in lcols]
-                out += [_j.gather_column(d, v, ri) for d, v in rcols]
                 return out, _scalar(n_out)
 
             return kern
