@@ -40,10 +40,13 @@ from .frame import concat as concat_frames  # noqa: E402
 from .table import Table, concat, merge  # noqa: E402
 from . import compute  # noqa: E402
 from .series import Series  # noqa: E402
+from . import indexing  # noqa: E402
 from .indexing.index import (  # noqa: E402
     CategoricalIndex,
+    HashIndex,
     Index,
     IntegerIndex,
+    LinearIndex,
     NumericIndex,
     PyRangeIndex,
 )
@@ -54,7 +57,10 @@ __all__ = [
     "CategoricalIndex",
     "Column",
     "CommConfig",
+    "HashIndex",
     "Index",
+    "LinearIndex",
+    "indexing",
     "IntegerIndex",
     "NumericIndex",
     "PyRangeIndex",

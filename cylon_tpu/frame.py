@@ -59,6 +59,17 @@ class CylonEnv:
 _default_local_ctx: Optional[CylonContext] = None
 
 
+def _check_mode(mode: str, env: Optional[CylonEnv]) -> None:
+    """Reject silently-ignored execution modes: 'fused' needs a distributed
+    env, and unknown modes should error here, not deep in Table."""
+    if mode == "eager":
+        return
+    if mode != "fused":
+        raise ValueError(f"unknown join mode {mode!r}")
+    if env is None or not env.is_distributed:
+        raise ValueError("mode='fused' requires a distributed env= argument")
+
+
 def _local_ctx() -> CylonContext:
     global _default_local_ctx
     if _default_local_ctx is None:
@@ -153,14 +164,30 @@ class DataFrame:
             return self._wrap(self._table.filter(key._table))
         raise TypeError(f"unsupported key {key!r}")
 
-    def __setitem__(self, key: str, value):
+    def __setitem__(self, key, value):
+        if isinstance(key, DataFrame):
+            # mask-assign: df[df['a'] > 5] = 0 (pycylon mask-__setitem__)
+            self._table = self._table.mask(key._table, value)
+            return
         if isinstance(value, DataFrame):
             col = next(iter(value._table._columns.values()))
         elif isinstance(value, Column):
             col = value
         else:
-            raise TypeError("assign a DataFrame single column")
+            t = self._table
+            t[key] = value  # Table.__setitem__ encodes host arrays/scalars
+            self._table = t
+            return
         self._table = self._table.add_column(key, col)
+
+    def where(self, cond: "DataFrame", other=None) -> "DataFrame":
+        return self._wrap(self._table.where(cond._table if isinstance(cond, DataFrame) else cond, other))
+
+    def mask(self, cond: "DataFrame", other=None) -> "DataFrame":
+        return self._wrap(self._table.mask(cond._table if isinstance(cond, DataFrame) else cond, other))
+
+    def iterrows(self):
+        return self._table.iterrows()
 
     def drop(self, columns: Sequence[str]) -> "DataFrame":
         return self._wrap(self._table.drop(columns))
@@ -237,15 +264,21 @@ class DataFrame:
         rsuffix: str = "r",
         algorithm: str = "sort",
         env: Optional[CylonEnv] = None,
+        mode: str = "eager",
     ) -> "DataFrame":
         """pandas.DataFrame.join flavor (suffix-renames both sides,
-        reference frame.py:1115-1226)."""
+        reference frame.py:1115-1226). ``mode='fused'`` compiles the whole
+        distributed shuffle->join into one XLA program (see
+        Table.distributed_join)."""
         t = self._retarget(env)
         o = other._retarget(env)
         suff = (f"_{lsuffix}", f"_{rsuffix}")
+        _check_mode(mode, env)
         if env is not None and env.is_distributed:
             return self._wrap(
-                t.distributed_join(o, on=on, how=how, suffixes=suff, algorithm=algorithm)
+                t.distributed_join(
+                    o, on=on, how=how, suffixes=suff, algorithm=algorithm, mode=mode
+                )
             )
         return self._wrap(t.join(o, on=on, how=how, suffixes=suff, algorithm=algorithm))
 
@@ -259,12 +292,16 @@ class DataFrame:
         suffixes: Tuple[str, str] = ("_x", "_y"),
         algorithm: str = "sort",
         env: Optional[CylonEnv] = None,
+        mode: str = "eager",
     ) -> "DataFrame":
         """pandas.merge semantics: with ``on=``, output carries ONE key
         column (coalesced for outer joins). Reference frame.py:1244+."""
         t = self._retarget(env)
         o = right._retarget(env)
         kwargs = dict(how=how, suffixes=suffixes, algorithm=algorithm)
+        _check_mode(mode, env)
+        if env is not None and env.is_distributed and mode != "eager":
+            kwargs["mode"] = mode
         if on is not None:
             kwargs["on"] = on
         else:

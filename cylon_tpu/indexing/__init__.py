@@ -1,2 +1,13 @@
-from .index import BaseIndex, ColumnIndex, RangeIndex  # noqa: F401
+from .index import (  # noqa: F401
+    BaseIndex,
+    CategoricalIndex,
+    ColumnIndex,
+    HashIndex,
+    Index,
+    IntegerIndex,
+    LinearIndex,
+    NumericIndex,
+    PyRangeIndex,
+    RangeIndex,
+)
 from .indexer import ILocIndexer, LocIndexer  # noqa: F401

@@ -67,6 +67,157 @@ class ColumnIndex(BaseIndex):
         return f"ColumnIndex({self._name!r})"
 
 
+def encode_lookup_values(
+    dictionary: Optional[np.ndarray], phys_dtype, values
+) -> np.ndarray:
+    """Host lookup values -> physical device/host-comparable values. The ONE
+    implementation shared by the eager loc path (indexer._encode_values) and
+    the built HashIndex/LinearIndex.
+
+    Dictionary misses encode to -1 (codes are >= 0, matches nothing).
+    Numeric values that do not round-trip through the physical dtype (e.g. a
+    3.5 probe against an int64 index) map to a no-match the caller detects as
+    missing — pandas raises KeyError for those, never aliases to 3."""
+    vals = np.asarray(values)
+    if dictionary is not None:
+        pos = np.searchsorted(dictionary, vals)
+        pos = np.clip(pos, 0, max(len(dictionary) - 1, 0))
+        hit = (
+            dictionary[pos] == vals
+            if len(dictionary)
+            else np.zeros(len(vals), bool)
+        )
+        return np.where(hit, pos, -1).astype(np.int32)
+    enc = vals.astype(phys_dtype)
+    bad = enc.astype(np.float64) != np.asarray(vals, np.float64)
+    if bad.any():
+        if np.issubdtype(np.dtype(phys_dtype), np.floating):
+            # float index: a non-representable probe simply matches nothing
+            enc = np.where(bad, np.asarray(np.nan, phys_dtype), enc)
+        else:
+            # integer index: park misses at the dtype minimum only when that
+            # value cannot be a live key... there is no spare code, so raise
+            raise KeyError(
+                f"lookup values not representable in index dtype "
+                f"{np.dtype(phys_dtype)}: {vals[bad][:5].tolist()}"
+            )
+    return enc
+
+
+class HashIndex(BaseIndex):
+    """Build-once value -> row-positions lookup over a table's index column
+    (reference typed ``HashIndex``, indexing/index.hpp:82-360: a hash multimap
+    built once and reused across loc calls). TPU-native design: the multimap
+    is a SORTED view (argsort of the index values + binary search), giving
+    O(log n) batched probes with exact duplicate runs — the device/columnar
+    equivalent of the reference's unordered_multimap buckets.
+
+    Construction gathers the index column once to the host (the reference's
+    build is likewise a full host-side pass, index_utils.cpp)."""
+
+    def __init__(self, table, column_name: Optional[str] = None):
+        name = column_name or table.index_name
+        if name is None:
+            raise ValueError("HashIndex requires an index column")
+        self._name = name
+        values, valid = table._host_physical(name)
+        col = table.column(name)
+        self._dictionary = col.dictionary  # None for numeric
+        self._phys_dtype = values.dtype
+        # null index entries are unreachable by value lookup (their physical
+        # payload is garbage): exclude them from the sorted view entirely
+        positions = np.arange(len(values), dtype=np.int64)
+        if valid is not None:
+            values = values[valid]
+            positions = positions[valid]
+        order = np.argsort(values, kind="stable")
+        self._sorted = values[order]
+        self._positions = positions[order]
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _encode(self, values) -> np.ndarray:
+        return encode_lookup_values(self._dictionary, self._phys_dtype, values)
+
+    def get_loc(self, value) -> np.ndarray:
+        """All row positions holding ``value`` (ascending)."""
+        v = self._encode([value])[0]
+        lo = np.searchsorted(self._sorted, v, side="left")
+        hi = np.searchsorted(self._sorted, v, side="right")
+        return np.sort(self._positions[lo:hi])
+
+    def loc_positions(self, values) -> np.ndarray:
+        """Row positions for a batch of lookups, in REQUEST order with
+        duplicate index entries expanded (pandas loc list semantics).
+        Raises KeyError on a missing value, like pandas."""
+        enc = self._encode(values)
+        lo = np.searchsorted(self._sorted, enc, side="left")
+        hi = np.searchsorted(self._sorted, enc, side="right")
+        if (lo == hi).any():
+            missing = np.asarray(values)[lo == hi]
+            raise KeyError(f"index values not found: {missing[:5].tolist()}")
+        return np.concatenate(
+            [np.sort(self._positions[a:b]) for a, b in zip(lo, hi)]
+        )
+
+    def __contains__(self, value) -> bool:
+        try:
+            v = self._encode([value])[0]
+        except KeyError:
+            return False
+        lo = np.searchsorted(self._sorted, v, side="left")
+        hi = np.searchsorted(self._sorted, v, side="right")
+        return bool(hi > lo)
+
+    def __repr__(self):
+        return f"HashIndex({self._name!r}, n={len(self._sorted)})"
+
+
+class LinearIndex(HashIndex):
+    """Reference ``LinearIndex`` (index.hpp:395+): same lookup surface as
+    HashIndex but built lazily with linear scans — cheaper to construct,
+    slower to probe. Here construction skips the argsort; probes scan."""
+
+    def __init__(self, table, column_name: Optional[str] = None):
+        name = column_name or table.index_name
+        if name is None:
+            raise ValueError("LinearIndex requires an index column")
+        self._name = name
+        values, valid = table._host_physical(name)
+        col = table.column(name)
+        self._dictionary = col.dictionary
+        self._valid = valid
+        self._values = values
+        self._phys_dtype = values.dtype
+
+    def get_loc(self, value) -> np.ndarray:
+        v = self._encode([value])[0]
+        hit = self._values == v
+        if self._valid is not None:
+            hit = hit & self._valid
+        return np.nonzero(hit)[0].astype(np.int64)
+
+    def loc_positions(self, values) -> np.ndarray:
+        parts = []
+        for v in np.asarray(values):
+            p = self.get_loc(v)
+            if len(p) == 0:
+                raise KeyError(f"index value not found: {v!r}")
+            parts.append(p)
+        return np.concatenate(parts) if parts else np.empty(0, np.int64)
+
+    def __contains__(self, value) -> bool:
+        try:
+            return len(self.get_loc(value)) > 0
+        except KeyError:
+            return False
+
+    def __repr__(self):
+        return f"LinearIndex({self._name!r}, n={len(self._values)})"
+
+
 # --- python-facing index hierarchy (reference python/pycylon/index.py:26-126:
 # Index / NumericIndex / IntegerIndex / RangeIndex(start,stop,step) /
 # CategoricalIndex / ColumnIndex). These wrap host-side index VALUES the way

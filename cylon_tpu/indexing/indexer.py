@@ -42,15 +42,12 @@ def _index_column(table):
 
 
 def _encode_values(col, values):
-    """Host values -> physical device-comparable values for the column.
-    Dictionary misses encode to -1 (matches nothing: codes are >= 0)."""
-    vals = np.asarray(values)
-    if col.dtype.is_dictionary:
-        pos = np.searchsorted(col.dictionary, vals)
-        pos = np.clip(pos, 0, max(len(col.dictionary) - 1, 0))
-        hit = col.dictionary[pos] == vals
-        return np.where(hit, pos, -1).astype(np.int32)
-    return vals.astype(col.data.dtype)
+    """Host values -> physical device-comparable values for the column
+    (shared implementation: indexing.index.encode_lookup_values)."""
+    from .index import encode_lookup_values
+
+    dictionary = col.dictionary if col.dtype.is_dictionary else None
+    return encode_lookup_values(dictionary, np.dtype(col.data.dtype), values)
 
 
 def _encode_bound(col, value, side: str):
@@ -88,11 +85,20 @@ class LocIndexer:
                 mask = m if mask is None else (mask & m)
             if mask is None:
                 return t
+        elif _is_bool_mask(rows):
+            # boolean-mask mode (pandas loc[df['a'] > 0])
+            return t.filter(self._t._as_mask(rows))
         else:
             scalar = np.isscalar(rows) or isinstance(rows, str)
             vals = [rows] if scalar else list(rows)
             if len(vals) == 0:
                 return t.filter(jnp.zeros(col.data.shape, bool))
+            built = getattr(self._t, "_built_index", None)
+            if built is not None and built[0][1] == self._t.index_name:
+                # build-once index: positions in request order with duplicate
+                # index entries expanded — exact pandas loc list semantics
+                positions = built[1].loc_positions(vals)
+                return t.take(positions)
             enc = np.sort(_encode_values(col, vals))
             dev = jnp.asarray(enc)
             pos = jnp.searchsorted(dev, col.data)
@@ -120,6 +126,8 @@ class ILocIndexer:
                 mask = (gpos >= start) & (gpos < stop)
             else:
                 mask = (gpos >= start) & (gpos < stop) & ((gpos - start) % step == 0)
+        elif _is_bool_mask(rows):
+            return t.filter(self._t._as_mask(rows))
         elif np.isscalar(rows):
             p = int(rows)
             if p < 0:
@@ -139,6 +147,17 @@ class ILocIndexer:
             pos = jnp.clip(jnp.searchsorted(dev, gpos), 0, len(vals) - 1)
             mask = dev[pos] == gpos
         return t.filter(mask)
+
+
+def _is_bool_mask(rows) -> bool:
+    """Boolean-mask loc/iloc mode: Table/Column of bools or a bool ndarray."""
+    from ..column import Column
+    from ..table import Table
+
+    if isinstance(rows, (Table, Column)):
+        c = next(iter(rows._columns.values())) if isinstance(rows, Table) else rows
+        return bool(np.dtype(c.data.dtype) == np.bool_)
+    return isinstance(rows, np.ndarray) and rows.dtype == np.bool_
 
 
 def _split_item(item):

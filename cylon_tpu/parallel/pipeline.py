@@ -152,7 +152,9 @@ def make_distributed_join_step(
         else:
             ovl = ovr = jnp.int32(0)
         jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
-        overflow = (ovl + ovr + ovj).reshape(1)
+        # overflow lanes: [shuffle rows unsent, join rows past join_cap] —
+        # the join lane is EXACT so a retry can size join_cap in one step
+        overflow = jnp.stack([ovl + ovr, ovj])
         return list(jt.cols), jt.n.reshape(1), overflow
 
     return jax.jit(

@@ -43,6 +43,7 @@ KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
 # single-dispatch speculative join (see Table.join); CYLON_TPU_EXACT_JOIN=1
 # forces the exact two-phase count->emit path
+import operator as _op
 import os as _os
 
 _SPECULATIVE_JOIN = _os.environ.get("CYLON_TPU_EXACT_JOIN", "0") != "1"
@@ -51,6 +52,69 @@ _SPECULATIVE_JOIN = _os.environ.get("CYLON_TPU_EXACT_JOIN", "0") != "1"
 def _scalar(x) -> jax.Array:
     """Per-shard [1] arrays carry scalars through shard_map."""
     return x.reshape(1) if hasattr(x, "reshape") else jnp.asarray([x])
+
+
+class Row:
+    """Read-only cursor over one table row — the reference's ``cylon::Row``
+    (cpp/src/cylon/row.hpp:24-52), used by the row-UDF Select path
+    (:meth:`Table.select_rows`). Values are decoded host values (strings are
+    strings, nulls are None)."""
+
+    __slots__ = ("_cols", "_i")
+
+    def __init__(self, cols: Dict[str, np.ndarray], i: int):
+        self._cols = cols
+        self._i = i
+
+    def __getitem__(self, name: str):
+        return self._cols[name][self._i]
+
+    def get(self, name: str):
+        return self._cols[name][self._i]
+
+    def keys(self):
+        return self._cols.keys()
+
+    @property
+    def row_index(self) -> int:
+        return self._i
+
+
+def _dict_insert(dic: np.ndarray, value) -> Tuple[np.ndarray, int, bool]:
+    """Insert ``value`` into a sorted dictionary, WIDENING the unicode dtype
+    first — np.insert into a '<U1' array would silently truncate a longer
+    value. Returns (dictionary, code position, whether an insert happened)."""
+    pos = int(np.searchsorted(dic, value))
+    if pos < len(dic) and dic[pos] == value:
+        return dic, pos, False
+    wide = np.result_type(dic.dtype, np.asarray([value]).dtype)
+    return np.insert(dic.astype(wide), pos, value), pos, True
+
+
+def _host_col_like(
+    table: "Table",
+    phys: np.ndarray,
+    valid: Optional[np.ndarray],
+    dtype: DataType,
+    dictionary: Optional[np.ndarray],
+) -> Column:
+    """Stage a host column (live-row order, one value per live row) into a
+    device Column matching ``table``'s padded per-shard layout."""
+    world, cap = table.world_size, table._shard_cap
+    counts = table._row_counts
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    block = np.zeros((world, cap), phys.dtype)
+    vblock = None if valid is None else np.ones((world, cap), bool)
+    for i in range(world):
+        c = int(counts[i])
+        block[i, :c] = phys[offs[i] : offs[i] + c]
+        if vblock is not None:
+            vblock[i, :c] = valid[offs[i] : offs[i] + c]
+    data_dev = jax.device_put(block.reshape(-1), table.ctx.sharding)
+    valid_dev = (
+        None if vblock is None else jax.device_put(vblock.reshape(-1), table.ctx.sharding)
+    )
+    return Column(data_dev, dtype, valid_dev, dictionary)
 
 
 class Table:
@@ -508,18 +572,31 @@ class Table:
     # ------------------------------------------------------------------
     # filtering / row selection
     # ------------------------------------------------------------------
-    def filter(self, mask: Union["Table", Column, jax.Array]) -> "Table":
-        """Keep rows where mask is True. The vectorized analog of the
-        reference's UDF Select (table.cpp:504-529) and of pycylon's boolean
-        __getitem__ (data/table.pyx:1066-1223)."""
+    def _as_mask(self, mask) -> jax.Array:
+        """Normalize a Table / Column / array boolean row mask to a [P*cap]
+        device bool array (null mask entries count as False, like pandas)."""
         if isinstance(mask, Table):
             mask = next(iter(mask._columns.values()))
         if isinstance(mask, Column):
             m = mask.data
             if mask.valid is not None:
                 m = m & mask.valid
-        else:
-            m = mask
+            return m
+        if isinstance(mask, np.ndarray):
+            # host-order mask over live rows -> physical padded layout
+            world, cap = self.world_size, self._shard_cap
+            full = np.zeros((world, cap), bool)
+            offs = np.concatenate([[0], np.cumsum(self._row_counts)])
+            for i in range(world):
+                full[i, : int(self._row_counts[i])] = mask[offs[i] : offs[i + 1]]
+            return jax.device_put(full.reshape(-1), self.ctx.sharding)
+        return mask
+
+    def filter(self, mask: Union["Table", Column, jax.Array]) -> "Table":
+        """Keep rows where mask is True. The vectorized analog of the
+        reference's UDF Select (table.cpp:504-529) and of pycylon's boolean
+        __getitem__ (data/table.pyx:1066-1223)."""
+        m = self._as_mask(mask)
         names = self.column_names
         flat = self._flat_cols()
         key = ("filter", len(flat))
@@ -567,6 +644,19 @@ class Table:
         predicate is jit-compiled over whole columns — TPU-native.)"""
         env = {n: self._columns[n].data for n in self.column_names}
         mask = predicate(env)
+        return self.filter(mask)
+
+    def select_rows(self, predicate) -> "Table":
+        """Row filter by an arbitrary Python row UDF — the reference's exact
+        Select capability (table.cpp:504-529 with a ``Row`` cursor,
+        row.hpp:24-52). The UDF receives a :class:`Row` per live row and runs
+        on the HOST (decoded values), so this is the escape hatch for
+        predicates that cannot be vectorized; prefer :meth:`select`."""
+        host = self.to_pydict()
+        n = self.row_count
+        mask = np.fromiter(
+            (bool(predicate(Row(host, i))) for i in range(n)), bool, count=n
+        )
         return self.filter(mask)
 
     def take(self, indices: np.ndarray) -> "Table":
@@ -965,11 +1055,24 @@ class Table:
             list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
         )
 
-    def distributed_join(self, other: "Table", **kwargs) -> "Table":
+    def distributed_join(
+        self, other: "Table", mode: str = "eager", **kwargs
+    ) -> "Table":
         """The flagship op (reference DistributedJoin, table.cpp:482-502):
         hash-shuffle both tables on the join keys over the mesh, then local
         join per shard. world_size==1 short-circuits to the local join
-        (reference :487-489)."""
+        (reference :487-489).
+
+        ``mode='fused'`` runs the whole shuffle->join chain as ONE compiled
+        XLA program with static capacities and a single host sync (the
+        product surface of parallel/pipeline.py — the analog of the
+        reference's streaming DisJoinOP graph, ops/dis_join_op.cpp:26-71).
+        Undersized capacities are detected via the overflow flag and retried
+        with doubled capacities (no wrong answers, just a recompile)."""
+        if mode == "fused":
+            return self._fused_join(other, **kwargs)
+        if mode != "eager":
+            raise ValueError(f"unknown join mode {mode!r}")
         if self.world_size == 1:
             return self.join(other, **kwargs)
         l_names, r_names = self._resolve_join_keys(
@@ -983,6 +1086,85 @@ class Table:
         ls = left._shuffle_impl(kind="hash", key_names=l_names)
         rs = right._shuffle_impl(kind="hash", key_names=r_names)
         return ls.join(rs, **kwargs)
+
+    def _fused_join(
+        self,
+        other: "Table",
+        on=None,
+        how: str = "inner",
+        left_on=None,
+        right_on=None,
+        suffixes: Tuple[str, str] = ("_x", "_y"),
+        capacity_factor: float = 2.0,
+        max_retries: int = 3,
+        **_ignored,
+    ) -> "Table":
+        """shuffle->join as one XLA program (see distributed_join). One host
+        sync per attempt: the fetch of (out_counts, overflow)."""
+        from .parallel.pipeline import make_distributed_join_step
+
+        ctx = self.ctx
+        world = ctx.world_size
+        l_names, r_names = self._resolve_join_keys(other, on, left_on, right_on)
+        howi = _j.join_type_id(how)
+        left, right = _unify_dict_pair(self, other, l_names, r_names)
+        left, right = _promote_key_pair(left, right, l_names, r_names)
+        lk_idx = tuple(left.column_names.index(n) for n in l_names)
+        rk_idx = tuple(right.column_names.index(n) for n in r_names)
+        lflat = left._flat_cols()
+        rflat = right._flat_cols()
+        cap_l, cap_r = left.shard_cap, right.shard_cap
+        respill = 1
+        bucket_cap = round_cap(
+            int(capacity_factor * max(cap_l, cap_r) / max(world, 1))
+        )
+        if world > 1:
+            join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
+        else:
+            join_cap = round_cap(cap_l + cap_r)
+        for attempt in range(max_retries):
+            key = (
+                "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
+                bucket_cap, join_cap, respill,
+            )
+            cache = ctx.__dict__.setdefault("_jit_cache", {})
+            step = cache.get(key)
+            if step is None:
+                step = make_distributed_join_step(
+                    ctx.mesh, ctx.axis_name, lk_idx, rk_idx, howi,
+                    bucket_cap, join_cap, respill,
+                )
+                cache[key] = step
+            with span("join.fused", rows=int(self.row_count)):
+                out, nout, overflow = step(
+                    (lflat, left.counts_dev, rflat, right.counts_dev), ()
+                )
+                ov = np.asarray(overflow).reshape(-1, 2)  # THE host sync
+            ov_shuffle = int(ov[:, 0].sum())
+            ov_join = int(ov[:, 1].max())
+            if ov_shuffle == 0 and ov_join == 0:
+                out_names = _suffix_names(
+                    left.column_names, right.column_names, suffixes
+                )
+                src_cols = list(left._columns.values()) + list(
+                    right._columns.values()
+                )
+                return self._rebuild_cols(
+                    list(zip(out_names, src_cols)), out,
+                    self._out_counts(nout), join_cap,
+                )
+            if ov_shuffle > 0:
+                bucket_cap *= 2
+                join_cap = max(
+                    join_cap, round_cap(2 * (1 + respill) * world * bucket_cap)
+                )
+            if ov_join > 0:
+                # the join lane reports the EXACT shortfall: converge at once
+                join_cap = round_cap(join_cap + ov_join)
+        raise RuntimeError(
+            f"fused join overflowed after {max_retries} capacity retries "
+            f"(extreme skew); use mode='eager'"
+        )
 
     # ------------------------------------------------------------------
     # set operations
@@ -1373,11 +1555,9 @@ class Table:
                 cols[n] = c
                 continue
             if c.dtype.is_dictionary:
-                # add fill value to dictionary if missing
-                dic = c.dictionary
-                pos = np.searchsorted(dic, value)
-                if pos >= len(dic) or dic[pos] != value:
-                    dic = np.insert(dic, pos, value)
+                # add fill value to dictionary if missing (width-promoting)
+                dic, pos, inserted = _dict_insert(c.dictionary, value)
+                if inserted:
                     remap = jnp.asarray(
                         np.searchsorted(dic, c.dictionary).astype(np.int32)
                     )
@@ -1392,16 +1572,205 @@ class Table:
         return self._replace(columns=cols)
 
     def astype(self, dtype_map: Union[Any, Dict[str, Any]]) -> "Table":
+        """Column dtype conversion incl. strings both ways (pycylon astype,
+        data/table.pyx:2411): string->numeric parses the DICTIONARY on the
+        host and keeps the device codes; numeric->string builds a dictionary
+        from the column's distinct values."""
         if not isinstance(dtype_map, dict):
             dtype_map = {n: dtype_map for n in self.column_names}
         cols = OrderedDict(self._columns)
         for n, dt in dtype_map.items():
             c = self._columns[n]
+            want_str = dt in (str, "str", "string", "object") or (
+                isinstance(dt, np.dtype) and dt.kind in ("U", "S", "O")
+            )
             if c.dtype.is_dictionary:
-                raise TypeError("astype on string columns not supported")
-            nd = np.dtype(dt)
-            cols[n] = Column(c.data.astype(nd), DataType.from_numpy_dtype(nd), c.valid, None)
+                if want_str:
+                    cols[n] = c
+                    continue
+                # string -> numeric: parse dictionary values (host, O(|dict|))
+                # and remap the device codes through the parsed lookup
+                nd = np.dtype(dt)
+                parsed = c.dictionary.astype(nd)
+                lookup = jnp.asarray(parsed)
+                data = lookup[jnp.clip(c.data, 0, len(parsed) - 1)]
+                cols[n] = Column(data, DataType.from_numpy_dtype(nd), c.valid, None)
+            elif want_str:
+                # numeric -> string: distinct values become the dictionary
+                data_np, valid_np = self._host_physical(n)
+                strs = np.array([str(v) for v in data_np], object)
+                enc, valid2, dtype2, dic = Column.encode_host(strs)
+                if valid_np is not None:
+                    valid2 = valid_np if valid2 is None else (valid2 & valid_np)
+                cols[n] = _host_col_like(self, enc, valid2, dtype2, dic)
+            else:
+                nd = np.dtype(dt)
+                cols[n] = Column(
+                    c.data.astype(nd), DataType.from_numpy_dtype(nd), c.valid, None
+                )
         return self._replace(columns=cols)
+
+    def where(self, cond, other=None) -> "Table":
+        """pandas-style where (pycylon table.pyx:1683-1999 surface): keep
+        each value where ``cond`` is True, else replace with ``other``
+        (null when ``other`` is None). Shape is preserved."""
+        m = self._as_mask(cond)
+        live = self._live_mask()
+        keep = m & live
+        cols = OrderedDict()
+        for n, c in self._columns.items():
+            if other is None:
+                v = keep if c.valid is None else (keep & c.valid)
+                cols[n] = Column(c.data, c.dtype, v, c.dictionary)
+            elif c.dtype.is_dictionary:
+                dic, pos, inserted = _dict_insert(c.dictionary, other)
+                if inserted:
+                    remap = jnp.asarray(
+                        np.searchsorted(dic, c.dictionary).astype(np.int32)
+                    )
+                    data = remap[jnp.clip(c.data, 0, len(c.dictionary) - 1)]
+                else:
+                    data = c.data
+                filled = jnp.where(keep, data, jnp.int32(pos))
+                v = None if c.valid is None else jnp.where(keep, c.valid, True)
+                cols[n] = Column(filled, c.dtype, v, dic)
+            else:
+                filled = jnp.where(keep, c.data, jnp.asarray(other, c.data.dtype))
+                v = None if c.valid is None else jnp.where(keep, c.valid, True)
+                cols[n] = Column(filled, c.dtype, v, None)
+        return self._replace(columns=cols)
+
+    def mask(self, cond, other=None) -> "Table":
+        """pandas-style mask: replace where cond is True (inverse of where)."""
+        m = self._as_mask(cond)
+        return self.where(~m, other)
+
+    def __getitem__(self, key):
+        """pycylon Table __getitem__ (data/table.pyx:1066-1223): column name /
+        list -> projection; boolean mask -> filter; slice -> row range."""
+        if isinstance(key, str):
+            return self.project([key])
+        if isinstance(key, (list, tuple)) and all(isinstance(k, str) for k in key):
+            return self.project(list(key))
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self.row_count)
+            return self.take(np.arange(start, stop, step))
+        return self.filter(key)
+
+    def __setitem__(self, key, value) -> None:
+        """pycylon Table __setitem__: ``t['c'] = array/scalar`` adds or
+        replaces a column; ``t[bool_mask] = scalar`` sets every (numeric)
+        cell of the masked rows (data/table.pyx mask-__setitem__)."""
+        self._built_index = None  # in-place mutation invalidates loc cache
+        if isinstance(key, str):
+            if np.isscalar(value):
+                value = np.full(self.row_count, value)
+            if isinstance(value, Column):
+                col = value
+            else:
+                enc, valid, dtype, dic = Column.encode_host(np.asarray(value))
+                col = _host_col_like(self, enc, valid, dtype, dic)
+            new = self.add_column(key, col)
+            self._columns = new._columns
+            return
+        masked = self.mask(key, value)
+        self._columns = masked._columns
+
+    def __bool__(self) -> bool:
+        # __eq__ returns an elementwise Table (pandas semantics); plain
+        # truthiness would then silently misanswer `t == u` / `t in list` —
+        # raise like pandas does
+        raise ValueError(
+            "The truth value of a Table is ambiguous; use Table.equals() or "
+            "row_count"
+        )
+
+    # comparison / arithmetic operators (pycylon table.pyx:1224-1656); the
+    # heavy lifting (dictionary-aware compare, masks) lives in compute.py
+    def _cmp(self, other, op):
+        from . import compute as _cc
+
+        return _cc.table_compare_op(self, other, op)
+
+    def __eq__(self, other):  # noqa: A003 — pycylon Table semantics
+        return self._cmp(other, _op.eq)
+
+    def __ne__(self, other):
+        return self._cmp(other, _op.ne)
+
+    def __lt__(self, other):
+        return self._cmp(other, _op.lt)
+
+    def __le__(self, other):
+        return self._cmp(other, _op.le)
+
+    def __gt__(self, other):
+        return self._cmp(other, _op.gt)
+
+    def __ge__(self, other):
+        return self._cmp(other, _op.ge)
+
+    def __hash__(self):  # __eq__ returns a Table; keep identity hashing
+        return id(self)
+
+    def _math(self, op, other):
+        from . import compute as _cc
+
+        return _cc.math_op(self, op, other)
+
+    def __add__(self, other):
+        return self._math("add", other)
+
+    def __radd__(self, other):
+        return self._math("add", other)
+
+    def __sub__(self, other):
+        return self._math("sub", other)
+
+    def __mul__(self, other):
+        return self._math("mul", other)
+
+    def __rmul__(self, other):
+        return self._math("mul", other)
+
+    def __truediv__(self, other):
+        from . import compute as _cc
+
+        return _cc.division_op(self, "truediv", other)
+
+    def __floordiv__(self, other):
+        from . import compute as _cc
+
+        return _cc.division_op(self, "floordiv", other)
+
+    def __neg__(self):
+        from . import compute as _cc
+
+        return _cc.neg(self)
+
+    def __invert__(self):
+        from . import compute as _cc
+
+        return _cc.invert(self)
+
+    def __and__(self, other):
+        return self._math(_op.and_, other)
+
+    def __or__(self, other):
+        return self._math(_op.or_, other)
+
+    def iterrows(self):
+        """Yield (index_value, row OrderedDict) per live row — host-side
+        generator (pycylon iterrows, data/table.pyx:2402)."""
+        host = self.to_pydict()
+        names = self.column_names
+        idx_vals = (
+            host[self.index_name]
+            if self.index_name is not None
+            else np.arange(self.row_count)
+        )
+        for i in range(self.row_count):
+            yield idx_vals[i], OrderedDict((n, host[n][i]) for n in names)
 
     def equals(self, other: "Table", ordered: bool = True) -> bool:
         """Content equality WITHOUT gathering the global table.
@@ -1499,6 +1868,25 @@ class Table:
         if self.index_name is None:
             return RangeIndex(self.row_count)
         return ColumnIndex(self.index_name)
+
+    def build_index(self, kind: str = "hash"):
+        """Build (once) and cache a value->positions lookup over the index
+        column; subsequent ``loc`` calls reuse it (reference IndexUtil::Build
+        + HashIndex, indexing/index_utils.cpp / index.hpp:82). ``kind`` is
+        'hash' (sorted probe, O(log n) lookups) or 'linear' (scan)."""
+        from .indexing import HashIndex, LinearIndex
+
+        cached = getattr(self, "_built_index", None)
+        if cached is not None and cached[0] == (kind, self.index_name):
+            return cached[1]
+        if kind == "hash":
+            idx = HashIndex(self)
+        elif kind == "linear":
+            idx = LinearIndex(self)
+        else:
+            raise ValueError(f"unknown index kind {kind!r}")
+        self._built_index = ((kind, self.index_name), idx)
+        return idx
 
     @property
     def loc(self):

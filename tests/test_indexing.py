@@ -129,3 +129,125 @@ def test_descending_nan_last_f32_and_f64(local_ctx):
         out = np.asarray(t.sort("x", ascending=False).to_pandas()["x"])
         assert np.isnan(out[-1]), (dt, out)
         assert list(out[:3]) == [3.0, 2.0, 1.0], (dt, out)
+
+
+# ---------------------------------------------------------------------------
+# loc/iloc mode matrix + build-once HashIndex/LinearIndex
+# (reference indexer.cpp 1160-LoC mode coverage; index.hpp:82 HashIndex,
+# :395 LinearIndex). Oracle: pandas.
+# ---------------------------------------------------------------------------
+
+def _dup_tbl(ctx, rng, n=30):
+    """Index with DUPLICATE entries + a string column."""
+    df = pd.DataFrame(
+        {
+            "id": rng.integers(0, 10, n).astype(np.int64),
+            "v": rng.normal(size=n),
+            "s": rng.choice(["a", "b", "c"], n),
+        }
+    )
+    return df, ct.Table.from_pandas(ctx, df)
+
+
+def test_loc_bool_mask(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    out = ti.loc[(ti["k"] > 3)].to_pandas()
+    exp = df.set_index("id").loc[df.set_index("id")["k"] > 3].reset_index()
+    assert sorted(out["id"].tolist()) == sorted(exp["id"].tolist())
+
+
+def test_iloc_bool_mask(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    m = (df["k"] > 3).to_numpy()
+    out = t.iloc[m].to_pandas()
+    assert sorted(out["id"].tolist()) == sorted(df[m]["id"].tolist())
+
+
+def test_iloc_negative_and_step(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    out = t.iloc[-5].to_pandas()
+    assert out["id"].iloc[0] == df["id"].iloc[-5]
+    out = t.iloc[2:20:3].to_pandas()
+    assert out["id"].tolist() == df["id"].iloc[2:20:3].tolist()
+
+
+def test_hash_index_build_and_reuse(ctx8, rng):
+    df, t = _dup_tbl(ctx8, rng)
+    ti = t.set_index("id")
+    hi = ti.build_index("hash")
+    assert ti.build_index("hash") is hi  # cached: build once, reuse
+    pdf = df.set_index("id")
+    # get_loc: all positions of a duplicated value
+    positions = hi.get_loc(3)
+    assert positions.tolist() == np.nonzero((df["id"] == 3).to_numpy())[0].tolist()
+    assert (5 in hi) == bool((df["id"] == 5).any())
+    assert 1000 not in hi
+
+
+def test_hash_index_loc_list_duplicates_order(ctx8, rng):
+    """pandas loc[list] returns rows in REQUEST order with duplicates
+    expanded — only the built-index path can honor that."""
+    df, t = _dup_tbl(ctx8, rng)
+    ti = t.set_index("id")
+    ti.build_index("hash")
+    want = [7, 2, 7]
+    out = ti.loc[want].to_pandas()
+    exp = df.set_index("id").loc[want].reset_index()
+    assert out["id"].tolist() == exp["id"].tolist()
+    assert np.allclose(out["v"].to_numpy(), exp["v"].to_numpy())
+
+
+def test_hash_index_missing_raises(ctx8, rng):
+    df, t = _dup_tbl(ctx8, rng)
+    ti = t.set_index("id")
+    ti.build_index("hash")
+    with pytest.raises(KeyError):
+        ti.loc[[1000]]
+
+
+def test_linear_index_parity(ctx8, rng):
+    df, t = _dup_tbl(ctx8, rng)
+    ti = t.set_index("id")
+    li = ti.build_index("linear")
+    hi_positions = ct.indexing.HashIndex(ti).loc_positions([4, 9])
+    assert li.loc_positions([4, 9]).tolist() == hi_positions.tolist()
+
+
+def test_string_hash_index(ctx8, rng):
+    df, t = _dup_tbl(ctx8, rng)
+    ts = t.set_index("s")
+    hi = ts.build_index("hash")
+    assert ("a" in hi) == bool((df["s"] == "a").any())
+    out = ts.loc[["b"]].to_pandas()
+    exp = df[df["s"] == "b"]
+    assert len(out) == len(exp)
+
+
+def test_setitem_invalidates_built_index(ctx8, rng):
+    df, t = _dup_tbl(ctx8, rng)
+    ti = t.set_index("id")
+    ti.build_index("hash")
+    old_hits = len(ti.loc[[3]].to_pandas()) if (df["id"] == 3).any() else 0
+    ti["id"] = np.full(len(df), 3, np.int64)  # rewrite the index column
+    out = ti.loc[[3]].to_pandas()
+    assert len(out) == len(df), "stale built index served old positions"
+
+
+def test_float_probe_on_int_index_no_alias(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    ti.build_index("hash")
+    with pytest.raises(KeyError):
+        ti.loc[[3.5]]  # pandas raises; must NOT alias to id==3
+    hi = ti.build_index("hash")
+    assert 3.5 not in hi
+
+
+def test_null_index_entries_unmatchable(ctx8):
+    df = pd.DataFrame({"id": [1.0, np.nan, 2.0, np.nan, 1.0], "v": range(5)})
+    t = ct.Table.from_pandas(ctx8, df).set_index("id")
+    hi = t.build_index("hash")
+    assert hi.get_loc(1.0).tolist() == [0, 4]
+    # a null's garbage physical payload (0.0) must not be matchable
+    assert 0.0 not in hi

@@ -248,3 +248,67 @@ def test_graft_entry_dryrun_smoke():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+# ---------------------------------------------------------------------------
+# product surface: Table.distributed_join(mode='fused') / DataFrame mode=
+# (the execution-mode flag promoting the fused pipeline to product)
+# ---------------------------------------------------------------------------
+import cylon_tpu as ct
+
+
+def _msort(df):
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_fused_join_matches_eager(world_ctx, rng, how):
+    n = 600
+    a = pd.DataFrame({"k": rng.integers(0, 50, n).astype(np.int64),
+                      "x": rng.normal(size=n)})
+    b = pd.DataFrame({"k": rng.integers(0, 50, n // 2).astype(np.int64),
+                      "y": rng.normal(size=n // 2)})
+    ta, tb = ct.Table.from_pandas(world_ctx, a), ct.Table.from_pandas(world_ctx, b)
+    fused = ta.distributed_join(tb, on="k", how=how, mode="fused").to_pandas()
+    eager = ta.distributed_join(tb, on="k", how=how, mode="eager").to_pandas()
+    assert len(fused) == len(eager) == len(a.merge(b, on="k", how=how))
+    pd.testing.assert_frame_equal(_msort(fused), _msort(eager), check_dtype=False)
+
+
+def test_fused_join_skew_retries(ctx8, rng):
+    """One hot key: the first capacity guess overflows, the retry path must
+    converge to the exact result (no wrong answers under skew)."""
+    n = 512
+    k = np.zeros(n, np.int64)  # every row the same key on the left
+    a = pd.DataFrame({"k": k, "x": rng.normal(size=n)})
+    b = pd.DataFrame({"k": rng.integers(0, 4, 64).astype(np.int64),
+                      "y": rng.normal(size=64)})
+    ta, tb = ct.Table.from_pandas(ctx8, a), ct.Table.from_pandas(ctx8, b)
+    fused = ta.distributed_join(tb, on="k", how="inner", mode="fused").to_pandas()
+    exp = a.merge(b, on="k")
+    assert len(fused) == len(exp)
+    assert np.isclose(fused["x"].sum(), exp["x"].sum())
+
+
+def test_fused_join_string_keys(world_ctx, rng):
+    a = pd.DataFrame({"s": rng.choice(["aa", "bb", "cc", "dd"], 200),
+                      "x": rng.normal(size=200)})
+    b = pd.DataFrame({"s": rng.choice(["bb", "cc", "ee"], 100),
+                      "y": rng.normal(size=100)})
+    ta, tb = ct.Table.from_pandas(world_ctx, a), ct.Table.from_pandas(world_ctx, b)
+    fused = ta.distributed_join(tb, on="s", how="inner", mode="fused").to_pandas()
+    exp = a.merge(b, on="s")
+    assert len(fused) == len(exp)
+    assert sorted(fused["s_x"].tolist()) == sorted(exp["s"].tolist())
+
+
+def test_fused_mode_via_dataframe(ctx8, rng):
+    env = ct.CylonEnv(config=ct.TPUConfig(devices=list(ctx8.mesh.devices.flat)))
+    a = pd.DataFrame({"k": rng.integers(0, 20, 300).astype(np.int64),
+                      "x": rng.normal(size=300)})
+    b = pd.DataFrame({"k": rng.integers(0, 20, 200).astype(np.int64),
+                      "y": rng.normal(size=200)})
+    da, db = ct.DataFrame(a), ct.DataFrame(b)
+    out = da.merge(db, on="k", env=env, mode="fused").to_pandas()
+    exp = a.merge(b, on="k")
+    assert len(out) == len(exp)
