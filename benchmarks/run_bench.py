@@ -115,6 +115,27 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     record("dist_inner_join", s, c, 2 * n_rows, world,
            {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3)})
 
+    # fused execution mode: whole shuffle->join chain as ONE XLA program
+    # with a single host sync (vs one sync per op phase in eager mode) —
+    # the product surface of parallel/pipeline.py. The host_sync counter
+    # demonstrates the dispatch reduction.
+    from cylon_tpu.utils.tracing import get_count, reset_trace
+
+    def dist_join_fused():
+        out = left.distributed_join(right, on="k", how="inner", mode="fused")
+        jax.block_until_ready([c.data for c in out._columns.values()])
+
+    s, c = _bench(dist_join_fused, reps)
+    reset_trace()
+    dist_join()
+    eager_syncs = get_count("host_sync")
+    reset_trace()
+    dist_join_fused()
+    fused_syncs = get_count("host_sync")
+    record("dist_inner_join_fused", s, c, 2 * n_rows, world,
+           {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3),
+            "host_syncs": fused_syncs, "host_syncs_eager": eager_syncs})
+
     # config 2: join + groupby aggregate (TPC-H Q3-ish)
     def q3():
         out = left.distributed_join(right, on="k", how="inner")
@@ -123,6 +144,31 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
     s, c = _bench(q3, reps)
     record("dist_join_groupby_q3", s, c, 2 * n_rows, world)
+
+    # config 2b: the same chain fully fused (join + groupby + psum in one
+    # program, parallel/pipeline.make_join_groupby_step — what the multichip
+    # dryrun runs)
+    from cylon_tpu.ops.join import INNER
+    from cylon_tpu.parallel.pipeline import make_join_groupby_step
+
+    cap = left.shard_cap
+    step = make_join_groupby_step(
+        ctx.mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,),
+        agg_col_idx=1, how=INNER,
+        bucket_cap=max(64, 4 * cap // max(world, 1)),
+        join_cap=4 * cap, group_cap=2 * cap,
+    )
+    lflat = left._flat_cols()
+    rflat = right._flat_cols()
+
+    def q3_fused():
+        out = step((lflat, left.counts_dev, rflat, right.counts_dev), ())
+        jax.block_until_ready(out)
+        _ = np.asarray(out[3])  # the single fetch
+
+    s, c = _bench(q3_fused, reps)
+    record("dist_join_groupby_q3_fused", s, c, 2 * n_rows, world,
+           {"host_syncs": 1})
 
     # config 3: distributed sort (sample sort)
     def dsort():

@@ -16,6 +16,14 @@ import jax
 if not os.environ.get("CYLON_TPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
+# Optional platform pin (e.g. CYLON_TPU_PLATFORM=cpu for the virtual-device
+# mesh). The jax.config route is used on purpose: the JAX_PLATFORMS env var
+# can hang backend selection in tunneled-TPU images, the config update before
+# first backend touch cannot. Embedded/C-ABI consumers rely on this knob.
+_platform = os.environ.get("CYLON_TPU_PLATFORM")
+if _platform:
+    jax.config.update("jax_platforms", _platform)
+
 from . import dtypes  # noqa: E402
 from .column import Column  # noqa: E402
 from .config import (  # noqa: E402

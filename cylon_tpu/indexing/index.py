@@ -88,8 +88,16 @@ def encode_lookup_values(
             else np.zeros(len(vals), bool)
         )
         return np.where(hit, pos, -1).astype(np.int32)
-    enc = vals.astype(phys_dtype)
-    bad = enc.astype(np.float64) != np.asarray(vals, np.float64)
+    try:
+        enc = vals.astype(phys_dtype)
+        bad = enc.astype(np.float64) != np.asarray(vals, np.float64)
+    except (ValueError, TypeError):
+        # type-incompatible probe (e.g. a string against an int index):
+        # pandas reports a missing key, not a numpy coercion error
+        raise KeyError(
+            f"lookup values not comparable to index dtype "
+            f"{np.dtype(phys_dtype)}: {np.asarray(values).tolist()[:5]}"
+        ) from None
     if bad.any():
         if np.issubdtype(np.dtype(phys_dtype), np.floating):
             # float index: a non-representable probe simply matches nothing

@@ -150,13 +150,18 @@ class ILocIndexer:
 
 
 def _is_bool_mask(rows) -> bool:
-    """Boolean-mask loc/iloc mode: Table/Column of bools or a bool ndarray."""
+    """Boolean-mask loc/iloc mode: Table/Column of bools, a bool ndarray, or
+    a plain Python list/tuple of bools (pandas accepts all of these)."""
     from ..column import Column
     from ..table import Table
 
     if isinstance(rows, (Table, Column)):
         c = next(iter(rows._columns.values())) if isinstance(rows, Table) else rows
         return bool(np.dtype(c.data.dtype) == np.bool_)
+    if isinstance(rows, (list, tuple)):
+        return len(rows) > 0 and all(
+            isinstance(b, (bool, np.bool_)) for b in rows
+        )
     return isinstance(rows, np.ndarray) and rows.dtype == np.bool_
 
 

@@ -134,7 +134,11 @@ def make_distributed_join_step(
 
     Signature of the returned fn (global, row-sharded arrays):
       (l_cols, l_counts[P], r_cols, r_counts[P]) ->
-      (out_cols [P*join_cap], out_counts [P], overflow [P])
+      (out_cols [P*join_cap], out_counts [P], overflow [2P])
+    where overflow carries TWO lanes per shard — reshape(-1, 2) gives
+    [:, 0] = rows the shuffle could not send (bucket_cap exceeded after all
+    respill rounds) and [:, 1] = join rows past join_cap (exact shortfall,
+    so a retry can size join_cap in one step).
 
     This is the whole reference DistributedJoin call stack (SURVEY.md §3.2)
     as ONE compiled XLA program: hash -> scatter -> all_to_all -> sort-join

@@ -37,7 +37,7 @@ from .ops import partition as _p
 from .ops import setops as _s
 from .ops.sort import lexsort_rows
 from .parallel import shuffle as _sh
-from .utils.tracing import span
+from .utils.tracing import bump, span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
@@ -499,6 +499,7 @@ class Table:
         return Table(self.ctx, cols, row_counts, cap, index_name=idx)
 
     def _out_counts(self, per_shard) -> np.ndarray:
+        bump("host_sync")
         return np.asarray(per_shard).astype(np.int64)
 
     def _compact(self, new_cap: int) -> "Table":
@@ -582,6 +583,8 @@ class Table:
             if mask.valid is not None:
                 m = m & mask.valid
             return m
+        if isinstance(mask, (list, tuple)):
+            mask = np.asarray(mask, bool)
         if isinstance(mask, np.ndarray):
             # host-order mask over live rows -> physical padded layout
             world, cap = self.world_size, self._shard_cap
@@ -818,6 +821,7 @@ class Table:
             send_counts = get_kernel(ctx, key + ("count",), build_count)(
                 (flat, khash, self.counts_dev), ()
             )
+            bump("host_sync")
             send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
         new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
 
@@ -994,6 +998,7 @@ class Table:
                     (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
                     (jnp.zeros((spec_cap,), jnp.int8),),
                 )
+                bump("host_sync")
                 stats = np.asarray(stats).reshape(-1, 2)
                 totals = stats[:, 0].astype(np.int64)
                 shadows = stats[:, 1].copy().view(np.float32)
@@ -1056,7 +1061,13 @@ class Table:
         )
 
     def distributed_join(
-        self, other: "Table", mode: str = "eager", **kwargs
+        self,
+        other: "Table",
+        on: Optional[Union[str, Sequence[str]]] = None,
+        how: str = "inner",
+        *,
+        mode: str = "eager",
+        **kwargs,
     ) -> "Table":
         """The flagship op (reference DistributedJoin, table.cpp:482-502):
         hash-shuffle both tables on the join keys over the mesh, then local
@@ -1069,6 +1080,9 @@ class Table:
         reference's streaming DisJoinOP graph, ops/dis_join_op.cpp:26-71).
         Undersized capacities are detected via the overflow flag and retried
         with doubled capacities (no wrong answers, just a recompile)."""
+        if on is not None:
+            kwargs["on"] = on
+        kwargs.setdefault("how", how)
         if mode == "fused":
             return self._fused_join(other, **kwargs)
         if mode != "eager":
@@ -1139,7 +1153,16 @@ class Table:
                 out, nout, overflow = step(
                     (lflat, left.counts_dev, rflat, right.counts_dev), ()
                 )
-                ov = np.asarray(overflow).reshape(-1, 2)  # THE host sync
+                # ONE host transfer for counts + overflow: concatenate the
+                # tiny stat arrays on device, fetch once
+                stats = jnp.concatenate(
+                    [nout.astype(jnp.int32), overflow.astype(jnp.int32)]
+                )
+                bump("host_sync")
+                stats = np.asarray(stats)  # THE host sync
+            P = world
+            nout_h = stats[:P].astype(np.int64)
+            ov = stats[P:].reshape(-1, 2)
             ov_shuffle = int(ov[:, 0].sum())
             ov_join = int(ov[:, 1].max())
             if ov_shuffle == 0 and ov_join == 0:
@@ -1150,8 +1173,7 @@ class Table:
                     right._columns.values()
                 )
                 return self._rebuild_cols(
-                    list(zip(out_names, src_cols)), out,
-                    self._out_counts(nout), join_cap,
+                    list(zip(out_names, src_cols)), out, nout_h, join_cap,
                 )
             if ov_shuffle > 0:
                 bucket_cap *= 2

@@ -59,6 +59,23 @@ def span(name: str, rows: Optional[int] = None) -> Iterator[None]:
             print(f"[cylon_tpu] {name}: {dt * 1e3:.2f} ms{extra}", file=sys.stderr)
 
 
+def bump(name: str, rows: Optional[int] = None) -> None:
+    """Count an event (no timing) in the same registry — e.g. ``host_sync``,
+    bumped at every device->host count fetch so eager-vs-fused dispatch
+    behavior is measurable (the reference logs row counts after collectives
+    the same way, table.cpp:118-123)."""
+    with _lock:
+        s = _stats[name]
+        s["count"] += 1
+        if rows is not None:
+            s["rows"] += int(rows)
+
+
+def get_count(name: str) -> int:
+    with _lock:
+        return int(_stats[name]["count"]) if name in _stats else 0
+
+
 def get_trace_report() -> Dict[str, Dict[str, float]]:
     """Aggregated span stats: {name: {count, total_s, max_s, rows}}."""
     with _lock:

@@ -251,3 +251,23 @@ def test_null_index_entries_unmatchable(ctx8):
     assert hi.get_loc(1.0).tolist() == [0, 4]
     # a null's garbage physical payload (0.0) must not be matchable
     assert 0.0 not in hi
+
+
+def test_loc_iloc_bool_list(ctx8, rng):
+    df, t = _tbl(ctx8, rng, n=8)
+    ti = t.set_index("id")
+    m = [True, False, False, True, False, True, False, False]
+    out = ti.loc[m].to_pandas()
+    exp = df[np.asarray(m)]
+    assert sorted(out["id"].tolist()) == sorted(exp["id"].tolist())
+    out2 = t.iloc[m].to_pandas()
+    assert sorted(out2["id"].tolist()) == sorted(exp["id"].tolist())
+
+
+def test_incompatible_probe_types(ctx8, rng):
+    df, t = _tbl(ctx8, rng)
+    ti = t.set_index("id")
+    hi = ti.build_index("hash")
+    assert "a" not in hi  # pandas: False, not a numpy coercion error
+    with pytest.raises(KeyError):
+        hi.loc_positions(["a"])
