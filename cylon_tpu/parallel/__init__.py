@@ -1,1 +1,2 @@
 from . import shuffle  # noqa: F401
+from .task import LogicalTaskPlan, task_partition  # noqa: F401

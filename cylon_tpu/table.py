@@ -785,6 +785,7 @@ class Table:
         key_names: Sequence[str],
         asc0: bool = True,
         num_bins: int = 0,
+        task_map: Optional[np.ndarray] = None,
     ) -> "Table":
         """hash/range partition -> exact-size exchange -> padded all_to_all ->
         compact (SURVEY.md §7 stage 5; reference shuffle_table_by_hashing
@@ -798,15 +799,29 @@ class Table:
         ax = ctx.axis_name
         nb = num_bins if num_bins else 16 * world
 
+        if task_map is not None:
+            task_map_dev = jnp.asarray(np.asarray(task_map, np.int32))
+
         def compute_pid(cols, kcols, n):
             if kind == "hash":
                 return _p.hash_partition_ids(kcols, n, world)
+            if kind == "task":
+                # rows already carry logical task ids in the key column;
+                # route task t to worker task_map[t] (reference
+                # LogicalTaskPlan task->worker mapping,
+                # arrow_task_all_to_all.h:23-40)
+                tasks, _ = cols[key_idx[0]]
+                cap = tasks.shape[0]
+                live = jnp.arange(cap, dtype=jnp.int32) < n
+                wid = task_map_dev[jnp.clip(tasks, 0, len(task_map) - 1)]
+                return jnp.where(live, wid, world).astype(jnp.int32)
             keys = [cols[i] for i in key_idx]
             return _p.range_partition_ids(
                 keys[0], n, world, num_bins=nb, axis_name=ax, ascending=asc0
             )
 
-        key = ("shuffle", kind, key_idx, asc0, nb, len(flat))
+        tm_key = tuple(np.asarray(task_map).tolist()) if task_map is not None else None
+        key = ("shuffle", kind, key_idx, asc0, nb, len(flat), tm_key)
 
         def build_count():
             def kern(dp, rep):
@@ -903,6 +918,17 @@ class Table:
             res = res._compact(tight)
         return res
 
+    def task_partition(
+        self, hash_columns: Sequence[Union[str, int]], plan
+    ) -> Dict[int, "Table"]:
+        """Task-based all-to-all (reference ArrowTaskAllToAll /
+        LogicalTaskPlan, arrow/arrow_task_all_to_all.h:23-40): hash rows into
+        the plan's logical tasks and shuffle each task to its owning worker.
+        Returns {task_id: Table}."""
+        from .parallel.task import task_partition as _tp
+
+        return _tp(self, hash_columns, plan)
+
     def hash_partition(self, hash_columns: Sequence[Union[str, int]], num_partitions: int) -> Dict[int, "Table"]:
         """Local hash partition into k tables (reference HashPartition,
         table.cpp:384-405). Not a hot path; built on filter()."""
@@ -964,7 +990,20 @@ class Table:
         cap_l = left.shard_cap
         cap_r = right.shard_cap
         if _SPECULATIVE_JOIN:
-            spec_cap = round_cap(cap_l + cap_r)
+            # INNER/LEFT/RIGHT: max(cap_l, cap_r) covers every <=1-match-per-
+            # key workload at HALF the emit/gather width of cap_l + cap_r;
+            # overflow falls back to the exact two-phase path below AND
+            # records the observed output size, so workloads with fanout > 1
+            # (e.g. fact-to-2-row-dim joins) pay the wasted speculative
+            # dispatch only once per join signature. FULL_OUTER's zero-match
+            # minimum is nl + nr, so it always keeps the sum.
+            hints = self.ctx.__dict__.setdefault("_spec_cap_hints", {})
+            if howi == _j.FULL_OUTER:
+                spec_cap = round_cap(cap_l + cap_r)
+            else:
+                spec_cap = max(
+                    round_cap(max(cap_l, cap_r)), hints.get(key, 0)
+                )
 
             def build_spec():
                 def kern(dp, rep):
@@ -1013,6 +1052,9 @@ class Table:
                 if tight * 4 <= spec_cap:
                     res = res._compact(tight)
                 return res
+            # speculation overflowed: remember the observed size so the next
+            # join with this signature speculates wide enough immediately
+            hints[key] = round_cap(int(totals.max()))
 
         # phase 1: probe (the sorts) — returns reusable probe state + count
         def build_probe():

@@ -1,0 +1,72 @@
+"""Task-based all-to-all (reference experimental ArrowTaskAllToAll /
+LogicalTaskPlan, cpp/src/cylon/arrow/arrow_task_all_to_all.{h,cpp}):
+over-decomposition into T logical tasks routed to P workers.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import LogicalTaskPlan
+
+
+@pytest.fixture
+def tbl(world_ctx, rng):
+    df = pd.DataFrame(
+        {
+            "k": rng.integers(0, 100, 300).astype(np.int64),
+            "v": rng.normal(size=300),
+        }
+    )
+    return df, ct.Table.from_pandas(world_ctx, df)
+
+
+def test_plan_round_robin(ctx8):
+    plan = LogicalTaskPlan(10, ctx8.world_size)
+    assert plan.n_tasks == 10
+    assert plan.worker_of(0) == 0 and plan.worker_of(9) == 9 % 8
+    assert set(plan.tasks_of(0).tolist()) == {0, 8}
+
+
+def test_plan_explicit_map_validation(ctx8):
+    plan = LogicalTaskPlan({0: 3, 1: 0, 2: 3}, ctx8.world_size)
+    assert plan.worker_of(2) == 3
+    with pytest.raises(ValueError):
+        LogicalTaskPlan({0: 99}, ctx8.world_size)  # worker out of range
+    with pytest.raises(ValueError):
+        LogicalTaskPlan({1: 0}, ctx8.world_size)  # non-dense task ids
+
+
+def test_task_partition_content_and_placement(tbl):
+    df, t = tbl
+    world = t.world_size
+    n_tasks = 3 * world  # over-decomposition: T > P
+    plan = LogicalTaskPlan(n_tasks, world)
+    parts = t.task_partition(["k"], plan)
+    assert set(parts.keys()) == set(range(n_tasks))
+    # content: the union of all task tables is exactly the input (multiset)
+    total = sum(p.row_count for p in parts.values())
+    assert total == len(df)
+    all_rows = pd.concat([p.to_pandas() for p in parts.values() if p.row_count])
+    assert sorted(all_rows["k"].tolist()) == sorted(df["k"].tolist())
+    assert np.isclose(all_rows["v"].sum(), df["v"].sum())
+    for t_id, p in parts.items():
+        assert p.column_names == ["k", "v"]  # __task__ dropped
+        # placement: every row of task t lives on worker plan.worker_of(t)
+        owner = plan.worker_of(t_id)
+        counts = p.row_counts
+        for w in range(world):
+            if w != owner:
+                assert counts[w] == 0, (t_id, owner, counts)
+
+
+def test_task_determinism_same_key_same_task(tbl):
+    df, t = tbl
+    plan = LogicalTaskPlan(5, t.world_size)
+    parts = t.task_partition(["k"], plan)
+    # each distinct key appears in exactly one task
+    seen = {}
+    for t_id, p in parts.items():
+        for k in p.to_pandas()["k"].unique():
+            assert k not in seen, f"key {k} split across tasks {seen[k]},{t_id}"
+            seen[k] = t_id
