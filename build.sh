@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Build driver (reference analog: build.sh:28-106 with --cpp/--python/--java
+# [--test]). The XLA compute path needs no build step; this compiles the
+# native runtime pieces (CSV codec, arena, C ABI), optionally with
+# AddressSanitizer (the reference's Debug build compiles with ASAN,
+# cpp/CMakeLists.txt:57), runs the test suite, and builds a wheel.
+#
+#   ./build.sh --native [--asan]   compile native .so libraries now
+#   ./build.sh --test              run the pytest suite (virtual CPU mesh)
+#   ./build.sh --wheel             build a wheel into dist/
+set -euo pipefail
+cd "$(dirname "$0")"
+
+NATIVE=0 TEST=0 WHEEL=0 ASAN=0
+for arg in "$@"; do
+  case "$arg" in
+    --native) NATIVE=1 ;;
+    --test) TEST=1 ;;
+    --wheel) WHEEL=1 ;;
+    --asan) ASAN=1 ;;
+    *) echo "unknown flag $arg (use --native|--test|--wheel|--asan)"; exit 2 ;;
+  esac
+done
+[ "$NATIVE$TEST$WHEEL" = "000" ] && { echo "nothing to do: pass --native/--test/--wheel"; exit 2; }
+
+if [ "$ASAN" = 1 ]; then
+  # the instrumented .so refuses to load unless libasan comes first
+  export CYLON_TPU_NATIVE_ASAN=1
+  export LD_PRELOAD="$(g++ -print-file-name=libasan.so)${LD_PRELOAD:+:$LD_PRELOAD}"
+  export ASAN_OPTIONS="detect_leaks=0"  # CPython itself is leaky by design
+fi
+
+if [ "$NATIVE" = 1 ]; then
+  python - <<'PY'
+from cylon_tpu import native
+lib = native.get_lib()
+print("native runtime:", "ok" if lib is not None else "FALLBACK (build failed)")
+so = native.build_capi()
+print("c abi:", so or "FAILED")
+PY
+fi
+
+if [ "$TEST" = 1 ]; then
+  python -m pytest tests/ -q
+fi
+
+if [ "$WHEEL" = 1 ]; then
+  python -m pip wheel --no-deps -w dist .
+fi

@@ -49,6 +49,7 @@ from .table import Table, concat, merge  # noqa: E402
 from . import compute  # noqa: E402
 from .series import Series  # noqa: E402
 from . import indexing  # noqa: E402
+from .join_config import JoinAlgorithm, JoinConfig  # noqa: E402
 from .indexing.index import (  # noqa: E402
     CategoricalIndex,
     HashIndex,
@@ -67,6 +68,8 @@ __all__ = [
     "CommConfig",
     "HashIndex",
     "Index",
+    "JoinAlgorithm",
+    "JoinConfig",
     "LinearIndex",
     "indexing",
     "IntegerIndex",

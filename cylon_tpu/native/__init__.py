@@ -32,15 +32,36 @@ def _src_hash(*paths: str) -> str:
     return h.hexdigest()[:12]
 
 
+def _asan() -> bool:
+    """CYLON_TPU_NATIVE_ASAN=1 compiles the native libs with
+    AddressSanitizer — the analog of the reference's Debug build
+    (-fsanitize=address, cpp/CMakeLists.txt:57). Loading the instrumented
+    .so additionally requires libasan to be LD_PRELOADed (see get_lib)."""
+    return os.environ.get("CYLON_TPU_NATIVE_ASAN", "0") == "1"
+
+
+def _asan_runtime_loaded() -> bool:
+    try:
+        with open("/proc/self/maps") as f:
+            m = f.read()
+        return "libasan" in m or "libclang_rt.asan" in m
+    except OSError:
+        return False
+
+
 def _so_path() -> str:
     # the source hash is in the filename: glibc dlopen caches by pathname, so
     # a rebuild after a source edit must land at a NEW path to actually map
-    # fresh symbols in-process
-    return os.path.join(_HERE, f"_cylon_native-{_src_hash(_SRC, _SRC_RT)}.so")
+    # fresh symbols in-process; ASAN variants get their own name
+    tag = "-asan" if _asan() else ""
+    return os.path.join(
+        _HERE, f"_cylon_native-{_src_hash(_SRC, _SRC_RT)}{tag}.so"
+    )
 
 
 def _so_capi_path() -> str:
-    return os.path.join(_HERE, f"_cylon_capi-{_src_hash(_SRC_CAPI)}.so")
+    tag = "-asan" if _asan() else ""
+    return os.path.join(_HERE, f"_cylon_capi-{_src_hash(_SRC_CAPI)}{tag}.so")
 
 _lock = threading.Lock()
 _lib_handle = None
@@ -52,11 +73,13 @@ CT_INT64, CT_FLOAT64, CT_BOOL, CT_STRING = 0, 1, 2, 3
 
 def _prune_stale(keep: str, prefix: str) -> None:
     """Unlink hash-named siblings from earlier source versions (each rebuild
-    lands at a new path — see _so_path — and would otherwise accumulate)."""
+    lands at a new path — see _so_path — and would otherwise accumulate).
+    ASAN and plain variants are pruned independently."""
     import glob
 
+    keep_asan = keep.endswith("-asan.so")
     for old in glob.glob(os.path.join(_HERE, f"{prefix}-*.so")):
-        if old != keep:
+        if old != keep and old.endswith("-asan.so") == keep_asan:
             try:
                 os.unlink(old)
             except OSError:
@@ -68,6 +91,8 @@ def _build(so: str) -> bool:
         "g++", "-std=c++20", "-O3", "-fPIC", "-shared", "-pthread",
         _SRC, _SRC_RT, "-o", so + ".tmp",
     ]
+    if _asan():
+        cmd[1:1] = ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
@@ -93,6 +118,8 @@ def build_capi() -> Optional[str]:
         f"-I{inc}", _SRC_CAPI, "-o", so + ".tmp",
         f"-L{libdir}", f"-lpython{ver}",
     ]
+    if _asan():
+        cmd[1:1] = ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
@@ -170,6 +197,21 @@ def get_lib():
         if _lib_handle is not None or _load_failed:
             return _lib_handle
         if os.environ.get("CYLON_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        if _asan() and not _asan_runtime_loaded():
+            # CDLL of an ASAN-instrumented .so ABORTS the process ("ASan
+            # runtime does not come first in initial library list") — it is
+            # not a catchable error, so refuse up front unless libasan was
+            # LD_PRELOADed (build.sh --asan --test does this)
+            import warnings
+
+            warnings.warn(
+                "CYLON_TPU_NATIVE_ASAN=1 but libasan is not preloaded; "
+                "run under LD_PRELOAD=$(g++ -print-file-name=libasan.so). "
+                "Falling back to the pure-Python paths.",
+                stacklevel=2,
+            )
             _load_failed = True
             return None
         try:

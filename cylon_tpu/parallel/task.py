@@ -137,7 +137,9 @@ def task_partition(
         (flat, shuffled.counts_dev), ()
     )
     bump("host_sync")
-    cnts = np.asarray(cnts).reshape(table.world_size, T)  # [P, T]
+    from ..table import _fetch
+
+    cnts = _fetch(cnts).reshape(table.world_size, T)  # [P, T]
     offs = np.concatenate(
         [np.zeros((table.world_size, 1), np.int64), np.cumsum(cnts, axis=1)],
         axis=1,

@@ -54,6 +54,20 @@ def _scalar(x) -> jax.Array:
     return x.reshape(1) if hasattr(x, "reshape") else jnp.asarray([x])
 
 
+def _fetch(arr) -> np.ndarray:
+    """Device->host fetch that works under multi-process ``jax.distributed``:
+    a global array's remote shards are not addressable from this host, so
+    ``np.asarray`` alone would raise — allgather across processes first
+    (the reference's equivalent host boundary is each rank owning only its
+    partition, table.cpp:791-829)."""
+    if jax.process_count() > 1 and hasattr(arr, "is_fully_addressable"):
+        if not arr.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
 class Row:
     """Read-only cursor over one table row — the reference's ``cylon::Row``
     (cpp/src/cylon/row.hpp:24-52), used by the row-UDF Select path
@@ -248,15 +262,18 @@ class Table:
                 block = np.zeros((cap,), dtype=phys_dt)
                 block[: len(phys)] = phys
                 blocks.append(jax.device_put(block, devices[i]))
-                # drop the host block immediately: device_put may alias it
-                # (CPU zero-copy) and the whole point of this path is
-                # O(one shard) peak host memory
+                # drop the host block immediately AND wait for the transfer:
+                # device_put is async and holds the source buffer alive, so
+                # without the barrier several staging blocks coexist and the
+                # O(one shard) peak-host-memory guarantee silently degrades
+                blocks[-1].block_until_ready()
                 del block
                 if has_valid:
                     vb = np.ones((cap,), bool)
                     if valid is not None:
                         vb[: len(valid)] = valid
                     vblocks.append(jax.device_put(vb, devices[i]))
+                    vblocks[-1].block_until_ready()
                     del vb
             data_dev = jax.make_array_from_single_device_arrays(
                 (world * cap,), ctx.sharding, blocks
@@ -389,8 +406,8 @@ class Table:
         (data ndarray, valid ndarray | None)."""
         col = self._columns[name]
         world, cap = self.ctx.world_size, self._shard_cap
-        data = np.asarray(col.data).reshape(world, cap)
-        valid = None if col.valid is None else np.asarray(col.valid).reshape(world, cap)
+        data = _fetch(col.data).reshape(world, cap)
+        valid = None if col.valid is None else _fetch(col.valid).reshape(world, cap)
         parts, vparts = [], []
         for i in range(world):
             c = int(self._row_counts[i])
@@ -500,7 +517,7 @@ class Table:
 
     def _out_counts(self, per_shard) -> np.ndarray:
         bump("host_sync")
-        return np.asarray(per_shard).astype(np.int64)
+        return _fetch(per_shard).astype(np.int64)
 
     def _compact(self, new_cap: int) -> "Table":
         """Slice every column's physical buffer down to ``new_cap`` rows per
@@ -837,7 +854,7 @@ class Table:
                 (flat, khash, self.counts_dev), ()
             )
             bump("host_sync")
-            send_counts = np.asarray(send_counts).reshape(world, world)  # [src, dst]
+            send_counts = _fetch(send_counts).reshape(world, world)  # [src, dst]
         new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
 
         # Skew-robust capacity (reference sidesteps raggedness by streaming
@@ -962,12 +979,24 @@ class Table:
         right_on: Optional[Sequence[str]] = None,
         suffixes: Tuple[str, str] = ("_x", "_y"),
         algorithm: str = "sort",
+        config: Optional["object"] = None,
     ) -> "Table":
         """Per-shard (local) equi-join — all 4 types (reference Join,
         table.cpp:428-480; join/hash_join.cpp + sort_join.cpp). ``algorithm``
         is accepted for API parity; the TPU implementation is always the
         sort/searchsorted join (SURVEY.md §7: argsort is native, hash
-        multimaps are not)."""
+        multimaps are not). ``config`` takes a JoinConfig object (reference
+        join_config.hpp:33-189) and must then be the ONLY join argument."""
+        if config is not None:
+            if (
+                on is not None or left_on is not None or right_on is not None
+                or how != "inner" or suffixes != ("_x", "_y")
+                or algorithm != "sort"
+            ):
+                raise ValueError(
+                    "pass either config= or explicit join arguments, not both"
+                )
+            return self.join(other, **config.kwargs())
         l_names, r_names = self._resolve_join_keys(other, on, left_on, right_on)
         howi = _j.join_type_id(how)
         left, right = _unify_dict_pair(self, other, l_names, r_names)
@@ -1038,7 +1067,7 @@ class Table:
                     (jnp.zeros((spec_cap,), jnp.int8),),
                 )
                 bump("host_sync")
-                stats = np.asarray(stats).reshape(-1, 2)
+                stats = _fetch(stats).reshape(-1, 2)
                 totals = stats[:, 0].astype(np.int64)
                 shadows = stats[:, 1].copy().view(np.float32)
             _check_join_count(totals, shadows)
@@ -1075,7 +1104,7 @@ class Table:
             self.ctx, key + ("probe",), build_probe
         )((lflat_k, rflat_k, left.counts_dev, right.counts_dev), ())
         cnts = self._out_counts(cnts)
-        _check_join_count(cnts, np.asarray(shadows))
+        _check_join_count(cnts, _fetch(shadows))
         cap_out = round_cap(int(cnts.max()))
 
         # phase 2: emit + gather, reusing the probe state (no re-sort)
@@ -1201,7 +1230,7 @@ class Table:
                     [nout.astype(jnp.int32), overflow.astype(jnp.int32)]
                 )
                 bump("host_sync")
-                stats = np.asarray(stats)  # THE host sync
+                stats = _fetch(stats)  # THE host sync
             P = world
             nout_h = stats[:P].astype(np.int64)
             ov = stats[P:].reshape(-1, 2)
@@ -2243,7 +2272,7 @@ def _concat2(a: "Table", b: "Table") -> "Table":
         (jnp.zeros((cap_out,), jnp.int8),),
     )
     return a._rebuild_cols(
-        list(zip(names, a._columns.values())), out, np.asarray(nout, np.int64), cap_out
+        list(zip(names, a._columns.values())), out, _fetch(nout).astype(np.int64), cap_out
     )
 
 

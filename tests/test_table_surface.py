@@ -135,3 +135,22 @@ def test_row_cursor(tbl):
     assert set(r.keys()) == {"a", "b", "s"}
     assert r.row_index == 3
     assert r["a"] == host["a"][3]
+
+
+def test_join_config_object(local_ctx, rng):
+    """JoinConfig object form (reference join_config.hpp:26-189 with static
+    builders)."""
+    import pandas as pd
+
+    a = pd.DataFrame({"k": rng.integers(0, 10, 50), "x": rng.normal(size=50)})
+    b = pd.DataFrame({"k": rng.integers(0, 10, 40), "y": rng.normal(size=40)})
+    ta, tb = ct.Table.from_pandas(local_ctx, a), ct.Table.from_pandas(local_ctx, b)
+    cfg = ct.JoinConfig.inner_join(on="k", suffixes=("_l", "_r"))
+    out = ta.join(tb, config=cfg)
+    exp = a.merge(b, on="k", suffixes=("_l", "_r"))
+    assert out.row_count == len(exp)
+    assert "k_l" in out.column_names and "k_r" in out.column_names
+    with pytest.raises(ValueError):
+        ct.JoinConfig("inner", algorithm="quantum")
+    with pytest.raises(ValueError):
+        ct.JoinConfig("sideways")
