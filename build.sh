@@ -32,11 +32,14 @@ fi
 
 if [ "$NATIVE" = 1 ]; then
   python - <<'PY'
+import sys
+
 from cylon_tpu import native
 lib = native.get_lib()
-print("native runtime:", "ok" if lib is not None else "FALLBACK (build failed)")
+print("native runtime:", "ok" if lib is not None else "FAILED")
 so = native.build_capi()
 print("c abi:", so or "FAILED")
+sys.exit(0 if (lib is not None and so is not None) else 1)
 PY
 fi
 

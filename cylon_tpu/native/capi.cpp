@@ -131,6 +131,69 @@ int64_t ct_api_read_csv(const char* path) {
   return store(t);
 }
 
+// Build a table directly from raw C buffers — the reference's
+// arrow_builder raw-buffer ingest used by JNI (arrow/arrow_builder.cpp:
+// cylon::cyarrow::Build from addresses+sizes). Column types: 0 = int64,
+// 1 = float64, 2 = bool (uint8). Strings go through the CSV path instead
+// (variable-length raw buffers are not part of this ABI).
+// Buffers are COPIED (numpy frombuffer is zero-copy, but the table encode
+// stages to device anyway), so callers may free them on return.
+int64_t ct_api_table_from_columns(int32_t ncols, const char** names,
+                                  const int32_t* types, const void** data,
+                                  int64_t nrows) {
+  Gil gil;
+  g_err.clear();
+  if (!g_module) {
+    g_err = "ct_api_init not called";
+    return 0;
+  }
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (!np) {
+    set_err_from_python();
+    return 0;
+  }
+  PyObject* dict = PyDict_New();
+  bool ok = dict != nullptr;
+  for (int32_t c = 0; ok && c < ncols; ++c) {
+    const char* dt;
+    Py_ssize_t itemsize;
+    switch (types[c]) {
+      case 0: dt = "int64"; itemsize = 8; break;
+      case 1: dt = "float64"; itemsize = 8; break;
+      case 2: dt = "bool"; itemsize = 1; break;
+      default:
+        g_err = "unknown column type tag (use 0=int64,1=float64,2=bool)";
+        ok = false;
+        continue;
+    }
+    PyObject* mv = PyMemoryView_FromMemory(
+        const_cast<char*>(static_cast<const char*>(data[c])),
+        nrows * itemsize, PyBUF_READ);
+    PyObject* arr =
+        mv ? PyObject_CallMethod(np, "frombuffer", "Os", mv, dt) : nullptr;
+    // copy so the caller's buffer lifetime ends at return
+    PyObject* copy = arr ? PyObject_CallMethod(arr, "copy", nullptr) : nullptr;
+    if (!copy || PyDict_SetItemString(dict, names[c], copy) != 0) ok = false;
+    Py_XDECREF(copy);
+    Py_XDECREF(arr);
+    Py_XDECREF(mv);
+  }
+  PyObject* table = nullptr;
+  if (ok) {
+    PyObject* cls = PyObject_GetAttrString(g_module, "Table");
+    table = cls ? PyObject_CallMethod(cls, "from_pydict", "OO", g_ctx, dict)
+                : nullptr;
+    Py_XDECREF(cls);
+  }
+  if (!table && ok) set_err_from_python();
+  // never leave a pending exception across PyGILState_Release — a later
+  // C-API call would then execute with an exception already set
+  if (PyErr_Occurred()) set_err_from_python();
+  Py_XDECREF(dict);
+  Py_DECREF(np);
+  return table ? store(table) : 0;
+}
+
 // join (reference Table.java join/distributedJoin :126-171)
 int64_t ct_api_join(int64_t left, int64_t right, const char* on,
                     const char* how, int distributed) {

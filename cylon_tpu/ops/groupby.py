@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .factorize import factorize
-from .sort import KeyCol
+from .sort import KeyCol, wide_float, wide_int
 
 # aggregation op ids, mirroring reference AggregationOpId
 # (compute/aggregate_kernels.hpp:40-50)
@@ -124,12 +124,12 @@ def aggregate_column(
     # padding rows already have ids == cap (dropped by mode="drop" scatters
     # when cap >= cap_out; make sure by re-masking)
     live_ids = jnp.where(vmask, ids, jnp.int32(data.shape[0]))
-    cnt = _seg_sum(vmask.astype(jnp.int64), live_ids, cap_out)
+    cnt = _seg_sum(vmask.astype(wide_int()), live_ids, cap_out)
     gmask = jnp.arange(cap_out) < num_groups
     if op == COUNT:
         return jnp.where(gmask, cnt, 0), None
     if op == SUM:
-        acc = data.astype(jnp.int64) if jnp.issubdtype(data.dtype, jnp.integer) else data
+        acc = data.astype(wide_int()) if jnp.issubdtype(data.dtype, jnp.integer) else data
         s = _seg_sum(_masked(acc, vmask, 0), live_ids, cap_out)
         return jnp.where(gmask, s, jnp.zeros_like(s)), gmask & (cnt > 0) if valid is not None else None
     if op in (MIN, MAX):
@@ -141,11 +141,11 @@ def aggregate_column(
         has = gmask & (cnt > 0)
         return out, (has if valid is not None else None)
     if op == MEAN:
-        s = _seg_sum(_masked(data.astype(jnp.float64), vmask, 0.0), live_ids, cap_out)
+        s = _seg_sum(_masked(data.astype(wide_float()), vmask, 0.0), live_ids, cap_out)
         out = s / jnp.maximum(cnt, 1)
         return jnp.where(gmask, out, 0.0), gmask & (cnt > 0)
     if op in (VAR, STDDEV):
-        x = _masked(data.astype(jnp.float64), vmask, 0.0)
+        x = _masked(data.astype(wide_float()), vmask, 0.0)
         s = _seg_sum(x, live_ids, cap_out)
         ss = _seg_sum(x * x, live_ids, cap_out)
         denom = jnp.maximum(cnt - ddof, 1)
@@ -166,11 +166,11 @@ def aggregate_column(
         newpair = (
             (sid != jnp.roll(sid, 1)) | (sval != jnp.roll(sval, 1))
         ).at[0].set(True)
-        uniq = _seg_sum(newpair.astype(jnp.int64), sid, cap_out)
+        uniq = _seg_sum(newpair.astype(wide_int()), sid, cap_out)
         return jnp.where(gmask, uniq, 0), None
     if op == QUANTILE:
         cap = data.shape[0]
-        d = _masked(data.astype(jnp.float64), vmask, jnp.inf)
+        d = _masked(data.astype(wide_float()), vmask, jnp.inf)
         order = jnp.lexsort((d, live_ids))
         sid = live_ids[order]
         sval = d[order]
@@ -179,7 +179,7 @@ def aggregate_column(
             sid, jnp.arange(cap_out), side="left", method="sort"
         ).astype(jnp.int32)
         q = quantile
-        pos = starts.astype(jnp.float64) + q * jnp.maximum(cnt - 1, 0)
+        pos = starts.astype(wide_float()) + q * jnp.maximum(cnt - 1, 0)
         lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, cap - 1)
         hi_i = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, cap - 1)
         frac = pos - jnp.floor(pos)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .hash import hash_columns
-from .sort import KeyCol
+from .sort import KeyCol, wide_float, wide_int
 
 
 def hash_partition_ids(
@@ -42,8 +42,8 @@ def hash_partition_ids(
 
 def _as_float(data: jax.Array) -> jax.Array:
     if jnp.issubdtype(data.dtype, jnp.floating):
-        return jnp.where(jnp.isnan(data), jnp.zeros_like(data), data).astype(jnp.float64)
-    return data.astype(jnp.float64)
+        return jnp.where(jnp.isnan(data), jnp.zeros_like(data), data).astype(wide_float())
+    return data.astype(wide_float())
 
 
 def range_partition_ids(
@@ -71,7 +71,10 @@ def range_partition_ids(
     x = _as_float(data)
     live = jnp.arange(cap, dtype=jnp.int32) < n
     ok = live if valid is None else (live & valid)
-    big = jnp.float64(np.finfo(np.float64).max)
+    # sentinel must dominate the key dtype's full range: finfo of the WIDE
+    # float (f64-max under x64), not f32-max, or f64 keys above 3.4e38 would
+    # break the min/max and collapse every row into one partition
+    big = jnp.asarray(np.finfo(np.dtype(wide_float())).max, wide_float())
     lo = jnp.min(jnp.where(ok, x, big))
     hi = jnp.max(jnp.where(ok, x, -big))
     if axis_name is not None:
@@ -81,16 +84,16 @@ def range_partition_ids(
     # local histogram over num_bins equal-width bins
     b = jnp.clip(((x - lo) / span * num_bins).astype(jnp.int32), 0, num_bins - 1)
     b = jnp.where(ok, b, num_bins)  # nulls+padding counted out of range
-    hist = jnp.zeros((num_bins,), jnp.int64).at[b].add(1, mode="drop")
+    hist = jnp.zeros((num_bins,), wide_int()).at[b].add(1, mode="drop")
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)  # reference MPI_Allreduce :410
     total = jnp.sum(hist)
     # bin -> partition: equal cumulative weight split (reference
     # build_bin_to_partition :418-440)
     cum = jnp.cumsum(hist) - hist  # exclusive
-    per_part = jnp.maximum(total.astype(jnp.float64) / num_partitions, 1.0)
+    per_part = jnp.maximum(total.astype(wide_float()) / num_partitions, 1.0)
     bin_to_part = jnp.clip(
-        (cum.astype(jnp.float64) / per_part).astype(jnp.int32), 0, num_partitions - 1
+        (cum.astype(wide_float()) / per_part).astype(jnp.int32), 0, num_partitions - 1
     )
     pid = bin_to_part[jnp.clip(b, 0, num_bins - 1)]
     if not ascending:

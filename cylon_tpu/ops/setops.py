@@ -26,14 +26,22 @@ def compact_mask(mask: jax.Array, cap_out: int) -> Tuple[jax.Array, jax.Array]:
 
     Returns (idx [cap_out] int32 with -1 padding, count scalar int32).
     Order of surviving indices is ascending (stable compaction).
+
+    A stable argsort of ~mask puts True positions first in ascending order —
+    one byte-key sort instead of the scatter formulation (TPU sorts run near
+    memory bandwidth; scatters pay per element).
     """
     cap = mask.shape[0]
-    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    total = jnp.where(cap > 0, rank[-1] + 1, 0).astype(jnp.int32)
-    dest = jnp.where(mask, rank, cap_out)  # cap_out == drop
-    idx = jnp.full((cap_out,), -1, jnp.int32).at[dest].set(
-        jnp.arange(cap, dtype=jnp.int32), mode="drop"
-    )
+    total = jnp.sum(mask).astype(jnp.int32)
+    order = jnp.argsort(jnp.where(mask, 0, 1).astype(jnp.uint8), stable=True)
+    order = order.astype(jnp.int32)
+    if cap_out <= cap:
+        idx = order[:cap_out]
+    else:
+        idx = jnp.concatenate(
+            [order, jnp.full((cap_out - cap,), -1, jnp.int32)]
+        )
+    idx = jnp.where(jnp.arange(cap_out, dtype=jnp.int32) < total, idx, -1)
     return idx, total
 
 

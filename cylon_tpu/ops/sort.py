@@ -21,6 +21,21 @@ import numpy as np
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]  # (data, valid-or-None)
 
 
+
+def wide_float():
+    """float64 when X64 is enabled, else float32 — avoids the noisy
+    jax truncation warning under CYLON_TPU_NO_X64 pipelines."""
+    import jax
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def wide_int():
+    import jax
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def orderable_key(data: jax.Array) -> jax.Array:
     """Map a numeric column to a canonical sort/equality lane.
 

@@ -194,3 +194,14 @@ def test_unique(ctx8, rng):
     got = t.distributed_unique().to_pandas()
     assert set(got["x"]) == set(df["x"])
     assert len(got) == df["x"].nunique()
+
+
+def test_distributed_sort_huge_f64_keys(ctx8):
+    """Range partition sentinel must dominate f64 keys beyond f32 range."""
+    import pandas as pd
+
+    vals = np.array([1e40, -2e40, 3.5e38, -3.5e38, 0.0, 7e39, 1.0, -1.0] * 4)
+    t = ct.Table.from_pandas(ctx8, pd.DataFrame({"v": vals}))
+    out = t.distributed_sort("v").to_pandas()["v"].to_numpy()
+    assert (np.diff(out) >= 0).all()
+    assert np.allclose(np.sort(vals), out)
