@@ -148,6 +148,56 @@ class DataFrame:
     def to_dict(self):
         return self._table.to_pydict()
 
+    def to_arrow(self):
+        """Typed pyarrow.Table (reference frame.py:217)."""
+        return self._table.to_arrow()
+
+    def to_csv(self, path, csv_write_options=None) -> None:
+        """Write CSV (reference frame.py:226; per-rank when given a list of
+        world_size paths)."""
+        from .io.csv import write_csv
+
+        write_csv(self._table, path, csv_write_options)
+
+    @property
+    def context(self):
+        """The underlying device-mesh context (reference frame.py:42)."""
+        return self._table.ctx
+
+    def add_prefix(self, prefix: str) -> "DataFrame":
+        """Prefix every column name (reference frame.py:985). The index
+        column (if set) follows its renamed column, like pandas."""
+        out = self.rename([prefix + n for n in self.columns])
+        if self._table.index_name is not None:
+            out._table.index_name = prefix + self._table.index_name
+        return out
+
+    # device-placement surface (reference frame.py:82-98 — stubs there; here
+    # columns already live on the mesh devices, and the host side is reached
+    # via to_pandas/to_arrow)
+    def to_cpu(self) -> "DataFrame":
+        return self
+
+    def to_device(self, device=None) -> "DataFrame":
+        return self
+
+    def is_cpu(self) -> bool:
+        return all(
+            d.platform == "cpu" for d in self._table.ctx.mesh.devices.flat
+        )
+
+    def is_device(self, device) -> bool:
+        return any(
+            getattr(d, "platform", None) == device or d == device
+            for d in self._table.ctx.mesh.devices.flat
+        )
+
+    def isna(self) -> "DataFrame":
+        return self.isnull()
+
+    def notna(self) -> "DataFrame":
+        return self.notnull()
+
     def __repr__(self):
         return repr(self._table)
 

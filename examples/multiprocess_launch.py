@@ -1,0 +1,53 @@
+"""Launch an N-process distributed run on one machine (the mpirun analog).
+
+Each process owns local devices; collectives run over Gloo/ICI. Usage:
+
+    python examples/multiprocess_launch.py          # 2 processes x 2 devices
+
+In production each host runs ONE process with its local TPU devices and the
+same TPUConfig(coordinator_address=...) call — see tests/test_multiprocess.py
+for the full per-rank ingestion pattern.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+os.environ["CYLON_TPU_PLATFORM"] = "cpu"
+import numpy as np, pandas as pd
+import cylon_tpu as ct
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+ctx = ct.CylonContext.init_distributed(ct.TPUConfig(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid))
+rng = np.random.default_rng(0)  # identical data on every process (SPMD)
+a = ct.Table.from_pandas(ctx, pd.DataFrame(
+    {"k": rng.integers(0, 100, 10_000), "v": rng.normal(size=10_000)}))
+b = ct.Table.from_pandas(ctx, pd.DataFrame(
+    {"k": rng.integers(0, 100, 8_000), "w": rng.normal(size=8_000)}))
+j = a.distributed_join(b, on="k", how="inner")
+ctx.barrier()
+print(f"rank {ctx.rank}/{ctx.world_size} join rows: {j.row_count}", flush=True)
+"""
+
+
+def main():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", WORKER, str(i), str(port)], env=env)
+        for i in range(2)
+    ]
+    rc = [p.wait(timeout=600) for p in procs]
+    assert rc == [0, 0], rc
+
+
+if __name__ == "__main__":
+    main()

@@ -149,3 +149,31 @@ def test_env_properties(env):
     assert env.world_size == 4
     assert env.rank == 0
     env.barrier()
+
+
+def test_frame_surface_completions(local_ctx, tmp_path):
+    """add_prefix / isna / notna / to_arrow / to_csv / context / device
+    helpers (reference frame.py:42-98, 217-227, 985)."""
+    import numpy as np
+    import pandas as pd
+
+    df = ct.DataFrame(pd.DataFrame({"a": [1, 2, 3], "b": [1.0, np.nan, 3.0]}))
+    pre = df.add_prefix("x_")
+    assert pre.columns == ["x_a", "x_b"]
+    assert df.isna().to_pandas()["b"].tolist() == [False, True, False]
+    assert df.notna().to_pandas()["b"].tolist() == [True, False, True]
+    at = df.to_arrow()
+    assert at.column_names == ["a", "b"] and at.num_rows == 3
+    p = str(tmp_path / "f.csv")
+    df.to_csv(p)
+    got = pd.read_csv(p)
+    assert got["a"].tolist() == [1, 2, 3]
+    assert df.context.world_size >= 1
+    import jax
+
+    assert df.is_cpu() == (jax.default_backend() == "cpu")
+    assert df.is_device("cpu") == df.is_cpu()
+    assert df.to_cpu() is df and df.to_device() is df
+    # index follows add_prefix (pandas semantics)
+    pre_idx = df.set_index("a").add_prefix("x_")
+    assert pre_idx.table.index_name == "x_a"
