@@ -73,6 +73,50 @@ def _from_lanes(lanes: List[jax.Array], tag: str) -> jax.Array:
     return u.astype(dt)
 
 
+def pack_cols(cols: Sequence[KeyCol]):
+    """Shared lane-plan builder: encode every column (+ validity) as int32
+    lanes. Returns (plan, lanes, passthrough) where plan entries are
+    (tag-or-None, n_lanes, has_valid) — a None tag marks an f64 column that
+    has no 32-bit lane route on TPU and must be transported separately —
+    and passthrough maps column position -> its raw f64 data."""
+    plan = []
+    lanes: List[jax.Array] = []
+    passthrough = {}
+    for ci, (data, valid) in enumerate(cols):
+        if data.dtype == jnp.float64:
+            plan.append((None, 0, valid is not None))
+            passthrough[ci] = data
+        else:
+            dl, tag = _to_lanes(data)
+            plan.append((tag, len(dl), valid is not None))
+            lanes.extend(dl)
+        if valid is not None:
+            lanes.append(valid.astype(jnp.int32))
+    return plan, lanes, passthrough
+
+
+def unpack_cols(plan, out_lanes, handle_passthrough, make_valid):
+    """Shared unpack loop for :func:`pack_cols` plans.
+
+    ``handle_passthrough(ci)`` transports one f64 column;
+    ``make_valid(valid_lane_or_None)`` shapes the output validity."""
+    out: List[KeyCol] = []
+    pos = 0
+    for ci, (tag, nl, has_valid) in enumerate(plan):
+        if tag is None:
+            data = handle_passthrough(ci)
+        else:
+            data = _from_lanes(out_lanes[pos : pos + nl], tag)
+            pos += nl
+        if has_valid:
+            v = make_valid(out_lanes[pos])
+            pos += 1
+        else:
+            v = make_valid(None)
+        out.append((data, v))
+    return out, pos
+
+
 def pack_gather(
     cols: Sequence[KeyCol],
     idx: jax.Array,
@@ -86,21 +130,9 @@ def pack_gather(
     Returns (gathered cols with merged validity, gathered extra lanes).
     """
     cap = cols[0][0].shape[0] if cols else extra_lanes[0].shape[0]
-    plan = []  # (tag-or-None, n_lanes, has_valid); None tag = passthrough f64
-    lanes: List[jax.Array] = []
-    passthrough = {}  # col position -> data array (f64: not lane-encodable)
-    for ci, (data, valid) in enumerate(cols):
-        if data.dtype == jnp.float64:
-            plan.append((None, 0, valid is not None))
-            passthrough[ci] = data
-        else:
-            dl, tag = _to_lanes(data)
-            plan.append((tag, len(dl), valid is not None))
-            lanes.extend(dl)
-        if valid is not None:
-            lanes.append(valid.astype(jnp.int32))
+    plan, lanes, passthrough = pack_cols(cols)
     n_extra = len(extra_lanes)
-    lanes.extend(extra_lanes)
+    lanes = lanes + list(extra_lanes)
     safe = jnp.clip(idx, 0, cap - 1)
     ok = idx >= 0
     if len(lanes) == 1:
@@ -111,19 +143,12 @@ def pack_gather(
         g_cols = [g[:, j] for j in range(len(lanes))]
     else:
         g_cols = []
-    out: List[KeyCol] = []
-    pos = 0
-    for ci, (tag, nl, has_valid) in enumerate(plan):
-        if tag is None:
-            data = passthrough[ci][safe]
-        else:
-            data = _from_lanes(g_cols[pos : pos + nl], tag)
-            pos += nl
-        if has_valid:
-            v = ok & g_cols[pos].astype(jnp.bool_)
-            pos += 1
-        else:
-            v = ok
-        out.append((data, v))
+
+    def make_valid(lane):
+        return ok if lane is None else (ok & lane.astype(jnp.bool_))
+
+    out, pos = unpack_cols(
+        plan, g_cols, lambda ci: passthrough[ci][safe], make_valid
+    )
     extras = g_cols[pos : pos + n_extra]
     return out, extras

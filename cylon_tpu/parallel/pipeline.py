@@ -71,16 +71,9 @@ def shuffle_shard(
         recv_counts = _sh.exchange_counts(
             _sh.round_counts(cnt, bucket_cap, r), axis_name
         )
-        for ci, (data, valid) in enumerate(st.cols):
-            d = _sh.exchange_column(data, dest, world, bucket_cap, axis_name)
-            v = (
-                None
-                if valid is None
-                else _sh.exchange_column(
-                    valid, dest, world, bucket_cap, axis_name
-                ).astype(bool)
-            )
-            parts[ci].append((d, v))
+        got = _sh.exchange_columns(st.cols, dest, world, bucket_cap, axis_name)
+        for ci, dv in enumerate(got):
+            parts[ci].append(dv)
         mask_r, total_r = _sh.received_row_mask(recv_counts, world, bucket_cap)
         masks.append(mask_r)
         total = total + total_r

@@ -891,15 +891,7 @@ class Table:
                 recv_counts = _sh.exchange_counts(
                     _sh.round_counts(cnt, bc, rnd), ax
                 )
-                out_cols = []
-                for data, valid in cols:
-                    d = _sh.exchange_column(data, dest, world, bc, ax)
-                    v = (
-                        None
-                        if valid is None
-                        else _sh.exchange_column(valid, dest, world, bc, ax).astype(bool)
-                    )
-                    out_cols.append((d, v))
+                out_cols = _sh.exchange_columns(cols, dest, world, bc, ax)
                 mask, total = _sh.received_row_mask(recv_counts, world, bc)
                 out_cols = _sh.compact_received(out_cols, mask)
                 return out_cols, _scalar(total)
