@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.gather import pack_cols, pack_gather, unpack_cols
+
 Cols = Sequence[Tuple[jax.Array, Optional[jax.Array]]]
 
 
@@ -104,19 +106,22 @@ def exchange_column(
 ) -> jax.Array:
     """Scatter one column into the padded send buffer and all_to_all it.
 
-    Output: [P * bucket_cap]; chunk s holds the rows sent by source shard s
-    (front-packed within the chunk, garbage after its count).
+    ``data`` may have trailing dims (packed lane matrices ride the same
+    exchange). Output: [P * bucket_cap, *trailing]; chunk s holds the rows
+    sent by source shard s (front-packed within the chunk, garbage after its
+    count).
     """
-    buf = jnp.zeros((num_partitions * bucket_cap,), data.dtype).at[dest].set(
-        data, mode="drop"
-    )
+    trailing = data.shape[1:]
+    buf = jnp.zeros((num_partitions * bucket_cap, *trailing), data.dtype).at[
+        dest
+    ].set(data, mode="drop")
     return jax.lax.all_to_all(
-        buf.reshape(num_partitions, bucket_cap),
+        buf.reshape(num_partitions, bucket_cap, *trailing),
         axis_name,
         split_axis=0,
         concat_axis=0,
         tiled=False,
-    ).reshape(num_partitions * bucket_cap)
+    ).reshape(num_partitions * bucket_cap, *trailing)
 
 
 def exchange_columns(
@@ -131,24 +136,12 @@ def exchange_columns(
     one scatter and one collective instead of one pair per column. float64
     columns (no 32-bit lane route on TPU) fall back to the per-column path.
     """
-    from ..ops.gather import pack_cols, unpack_cols
-
     plan, lanes, passthrough = pack_cols(cols)
     out_lanes: List[jax.Array] = []
     if lanes:
         packed = jnp.stack(lanes, axis=1)  # [cap, L]
-        L = packed.shape[1]
-        buf = jnp.zeros((num_partitions * bucket_cap, L), packed.dtype).at[
-            dest
-        ].set(packed, mode="drop")
-        got = jax.lax.all_to_all(
-            buf.reshape(num_partitions, bucket_cap, L),
-            axis_name,
-            split_axis=0,
-            concat_axis=0,
-            tiled=False,
-        ).reshape(num_partitions * bucket_cap, L)
-        out_lanes = [got[:, j] for j in range(L)]
+        got = exchange_column(packed, dest, num_partitions, bucket_cap, axis_name)
+        out_lanes = [got[:, j] for j in range(packed.shape[1])]
 
     out, _ = unpack_cols(
         plan,
@@ -177,8 +170,6 @@ def compact_received(
 ) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
     """Front-pack received rows (stable), restoring the live-prefix
     invariant. All columns ride ONE packed row gather (see ops/gather)."""
-    from ..ops.gather import pack_gather
-
     order = jnp.argsort(~mask, stable=True).astype(jnp.int32)
     gathered, _ = pack_gather(cols, order)
     # pack_gather merges ok=order>=0 (always True here) into validity; keep

@@ -1,0 +1,115 @@
+/*
+ * JVM-side runtime bridge for the cylon_tpu C ABI (native/capi.cpp).
+ *
+ * Reference analog: java/src/main/native/src/TwisterXContext.cpp +
+ * Table.cpp — the JNI layer the reference hand-writes. Here the Java 22+
+ * Foreign Function & Memory API (java.lang.foreign) binds the same C ABI
+ * the standalone C client (native/examples/capi_client.c) uses, so no
+ * hand-written JNI glue is needed at all.
+ *
+ * NOTE: this build image has no JVM, so this source is compiled and
+ * exercised only where a JDK >= 22 exists:
+ *
+ *   javac java/org/cylondata/cylontpu/*.java
+ *   java --enable-native-access=ALL-UNNAMED \
+ *        org.cylondata.cylontpu.Table <capi.so> <l.csv> <r.csv> <out.csv>
+ */
+package org.cylondata.cylontpu;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.invoke.MethodHandle;
+
+import static java.lang.foreign.ValueLayout.ADDRESS;
+import static java.lang.foreign.ValueLayout.JAVA_INT;
+import static java.lang.foreign.ValueLayout.JAVA_LONG;
+
+/** Process-wide binding to the cylon_tpu C ABI; one embedded interpreter. */
+public final class CylonTpu {
+  final MethodHandle lastError;
+  final MethodHandle init;
+  final MethodHandle readCsv;
+  final MethodHandle join;
+  final MethodHandle sort;
+  final MethodHandle project;
+  final MethodHandle rowCount;
+  final MethodHandle columnCount;
+  final MethodHandle writeCsv;
+  final MethodHandle release;
+  final MethodHandle shutdown;
+  final Arena arena = Arena.ofShared();
+
+  private static CylonTpu instance;
+
+  /** Load the capi shared library and resolve every ct_api_* symbol. */
+  public static synchronized CylonTpu load(String capiSoPath) {
+    if (instance == null) {
+      instance = new CylonTpu(capiSoPath);
+      int rc;
+      try {
+        rc = (int) instance.init.invokeExact();
+      } catch (Throwable t) {
+        throw new RuntimeException("ct_api_init invocation failed", t);
+      }
+      if (rc != 0) {
+        throw new RuntimeException("ct_api_init failed: " + instance.errorMessage());
+      }
+      Runtime.getRuntime().addShutdownHook(new Thread(() -> {
+        try {
+          instance.shutdown.invokeExact();
+        } catch (Throwable ignored) {
+        }
+      }));
+    }
+    return instance;
+  }
+
+  private CylonTpu(String capiSoPath) {
+    Linker linker = Linker.nativeLinker();
+    SymbolLookup lib = SymbolLookup.libraryLookup(capiSoPath, arena);
+    lastError = handle(linker, lib, "ct_api_last_error",
+        FunctionDescriptor.of(ADDRESS));
+    init = handle(linker, lib, "ct_api_init", FunctionDescriptor.of(JAVA_INT));
+    readCsv = handle(linker, lib, "ct_api_read_csv",
+        FunctionDescriptor.of(JAVA_LONG, ADDRESS));
+    join = handle(linker, lib, "ct_api_join",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, JAVA_LONG, ADDRESS, ADDRESS, JAVA_INT));
+    sort = handle(linker, lib, "ct_api_sort",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, ADDRESS, JAVA_INT));
+    project = handle(linker, lib, "ct_api_project",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, ADDRESS));
+    rowCount = handle(linker, lib, "ct_api_row_count",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG));
+    columnCount = handle(linker, lib, "ct_api_column_count",
+        FunctionDescriptor.of(JAVA_INT, JAVA_LONG));
+    writeCsv = handle(linker, lib, "ct_api_write_csv",
+        FunctionDescriptor.of(JAVA_INT, JAVA_LONG, ADDRESS));
+    release = handle(linker, lib, "ct_api_release",
+        FunctionDescriptor.ofVoid(JAVA_LONG));
+    shutdown = handle(linker, lib, "ct_api_shutdown", FunctionDescriptor.ofVoid());
+  }
+
+  private static MethodHandle handle(Linker linker, SymbolLookup lib,
+      String name, FunctionDescriptor desc) {
+    MemorySegment sym = lib.find(name)
+        .orElseThrow(() -> new UnsatisfiedLinkError("missing symbol " + name));
+    return linker.downcallHandle(sym, desc);
+  }
+
+  /** The last ct_api error message (empty string when none). */
+  public String errorMessage() {
+    try {
+      MemorySegment p = (MemorySegment) lastError.invokeExact();
+      return p.reinterpret(Long.MAX_VALUE).getString(0);
+    } catch (Throwable t) {
+      return "(error message unavailable: " + t + ")";
+    }
+  }
+
+  MemorySegment cstr(Arena a, String s) {
+    return a.allocateFrom(s);
+  }
+}

@@ -1,0 +1,44 @@
+"""The shipped examples run end-to-end on the virtual mesh.
+
+Reference analog: python/test/test_uno_app.py — an end-to-end application
+test over the public API (SURVEY.md §4.2). Sizes are shrunk; the assertions
+live inside the examples themselves (result checks, learnability check)."""
+import numpy as np
+
+
+def test_etl_logreg_end_to_end(devices):
+    from examples.etl_logreg import main
+
+    loss, acc = main(n_tx=30_000, n_users=3_000)
+    assert np.isfinite(loss)
+    assert acc > 0.85
+
+
+def test_join_groupby_example_flow(devices):
+    # the example's exact flow at test size (the 1M-row original is the
+    # bench config; this keeps the suite fast)
+    import pandas as pd
+
+    import cylon_tpu as ct
+
+    env = ct.CylonEnv(config=ct.TPUConfig())
+    rng = np.random.default_rng(0)
+    n = 20_000
+    orders = pd.DataFrame(
+        {"cust": rng.integers(0, 500, n), "price": rng.gamma(2.0, 50.0, n)}
+    )
+    customers = pd.DataFrame(
+        {"cust": np.arange(500), "segment": rng.choice(list("abc"), 500)}
+    )
+    joined = ct.DataFrame(orders).merge(ct.DataFrame(customers), on="cust", env=env)
+    assert len(joined) == n
+    by_seg = joined.groupby("segment", env=env).agg({"price": "sum"})
+    got = by_seg.to_pandas().sort_values("segment")["price_sum"].to_numpy()
+    want = (
+        orders.assign(segment=customers.set_index("cust").loc[orders.cust, "segment"].values)
+        .groupby("segment")["price"]
+        .sum()
+        .sort_index()
+        .to_numpy()
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5)
