@@ -577,6 +577,15 @@ class Table:
         cols[name] = col
         return self._replace(columns=cols)
 
+    def live_mask(self) -> jax.Array:
+        """Public [P*cap] bool device mask of live rows (False = padding).
+
+        The ML-handoff companion of ``Column.data``: when feeding the sharded
+        column buffers straight into a jitted model (see
+        examples/etl_logreg.py), use this as the sample-weight mask so
+        padding rows contribute zero. Same sharding as the columns."""
+        return self._live_mask()
+
     def _live_mask(self) -> jax.Array:
         """Global [P*cap] bool mask of live rows."""
         cap = self._shard_cap

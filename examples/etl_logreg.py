@@ -64,7 +64,7 @@ def train(table, steps: int = 80, lr: float = 0.5):
 
     feat_names = ["amount_sum", "night_mean", "tenure_max"]
     cols = [table.column(n).data.astype(jnp.float32) for n in feat_names]
-    live = table._live_mask()  # padding rows -> weight 0
+    live = table.live_mask()  # padding rows -> weight 0
 
     w = live.astype(jnp.float32)
     X = jnp.stack(cols, axis=-1)  # [rows, d] sharded over the mesh

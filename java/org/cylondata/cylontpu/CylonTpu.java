@@ -43,28 +43,44 @@ public final class CylonTpu {
   final Arena arena = Arena.ofShared();
 
   private static CylonTpu instance;
+  private static String instancePath;
 
-  /** Load the capi shared library and resolve every ct_api_* symbol. */
+  /** Load the capi shared library and resolve every ct_api_* symbol.
+   *  The embedded interpreter is process-wide, so only ONE library may ever
+   *  be loaded; a different path on a later call is an error, and a failed
+   *  init is retryable (the singleton is published only on success). */
   public static synchronized CylonTpu load(String capiSoPath) {
-    if (instance == null) {
-      instance = new CylonTpu(capiSoPath);
-      int rc;
-      try {
-        rc = (int) instance.init.invokeExact();
-      } catch (Throwable t) {
-        throw new RuntimeException("ct_api_init invocation failed", t);
+    if (instance != null) {
+      if (!instance.samePath(capiSoPath)) {
+        throw new IllegalStateException(
+            "cylon_tpu already loaded from " + instancePath
+                + "; cannot load " + capiSoPath);
       }
-      if (rc != 0) {
-        throw new RuntimeException("ct_api_init failed: " + instance.errorMessage());
-      }
-      Runtime.getRuntime().addShutdownHook(new Thread(() -> {
-        try {
-          instance.shutdown.invokeExact();
-        } catch (Throwable ignored) {
-        }
-      }));
+      return instance;
     }
+    CylonTpu rt = new CylonTpu(capiSoPath);
+    int rc;
+    try {
+      rc = (int) rt.init.invokeExact();
+    } catch (Throwable t) {
+      throw new RuntimeException("ct_api_init invocation failed", t);
+    }
+    if (rc != 0) {
+      throw new RuntimeException("ct_api_init failed: " + rt.errorMessage());
+    }
+    instance = rt;
+    instancePath = capiSoPath;
+    Runtime.getRuntime().addShutdownHook(new Thread(() -> {
+      try {
+        rt.shutdown.invokeExact();
+      } catch (Throwable ignored) {
+      }
+    }));
     return instance;
+  }
+
+  private boolean samePath(String path) {
+    return path != null && path.equals(instancePath);
   }
 
   private CylonTpu(String capiSoPath) {

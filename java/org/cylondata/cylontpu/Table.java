@@ -13,7 +13,6 @@
 package org.cylondata.cylontpu;
 
 import java.lang.foreign.Arena;
-import java.lang.foreign.MemorySegment;
 
 /** An immutable handle to a cylon_tpu table living behind the C ABI. */
 public final class Table implements AutoCloseable {
@@ -26,6 +25,24 @@ public final class Table implements AutoCloseable {
     this.handle = handle;
   }
 
+  @FunctionalInterface
+  private interface NativeCall<T> {
+    T run(Arena a) throws Throwable;
+  }
+
+  /** One place for the call boilerplate: confined arena for C strings,
+   *  native error message on failure, uniform exception wrapping. */
+  private static <T> T call(CylonTpu rt, String op, NativeCall<T> body) {
+    try (Arena a = Arena.ofConfined()) {
+      return body.run(a);
+    } catch (RuntimeException e) {
+      throw e;
+    } catch (Throwable t) {
+      throw new RuntimeException(
+          op + " failed: " + rt.errorMessage(), t);
+    }
+  }
+
   private static Table wrap(CylonTpu rt, long h, String op) {
     if (h == 0) {
       throw new RuntimeException(op + " failed: " + rt.errorMessage());
@@ -35,14 +52,9 @@ public final class Table implements AutoCloseable {
 
   /** Reference Table.java fromCSV(ctx, path) :63. */
   public static Table fromCSV(CylonTpu rt, String path) {
-    try (Arena a = Arena.ofConfined()) {
-      long h = (long) rt.readCsv.invokeExact(rt.cstr(a, path));
-      return wrap(rt, h, "read_csv(" + path + ")");
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    return call(rt, "read_csv", a ->
+        wrap(rt, (long) rt.readCsv.invokeExact(rt.cstr(a, path)),
+            "read_csv(" + path + ")"));
   }
 
   /** Local equi-join; how in {inner,left,right,outer}. Reference :126. */
@@ -56,84 +68,57 @@ public final class Table implements AutoCloseable {
   }
 
   private Table joinImpl(Table right, String on, String how, int dist) {
-    try (Arena a = Arena.ofConfined()) {
-      long h = (long) rt.join.invokeExact(
-          handle, right.handle, rt.cstr(a, on), rt.cstr(a, how), dist);
-      return wrap(rt, h, "join");
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    return call(rt, "join", a ->
+        wrap(rt, (long) rt.join.invokeExact(
+            handle, right.handle, rt.cstr(a, on), rt.cstr(a, how), dist),
+            "join"));
   }
 
   /** Sort by one column (ascending). Reference sort :190. */
   public Table sort(String column, boolean distributed) {
-    try (Arena a = Arena.ofConfined()) {
-      long h = (long) rt.sort.invokeExact(
-          handle, rt.cstr(a, column), distributed ? 1 : 0);
-      return wrap(rt, h, "sort");
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    return call(rt, "sort", a ->
+        wrap(rt, (long) rt.sort.invokeExact(
+            handle, rt.cstr(a, column), distributed ? 1 : 0), "sort"));
   }
 
   /** Keep only the named columns (comma-separated). Reference select :219. */
   public Table project(String columnsCsv) {
-    try (Arena a = Arena.ofConfined()) {
-      long h = (long) rt.project.invokeExact(handle, rt.cstr(a, columnsCsv));
-      return wrap(rt, h, "project");
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    return call(rt, "project", a ->
+        wrap(rt, (long) rt.project.invokeExact(
+            handle, rt.cstr(a, columnsCsv)), "project"));
   }
 
   /** Global live row count. Reference rowCount :200. */
   public long rowCount() {
-    try {
+    return call(rt, "row_count", a -> {
       long n = (long) rt.rowCount.invokeExact(handle);
       if (n < 0) {
         throw new RuntimeException("row_count failed: " + rt.errorMessage());
       }
       return n;
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    });
   }
 
   /** Column count. Reference columnCount :205. */
   public int columnCount() {
-    try {
+    return call(rt, "column_count", a -> {
       int n = (int) rt.columnCount.invokeExact(handle);
       if (n < 0) {
         throw new RuntimeException("column_count failed: " + rt.errorMessage());
       }
       return n;
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+    });
   }
 
   /** Write the table to CSV (gathered on the host edge). Reference :233. */
   public void writeCSV(String path) {
-    try (Arena a = Arena.ofConfined()) {
+    call(rt, "write_csv", a -> {
       int rc = (int) rt.writeCsv.invokeExact(handle, rt.cstr(a, path));
       if (rc != 0) {
         throw new RuntimeException("write_csv failed: " + rt.errorMessage());
       }
-    } catch (RuntimeException e) {
-      throw e;
-    } catch (Throwable t) {
-      throw new RuntimeException(t);
-    }
+      return null;
+    });
   }
 
   /** Release the native handle (idempotent). */
