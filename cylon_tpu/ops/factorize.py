@@ -92,7 +92,9 @@ def factorize_two(
         if valid is not None:
             lanes.append((~valid).astype(jnp.int8))
     lanes.append((~live).astype(jnp.int8))  # most significant: padding last
-    order = jnp.lexsort(tuple(lanes)).astype(jnp.int32)
+    from .sort import lexsort_indices
+
+    order = lexsort_indices(lanes, cap)
     sorted_cols = [
         (data[order], None if valid is None else valid[order])
         for data, valid in cat_cols

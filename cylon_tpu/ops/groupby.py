@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .factorize import factorize
-from .sort import KeyCol, wide_float, wide_int
+from .sort import KeyCol, wide_float, wide_int, lexsort_indices
 
 # aggregation op ids, mirroring reference AggregationOpId
 # (compute/aggregate_kernels.hpp:40-50)
@@ -160,7 +160,7 @@ def aggregate_column(
         d = data
         if jnp.issubdtype(d.dtype, jnp.floating):
             d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
-        order = jnp.lexsort((d, live_ids))
+        order = lexsort_indices([d, live_ids], cap)
         sid = live_ids[order]
         sval = d[order]
         newpair = (
@@ -171,7 +171,7 @@ def aggregate_column(
     if op == QUANTILE:
         cap = data.shape[0]
         d = _masked(data.astype(wide_float()), vmask, jnp.inf)
-        order = jnp.lexsort((d, live_ids))
+        order = lexsort_indices([d, live_ids], cap)
         sid = live_ids[order]
         sval = d[order]
         # method='sort': the default 'scan' binary search is ~8x slower on TPU

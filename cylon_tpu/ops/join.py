@@ -74,6 +74,13 @@ def _merged_counts(
     ``lo`` is only meaningful where ``cnt > 0`` (emit clips it elsewhere);
     padding rows report cnt == 0 / r_cnt == 0.
     """
+    from .sort import (
+        run_count_from,
+        run_count_upto,
+        run_start_broadcast,
+        sentinel_compact,
+    )
+
     keys = jnp.concatenate([r_ids, l_ids])  # rights FIRST (tie order matters)
     pay = jnp.arange(cap_r + cap_l, dtype=jnp.int32)
     skey, spay = jax.lax.sort((keys, pay), num_keys=1, is_stable=True)
@@ -82,25 +89,22 @@ def _merged_counts(
     rl = is_r_live.astype(jnp.int32)
     r_excl = jnp.cumsum(rl) - rl  # live rights strictly before each position
     new_run = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
-    lo_run = jax.lax.cummax(jnp.where(new_run, r_excl, 0))  # r_excl @ run start
-    cnt_p = r_excl + rl - lo_run  # live rights in run up to AND including p
+    lo_run = run_start_broadcast(new_run, r_excl)  # r_excl @ run start
+    cnt_p = run_count_upto(new_run, is_r_live)  # live rights in run up to p
     big = jnp.int32(2**31 - 1)
-    key2 = jnp.where(is_l, spay, big)
-    _, lo_c, cnt_c = jax.lax.sort((key2, lo_run, cnt_p), num_keys=1, is_stable=True)
+    lo_c, cnt_c = sentinel_compact(
+        jnp.where(is_l, spay, big), [lo_run, cnt_p]
+    )
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     lo = lo_c[:cap_l]
     cnt = jnp.where(idx_l < nl, cnt_c[:cap_l], 0)
     if not need_rcnt:
         return lo, cnt, jnp.zeros((cap_r,), jnp.int32)
-    il = (is_l & (spay < cap_r + nl)).astype(jnp.int32)
-    il_r = jnp.flip(il)
-    run_end = jnp.concatenate([new_run[1:], jnp.ones((1,), bool)])
-    new_run_r = jnp.flip(run_end)
-    l_excl_r = jnp.cumsum(il_r) - il_r
-    l_lo_run_r = jax.lax.cummax(jnp.where(new_run_r, l_excl_r, 0))
-    rcnt_p = jnp.flip(l_excl_r + il_r - l_lo_run_r)
-    key3 = jnp.where(~is_l, spay, big)
-    _, rcnt_c = jax.lax.sort((key3, rcnt_p), num_keys=1, is_stable=True)
+    # lefts come after rights within a run, so counting "at/after me" from
+    # a right position sees exactly the run's live lefts
+    is_l_live = is_l & (spay < cap_r + nl)
+    rcnt_p = run_count_from(new_run, is_l_live)
+    (rcnt_c,) = sentinel_compact(jnp.where(~is_l, spay, big), [rcnt_p])
     idx_r = jnp.arange(cap_r, dtype=jnp.int32)
     r_cnt = jnp.where(idx_r < nr, rcnt_c[:cap_r], 0)
     return lo, cnt, r_cnt

@@ -4,21 +4,31 @@ Reference analog: cpp/src/cylon/table.cpp — Union (:531-603), Subtract
 (:605-663), Intersect (:665-721) via ``TwoTableRowIndexHash`` bytell hash sets
 over full-row keys; Unique (:923-982) with keep-first/last.
 
-TPU-native design: no hash sets — rows are factorized to dense ids
-(sort + run-detect, see ops/factorize.py) and the set algebra becomes segment
-counting + mask compaction. Output preserves first-occurrence order (matching
-pandas and the reference's keep-first semantics).
+TPU-native design: no hash sets and (since round 2) no scatters either — the
+whole set algebra runs in SORTED SPACE. One stable multi-operand ``lax.sort``
+orders both tables' rows by canonical key lanes with an iota payload; run
+boundaries + prefix-scan run totals decide membership, and compaction back to
+row indices is one more payload sort. Sorts run near memory bandwidth on TPU
+while scatters pay per element, so this replaces the earlier
+factorize -> scatter-id -> scatter-flag -> scatter-first pipeline (4 big
+scatters) with 2 sorts + O(n) scans. Output preserves first-occurrence order
+(matching pandas and the reference's keep-first semantics).
 """
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from .factorize import factorize, factorize_two
-from .sort import KeyCol
+from .sort import (
+    KeyCol,
+    lanes_differ,
+    lexsort_with_payload,
+    orderable_key,
+    run_count_from,
+    sentinel_compact,
+)
 
 
 def compact_mask(mask: jax.Array, cap_out: int) -> Tuple[jax.Array, jax.Array]:
@@ -45,89 +55,144 @@ def compact_mask(mask: jax.Array, cap_out: int) -> Tuple[jax.Array, jax.Array]:
     return idx, total
 
 
-def _first_occurrence_mask(
-    ids: jax.Array, n: jax.Array, keep: str = "first", id_cap: int | None = None
-) -> jax.Array:
-    """Bool [cap]: row is the first (or last) live occurrence of its id.
+def _sort_lanes(cols: Sequence[KeyCol], live: jax.Array) -> List[jax.Array]:
+    """Canonical key lanes for one combined row ordering, most significant
+    first: [padding-last class, per column: (null lane, value lane)].
 
-    ``id_cap``: upper bound (inclusive sentinel) on id values; defaults to the
-    row capacity (ids from single-table :func:`factorize`). For ids produced
-    by :func:`factorize_two` pass ``cap_l + cap_r``.
+    Value lanes are zeroed under null so that a run of nulls is ONE run
+    regardless of the masked payload (rows_differ semantics: null == null).
     """
-    cap = ids.shape[0]
-    if id_cap is None:
-        id_cap = cap
-    rows = jnp.arange(cap, dtype=jnp.int32)
-    live = rows < n
-    safe_ids = jnp.where(live, ids, id_cap)
-    if keep == "last":
-        rep = jnp.full((id_cap + 1,), -1, jnp.int32).at[safe_ids].max(rows, mode="drop")
+    lanes: List[jax.Array] = [(~live).astype(jnp.uint8)]
+    for data, valid in cols:
+        vlane = orderable_key(data)
+        if valid is not None:
+            lanes.append((~valid).astype(jnp.uint8))
+            vlane = jnp.where(valid, vlane, jnp.zeros_like(vlane))
+        lanes.append(vlane)
+    return lanes
+
+
+def _sorted_runs(lanes: List[jax.Array], pay: jax.Array):
+    """Stable row ordering + run boundaries via chained 1-key sorts
+    (multi-key XLA sorts compile ~4x slower for equal warm time — see
+    ops.sort.lexsort_with_payload).
+
+    Returns (spay [cap] original indices in sorted order, new_run [cap]).
+    """
+    sorted_lanes, pays = lexsort_with_payload(list(reversed(lanes)), [pay])
+    sorted_lanes = list(reversed(sorted_lanes))  # back to msb-first
+    spay = pays[0]
+    diff = jnp.zeros(pay.shape, bool)
+    for lane in sorted_lanes:
+        prev = jnp.roll(lane, 1)
+        diff = diff | lanes_differ(lane, prev)
+    return spay, diff.at[0].set(True)
+
+
+def _emit_by_pay(
+    keep: jax.Array, spay: jax.Array, cap_out: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Compact kept rows back to ascending-original-index order: one stable
+    sort keyed by (keep ? original index : BIG sentinel)."""
+    big = jnp.int32(2**31 - 1)
+    (idx,) = sentinel_compact(jnp.where(keep, spay, big), [spay])
+    total = jnp.sum(keep).astype(jnp.int32)
+    cap = spay.shape[0]
+    if cap_out <= cap:
+        idx = idx[:cap_out]
     else:
-        rep = jnp.full((id_cap + 1,), cap, jnp.int32).at[safe_ids].min(rows, mode="drop")
-    return live & (rep[jnp.clip(safe_ids, 0, id_cap)] == rows)
+        idx = jnp.concatenate([idx, jnp.full((cap_out - cap,), -1, jnp.int32)])
+    idx = jnp.where(jnp.arange(cap_out, dtype=jnp.int32) < total, idx, -1)
+    return idx, total
+
+
+def _unique_keep(
+    key_cols: Sequence[KeyCol], n: jax.Array, cap: int, keep: str
+) -> Tuple[jax.Array, jax.Array]:
+    """(keep mask in sorted space, spay) for single-table dedup."""
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < n
+    spay, new_run = _sorted_runs(_sort_lanes(key_cols, live), idx)
+    live_sorted = spay < n
+    if keep == "last":
+        # stable sort => run's last live element has the max original index
+        run_end = jnp.concatenate([new_run[1:], jnp.ones((1,), bool)])
+        keepm = run_end & live_sorted
+    else:
+        keepm = new_run & live_sorted
+    return keepm, spay
 
 
 def unique_count(key_cols: Sequence[KeyCol], n: jax.Array, cap: int) -> jax.Array:
-    _, num_groups = factorize(key_cols, n, cap)
-    return num_groups
+    keepm, _ = _unique_keep(key_cols, n, cap, "first")
+    return jnp.sum(keepm).astype(jnp.int32)
 
 
 def unique_emit(
     key_cols: Sequence[KeyCol], n: jax.Array, cap: int, cap_out: int, keep: str = "first"
 ) -> Tuple[jax.Array, jax.Array]:
     """Row indices of the deduplicated table (first-occurrence order)."""
-    ids, _ = factorize(key_cols, n, cap)
-    mask = _first_occurrence_mask(ids, n, keep)
-    return compact_mask(mask, cap_out)
+    keepm, spay = _unique_keep(key_cols, n, cap, keep)
+    return _emit_by_pay(keepm, spay, cap_out)
 
 
-def _two_table_flags(
+def _two_table_keep(
     l_cols: Sequence[KeyCol],
     r_cols: Sequence[KeyCol],
     nl: jax.Array,
     nr: jax.Array,
     cap_l: int,
     cap_r: int,
-):
-    """ids for the left table + per-id presence counts in left and right."""
-    l_ids, r_ids, _ = factorize_two(l_cols, r_cols, nl, nr, cap_l, cap_r)
+    want_in_r: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """(keep mask, spay) over the combined sort: keep = first live LEFT row
+    of each run whose run does (intersect) / does not (subtract) contain a
+    live right row. Lefts precede rights within a run (stable sort over the
+    [left ++ right] concatenation), so the run's first element is a left
+    whenever the run has one."""
     cap = cap_l + cap_r
-    live_l = jnp.arange(cap_l) < nl
-    live_r = jnp.arange(cap_r) < nr
-    sl = jnp.where(live_l, l_ids, cap)
-    sr = jnp.where(live_r, r_ids, cap)
-    in_l = jnp.zeros((cap + 1,), bool).at[sl].set(True, mode="drop")
-    in_r = jnp.zeros((cap + 1,), bool).at[sr].set(True, mode="drop")
-    return l_ids, r_ids, live_l, live_r, in_l, in_r
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = (idx < nl) | ((idx >= cap_l) & (idx < cap_l + nr))
+    cat_cols: List[KeyCol] = []
+    for (ld, lv), (rd, rv) in zip(l_cols, r_cols):
+        if ld.dtype != rd.dtype:
+            from ..dtypes import promote_key_dtypes
+
+            common = promote_key_dtypes(ld.dtype, rd.dtype)
+            ld, rd = ld.astype(common), rd.astype(common)
+        data = jnp.concatenate([ld, rd])
+        if lv is None and rv is None:
+            valid = None
+        else:
+            lvm = jnp.ones((cap_l,), bool) if lv is None else lv
+            rvm = jnp.ones((cap_r,), bool) if rv is None else rv
+            valid = jnp.concatenate([lvm, rvm])
+        cat_cols.append((data, valid))
+    spay, new_run = _sorted_runs(_sort_lanes(cat_cols, live), idx)
+    is_l_live = spay < nl
+    is_r_live = (spay >= cap_l) & (spay < cap_l + nr)
+    # keep is evaluated at run STARTS only, where count-from == run total
+    r_in_run = run_count_from(new_run, is_r_live)
+    hit = (r_in_run > 0) if want_in_r else (r_in_run == 0)
+    keepm = new_run & is_l_live & hit
+    return keepm, spay
 
 
 def subtract_count(l_cols, r_cols, nl, nr, cap_l, cap_r) -> jax.Array:
-    l_ids, _, live_l, _, _, in_r = _two_table_flags(l_cols, r_cols, nl, nr, cap_l, cap_r)
-    ids = jnp.where(live_l, l_ids, cap_l + cap_r)
-    first = _first_occurrence_mask(ids, nl, id_cap=cap_l + cap_r)
-    keepm = first & ~in_r[jnp.clip(ids, 0, cap_l + cap_r)]
+    keepm, _ = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, False)
     return jnp.sum(keepm).astype(jnp.int32)
 
 
 def subtract_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
-    l_ids, _, live_l, _, _, in_r = _two_table_flags(l_cols, r_cols, nl, nr, cap_l, cap_r)
-    ids = jnp.where(live_l, l_ids, cap_l + cap_r)
-    first = _first_occurrence_mask(ids, nl, id_cap=cap_l + cap_r)
-    keepm = first & ~in_r[jnp.clip(ids, 0, cap_l + cap_r)]
-    return compact_mask(keepm, cap_out)
+    keepm, spay = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, False)
+    return _emit_by_pay(keepm, spay, cap_out)
 
 
 def intersect_count(l_cols, r_cols, nl, nr, cap_l, cap_r) -> jax.Array:
-    l_ids, _, live_l, _, _, in_r = _two_table_flags(l_cols, r_cols, nl, nr, cap_l, cap_r)
-    ids = jnp.where(live_l, l_ids, cap_l + cap_r)
-    first = _first_occurrence_mask(ids, nl, id_cap=cap_l + cap_r)
-    keepm = first & in_r[jnp.clip(ids, 0, cap_l + cap_r)]
+    keepm, _ = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, True)
     return jnp.sum(keepm).astype(jnp.int32)
 
 
 def intersect_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
-    l_ids, _, live_l, _, _, in_r = _two_table_flags(l_cols, r_cols, nl, nr, cap_l, cap_r)
-    ids = jnp.where(live_l, l_ids, cap_l + cap_r)
-    first = _first_occurrence_mask(ids, nl, id_cap=cap_l + cap_r)
-    keepm = first & in_r[jnp.clip(ids, 0, cap_l + cap_r)]
-    return compact_mask(keepm, cap_out)
+    keepm, spay = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, True)
+    return _emit_by_pay(keepm, spay, cap_out)

@@ -122,6 +122,104 @@ def row_class(
     return cls
 
 
+def lexsort_with_payload(
+    lanes: Sequence[jax.Array],
+    payloads: Sequence[jax.Array],
+    keep_lanes: bool = True,
+) -> Tuple[list, list]:
+    """``jnp.lexsort``-equivalent (lanes least-significant FIRST) as CHAINED
+    stable 1-key sorts, carrying ``payloads`` through every pass.
+
+    TPU rationale: XLA's multi-key sort comparator blows up compile time
+    super-linearly in the key count (measured on v5e at 4M rows: 1 key 13 s,
+    3 keys 148 s) while warm time is no better than k chained 1-key passes
+    (80 ms vs 76 ms). LSD radix order — sort by the least significant lane
+    first — plus per-pass stability reproduces the multi-key order exactly
+    (verified element-identical).
+
+    ``keep_lanes=False`` drops each lane after the pass it keys (a consumed
+    lane is never read again), saving ~k/2 lanes of memory-bandwidth-bound
+    traffic per pass for callers that only want the payloads.
+
+    Returns (sorted_lanes | None, sorted_payloads).
+    """
+    k = len(lanes)
+    if not keep_lanes:
+        pending = list(lanes)  # least-significant first; index 0 keys next
+        carry = list(payloads)
+        for _ in range(k):
+            key, *pending = pending
+            out = jax.lax.sort(
+                tuple([key] + pending + carry), num_keys=1, is_stable=True
+            )
+            pending = list(out[1 : 1 + len(pending)])
+            carry = list(out[1 + len(pending) :])
+        return None, carry
+    ops = list(lanes) + list(payloads)
+    for i in range(k):  # least significant first
+        rest = [ops[j] for j in range(len(ops)) if j != i]
+        out = jax.lax.sort(tuple([ops[i]] + rest), num_keys=1, is_stable=True)
+        ops = [None] * len(ops)
+        ops[i] = out[0]
+        rj = 1
+        for j in range(len(ops)):
+            if ops[j] is None:
+                ops[j] = out[rj]
+                rj += 1
+    return ops[:k], ops[k:]
+
+
+def lexsort_indices(lanes: Sequence[jax.Array], cap: int) -> jax.Array:
+    """Permutation that stably lexsorts ``lanes`` (least-significant first):
+    the chained-pass replacement for ``jnp.lexsort``."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    _, pays = lexsort_with_payload(lanes, [iota], keep_lanes=False)
+    return pays[0]
+
+
+# ---------------------------------------------------------------------------
+# run (equal-key segment) scans over a sorted order — shared by the join
+# probe (ops/join._merged_counts) and the set algebra (ops/setops): ONE
+# implementation of the subtle prefix-scan idioms.
+# ---------------------------------------------------------------------------
+
+def run_start_broadcast(new_run: jax.Array, prefix: jax.Array) -> jax.Array:
+    """Broadcast each run's first ``prefix`` value over the whole run.
+
+    Valid only for NON-DECREASING ``prefix`` (e.g. a cumsum): cummax of the
+    run-start-masked values then reproduces the start value everywhere."""
+    return jax.lax.cummax(jnp.where(new_run, prefix, 0))
+
+
+def run_count_upto(new_run: jax.Array, flag: jax.Array) -> jax.Array:
+    """[cap] int32: how many ``flag`` positions MY run has at/before me."""
+    f = flag.astype(jnp.int32)
+    excl = jnp.cumsum(f) - f
+    return excl + f - run_start_broadcast(new_run, excl)
+
+
+def run_count_from(new_run: jax.Array, flag: jax.Array) -> jax.Array:
+    """[cap] int32: how many ``flag`` positions MY run has at/after me.
+
+    Mirror of :func:`run_count_upto` on flipped arrays (a run's end is the
+    flipped run's start). At a run START this is the run's total count."""
+    f_r = jnp.flip(flag.astype(jnp.int32))
+    run_end = jnp.concatenate([new_run[1:], jnp.ones((1,), bool)])
+    new_run_r = jnp.flip(run_end)
+    excl_r = jnp.cumsum(f_r) - f_r
+    start_r = jax.lax.cummax(jnp.where(new_run_r, excl_r, 0))
+    return jnp.flip(excl_r + f_r - start_r)
+
+
+def sentinel_compact(key: jax.Array, payloads: Sequence[jax.Array]) -> list:
+    """Stable 1-key sort of ``payloads`` by ``key``: rows to keep carry an
+    ordering key (e.g. their original index), dropped rows a BIG sentinel
+    that pushes them past every kept row. The scatter-free compaction used
+    by the join probe and every set-op emit."""
+    out = jax.lax.sort(tuple([key] + list(payloads)), num_keys=1, is_stable=True)
+    return list(out[1:])
+
+
 def lexsort_rows(
     key_cols: Sequence[KeyCol],
     n: jax.Array,
@@ -136,7 +234,7 @@ def lexsort_rows(
     """
     if ascending is None:
         ascending = [True] * len(key_cols)
-    lanes = []  # least-significant first for jnp.lexsort
+    lanes = []  # least-significant first (lexsort convention)
     pad = row_class(n, cap, None)
     for (data, valid), asc in zip(reversed(list(key_cols)), list(reversed(list(ascending)))):
         lanes.append(_norm_key(data, asc))
@@ -146,7 +244,7 @@ def lexsort_rows(
                 null_lane = -null_lane
             lanes.append(null_lane)
     lanes.append(pad)  # most significant: padding always last
-    return jnp.lexsort(tuple(lanes)).astype(jnp.int32)
+    return lexsort_indices(lanes, cap)
 
 
 def rows_differ(

@@ -35,6 +35,7 @@ from .ops import groupby as _g
 from .ops import join as _j
 from .ops import partition as _p
 from .ops import setops as _s
+from .ops import gather as _g_pack
 from .ops.sort import lexsort_rows
 from .parallel import shuffle as _sh
 from .utils.tracing import bump, span
@@ -655,7 +656,7 @@ class Table:
                 cap = m.shape[0]
                 live = jnp.arange(cap, dtype=jnp.int32) < n
                 idx, total = _s.compact_mask(m & live, co)
-                out = [_j.gather_column(d, v, idx) for d, v in cols]
+                out, _ = _g_pack.pack_gather(list(cols), idx)
                 return out, _scalar(total)
 
             return kern
@@ -1316,7 +1317,7 @@ class Table:
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
                 idx, total = emit_fn(lk, rk, nl[0], nr[0], cap_l, cap_r, co)
-                out = [_j.gather_column(d, v, idx) for d, v in lk]
+                out, _ = _g_pack.pack_gather(list(lk), idx)
                 return out, _scalar(total)
 
             return kern
@@ -1388,7 +1389,7 @@ class Table:
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
                 idx, total = _s.unique_emit(keys, n, cap, co, keep)
-                out = [_j.gather_column(d, v, idx) for d, v in cols]
+                out, _ = _g_pack.pack_gather(list(cols), idx)
                 return out, _scalar(total)
 
             return kern

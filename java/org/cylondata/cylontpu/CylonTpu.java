@@ -63,10 +63,13 @@ public final class CylonTpu {
     try {
       rc = (int) rt.init.invokeExact();
     } catch (Throwable t) {
+      rt.arena.close(); // free the library mapping so a retry starts clean
       throw new RuntimeException("ct_api_init invocation failed", t);
     }
     if (rc != 0) {
-      throw new RuntimeException("ct_api_init failed: " + rt.errorMessage());
+      String err = rt.errorMessage();
+      rt.arena.close();
+      throw new RuntimeException("ct_api_init failed: " + err);
     }
     instance = rt;
     instancePath = capiSoPath;
