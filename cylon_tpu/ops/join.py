@@ -157,15 +157,16 @@ def _fast_path_ok(cols: Sequence[KeyCol]) -> bool:
     )
 
 
-def _probe(
+def _canonical_ids(
     l_key_cols: Sequence[KeyCol],
     r_key_cols: Sequence[KeyCol],
     nl: jax.Array,
     nr: jax.Array,
     cap_l: int,
     cap_r: int,
-    need_rcnt: bool = True,
-) -> _Probe:
+) -> Tuple[jax.Array, jax.Array]:
+    """Canonical comparable key ids for both tables, one integer dtype,
+    padding rows holding a value that sorts >= every live id."""
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     idx_r = jnp.arange(cap_r, dtype=jnp.int32)
     # promote key dtypes to a common type first: orderable_key lanes are only
@@ -200,6 +201,21 @@ def _probe(
         big = jnp.int32(cap_l + cap_r)  # sorts after every live dense id
         l_ids = jnp.where(idx_l < nl, l_ids, big)
         r_ids = jnp.where(idx_r < nr, r_ids, big)
+    return l_ids, r_ids
+
+
+def _probe(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    need_rcnt: bool = True,
+) -> _Probe:
+    l_ids, r_ids = _canonical_ids(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r
+    )
     r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
     lo, cnt, r_cnt = _merged_counts(
         l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
@@ -334,8 +350,8 @@ def emit_gather(
 
     INNER/LEFT fast path does exactly three big gathers: the ``jnp.repeat``
     for li, one packed left-row gather (payload + base/cnt lanes), and one
-    packed right-row gather against the r_order-permuted right payload (which
-    also yields ri through an extra lane). RIGHT/FULL_OUTER falls back to
+    packed right-row gather against the r_order-permuted right payload
+    (see :func:`_emit_inner_left`). RIGHT/FULL_OUTER falls back to
     :func:`emit_from_probe` indices + two packed gathers (the unmatched-right
     scatter does not fuse).
 
@@ -351,8 +367,32 @@ def emit_gather(
         out_r, _ = pack_gather(r_cols, ri)
         return out_l + out_r, n_out
 
+    # permute right payload into key-sorted order once (cap_r rows).
+    # r_order is a permutation (all indices >= 0), so columns that had no
+    # validity mask stay mask-free — don't let the all-True ok lane ride
+    # through the second (hot, cap_out-sized) gather.
+    r_sorted_cols, _ = pack_gather(r_cols, r_order)
+    r_sorted_cols = [
+        (d, None if rv is None else v)
+        for (d, v), (_, rv) in zip(r_sorted_cols, r_cols)
+    ]
+    return _emit_inner_left(
+        lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, r_order.shape[0]
+    )
+
+
+def _emit_inner_left(
+    lo, cnt,
+    l_cols: Sequence[KeyCol],
+    r_sorted_cols: Sequence[KeyCol],
+    nl, how: int, cap_out: int, cap_r: int,
+) -> Tuple[list, jax.Array]:
+    """INNER/LEFT emit against an ALREADY key-sorted right payload: the
+    ``jnp.repeat`` for li, one packed left-row gather (payload + base/cnt
+    lanes), one packed right-row gather at the run positions."""
+    from .gather import pack_gather
+
     cap_l = lo.shape[0]
-    cap_r = r_order.shape[0]
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     live_l = idx_l < nl
     if how == LEFT:
@@ -369,20 +409,79 @@ def emit_gather(
     li = jnp.where(out_pos < total_l, li, -1)
     out_l, (base_g, cnt_g) = pack_gather(l_cols, li, extra_lanes=[base, cnt])
 
-    # permute right payload into key-sorted order once (cap_r rows), then one
-    # packed gather at rpos delivers the whole right half of the output row.
-    # r_order is a permutation (all indices >= 0), so columns that had no
-    # validity mask stay mask-free — don't let the all-True ok lane ride
-    # through the second (hot, cap_out-sized) gather.
-    r_sorted_cols, _ = pack_gather(r_cols, r_order)
-    r_sorted_cols = [
-        (d, None if rv is None else v)
-        for (d, v), (_, rv) in zip(r_sorted_cols, r_cols)
-    ]
     has_match = (li >= 0) & (cnt_g > 0)
     rpos = jnp.where(has_match, jnp.clip(base_g + out_pos, 0, cap_r - 1), -1)
     out_r, _ = pack_gather(r_sorted_cols, rpos)
-    return out_l + out_r, total_l
+    return list(out_l) + list(out_r), total_l
+
+
+def spec_join(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    how: int,
+    cap_out: int,
+) -> Tuple[list, jax.Array, jax.Array]:
+    """Single-dispatch speculative join: probe + count + emit + gather in one
+    program with the minimal pass count.
+
+    On the INNER/LEFT path the right payload RIDES the key sort — one stable
+    multi-operand ``lax.sort`` keyed by the canonical right ids yields the
+    key-sorted right table directly, replacing the separate
+    ``argsort(r_ids)`` + packed permute gather of :func:`emit_gather` (and
+    mask-free columns stay mask-free with no lane codec at all).
+    RIGHT/FULL_OUTER composes the probe + emit pieces unchanged.
+
+    Returns (out_cols = left ++ right, exact total, float32 overflow shadow).
+    The caller compares ``total`` against ``cap_out`` on the host and falls
+    back to the exact two-phase path on overflow (table.py speculative join).
+    """
+    cap_l = l_key_cols[0][0].shape[0]
+    cap_r = r_key_cols[0][0].shape[0]
+    need_rcnt = how in (RIGHT, FULL_OUTER)
+    l_ids, r_ids = _canonical_ids(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    lo, cnt, r_cnt = _merged_counts(
+        l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
+    )
+    total = count_from_probe(cnt, r_cnt, nl, nr, how)
+    shadow = count_overflow_check(cnt, r_cnt)
+    # 64-bit payloads stay on the codec path (ops/gather lane codec): the
+    # TPU X64-rewrite pass has no audited lowering for 64-bit operands of a
+    # variadic sort, while the codec's hi/lo int32 lanes are proven
+    ride_sort = all(
+        np.dtype(d.dtype).itemsize <= 4 for d, _ in r_cols
+    )
+    if how in (INNER, LEFT) and ride_sort:
+        ops = [r_ids]
+        has_valid = []
+        for d, v in r_cols:
+            ops.append(d)
+            has_valid.append(v is not None)
+            if v is not None:
+                ops.append(v)
+        sorted_ops = jax.lax.sort(tuple(ops), num_keys=1, is_stable=True)
+        r_sorted = []
+        i = 1
+        for hv in has_valid:
+            d = sorted_ops[i]
+            i += 1
+            v = None
+            if hv:
+                v = sorted_ops[i]
+                i += 1
+            r_sorted.append((d, v))
+        out_cols, n_out = _emit_inner_left(
+            lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r
+        )
+    else:
+        r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+        out_cols, n_out = emit_gather(
+            lo, cnt, r_order, r_cnt, l_cols, r_cols, nl, nr, how, cap_out
+        )
+    return out_cols, total, shadow
 
 
 def gather_column(

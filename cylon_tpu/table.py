@@ -1041,16 +1041,8 @@ class Table:
                     (lk, rk, lcols, rcols, nl, nr) = dp
                     (dummy,) = rep
                     co = dummy.shape[0]
-                    cl = lk[0][0].shape[0]
-                    cr = rk[0][0].shape[0]
-                    lo, cnt, r_order, r_cnt = _j.probe_arrays(
-                        lk, rk, nl[0], nr[0], cl, cr, howi
-                    )
-                    total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
-                    shadow = _j.count_overflow_check(cnt, r_cnt)
-                    out, _ = _j.emit_gather(
-                        lo, cnt, r_order, r_cnt, lcols, rcols,
-                        nl[0], nr[0], howi, co,
+                    out, total, shadow = _j.spec_join(
+                        lk, rk, lcols, rcols, nl[0], nr[0], howi, co
                     )
                     # pack count + f32 overflow shadow into one [2] i32 lane
                     # so the host needs a single fetch
