@@ -48,7 +48,51 @@ class Column:
         if values.dtype.kind in ("U", "S", "O"):
             vals = np.asarray(values, dtype=object)
             is_null = np.array([v is None or (isinstance(v, float) and np.isnan(v)) for v in vals])
+            if values.dtype.kind == "O":
+                # Arrow-style inference for object columns: if every non-null
+                # value is numeric/bool, the column is numeric — NOT strings
+                # (pyarrow infers double/int64 here; stringifying would make
+                # -0.0 != 0.0 and "10" < "9").
+                live = [v for v, nul in zip(vals, is_null) if not nul]
+                if live and all(
+                    isinstance(v, (int, float, np.integer, np.floating, bool, np.bool_))
+                    for v in live
+                ):
+                    if all(isinstance(v, (bool, np.bool_)) for v in live):
+                        num = np.where(is_null, False, vals).astype(bool)
+                        return Column.encode_host(num) if not is_null.any() else (
+                            num, ~is_null, DataType.from_numpy_dtype(np.dtype(bool)), None
+                        )
+                    if all(
+                        isinstance(v, (int, np.integer)) and not isinstance(v, (bool, np.bool_))
+                        for v in live
+                    ):
+                        # exact int64 with a validity mask — a float64 fall-
+                        # back would corrupt keys above 2^53 (pyarrow infers
+                        # int64 + validity bitmap here too)
+                        num = np.where(is_null, 0, vals).astype(np.int64)
+                        if not is_null.any():
+                            return Column.encode_host(num)
+                        return (
+                            num, ~is_null,
+                            DataType.from_numpy_dtype(np.dtype(np.int64)), None,
+                        )
+                    num = np.full(len(vals), np.nan, np.float64)
+                    num[~is_null] = [float(v) for v in live]
+                    return Column.encode_host(num)
             filler = ""
+            # stray bools inside a string column stringify as 'true'/'false',
+            # matching promote_encoded_shards' BOOL->STRING promotion so the
+            # same logical value encodes identically on every shard
+            vals = np.asarray(
+                [
+                    ("true" if v is True else "false" if v is False else v)
+                    if isinstance(v, (bool, np.bool_))
+                    else v
+                    for v in vals
+                ],
+                dtype=object,
+            )
             safe = np.where(is_null, filler, vals)
             dictionary, codes = np.unique(np.asarray(safe, dtype=str), return_inverse=True)
             codes = codes.astype(np.int32)
@@ -96,6 +140,12 @@ class Column:
                 out[~valid_np] = np.datetime64("NaT")
             return out
         if valid_np is not None and not valid_np.all():
+            if self.dtype.type == Type.BOOL:
+                # keep booleans boolean (pandas object column with None),
+                # not 1.0/0.0 floats
+                out = data_np.astype(bool).astype(object)
+                out[~valid_np] = None
+                return out
             out = data_np.astype(np.float64, copy=True)
             out[~valid_np] = np.nan
             return out
