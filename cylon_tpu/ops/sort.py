@@ -232,11 +232,31 @@ def lexsort_rows(
     Returns a permutation [cap] with live rows ordered first, then null-key
     rows (per-column null ordering), then padding.
     """
+    return lexsort_rows_payload(key_cols, n, cap, [], ascending, nulls_last)[0]
+
+
+def lexsort_rows_payload(
+    key_cols: Sequence[KeyCol],
+    n: jax.Array,
+    cap: int,
+    payloads: Sequence[jax.Array],
+    ascending: Optional[Sequence[bool]] = None,
+    nulls_last: bool = True,
+) -> Tuple[jax.Array, list]:
+    """:func:`lexsort_rows` with ``payloads`` riding the sort passes.
+
+    Returns (order [cap] permutation, sorted_payloads). Carrying a column as
+    a payload operand costs ~one lane of memory traffic per pass; a separate
+    row gather by ``order`` costs a full random gather — on TPU the payload
+    route wins whenever the column fits a sort operand (<= 32-bit).
+    """
     if ascending is None:
         ascending = [True] * len(key_cols)
     lanes = []  # least-significant first (lexsort convention)
     pad = row_class(n, cap, None)
-    for (data, valid), asc in zip(reversed(list(key_cols)), list(reversed(list(ascending)))):
+    for (data, valid), asc in zip(
+        reversed(list(key_cols)), list(reversed(list(ascending)))
+    ):
         lanes.append(_norm_key(data, asc))
         if valid is not None:
             null_lane = (~valid).astype(jnp.int8)
@@ -244,7 +264,11 @@ def lexsort_rows(
                 null_lane = -null_lane
             lanes.append(null_lane)
     lanes.append(pad)  # most significant: padding always last
-    return lexsort_indices(lanes, cap)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    _, pays = lexsort_with_payload(
+        lanes, list(payloads) + [iota], keep_lanes=False
+    )
+    return pays[-1], pays[:-1]
 
 
 def rows_differ(

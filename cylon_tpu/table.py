@@ -36,7 +36,7 @@ from .ops import join as _j
 from .ops import partition as _p
 from .ops import setops as _s
 from .ops import gather as _g_pack
-from .ops.sort import lexsort_rows
+from .ops import sort as _sort_mod
 from .parallel import shuffle as _sh
 from .utils.tracing import bump, span
 
@@ -745,10 +745,44 @@ class Table:
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                order = lexsort_rows(keys, n, cap, ascending=list(asc))
-                return [
-                    (d[order], None if v is None else v[order]) for d, v in cols
+                # <=32-bit columns RIDE the sort as payload operands (a lane
+                # per pass instead of a random row gather — ops/sort
+                # lexsort_rows_payload); 64-bit columns fall back to one
+                # packed gather by the order (the int32 lane codec path)
+                ride = [
+                    np.dtype(d.dtype).itemsize <= 4 for d, _ in cols
                 ]
+                payloads = []
+                for (d, v), r in zip(cols, ride):
+                    if r:
+                        payloads.append(d)
+                        if v is not None:
+                            payloads.append(v)
+                order, spays = _sort_mod.lexsort_rows_payload(
+                    keys, n, cap, payloads, ascending=list(asc)
+                )
+                heavy = [cols[i] for i, r in enumerate(ride) if not r]
+                heavy_out = (
+                    _g_pack.pack_gather(heavy, order)[0] if heavy else []
+                )
+                out = []
+                pi = hi = 0
+                for (d, v), r in zip(cols, ride):
+                    if r:
+                        sd = spays[pi]
+                        pi += 1
+                        sv = None
+                        if v is not None:
+                            sv = spays[pi]
+                            pi += 1
+                        out.append((sd, sv))
+                    else:
+                        gd, gv = heavy_out[hi]
+                        hi += 1
+                        # order is a permutation (no -1): keep mask-free
+                        # columns mask-free
+                        out.append((gd, None if v is None else gv))
+                return out
 
             return kern
 
