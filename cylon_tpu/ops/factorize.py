@@ -17,7 +17,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sort import KeyCol, lexsort_rows, rows_differ
+from .sort import (
+    KeyCol,
+    canonical_row_lanes,
+    sentinel_compact,
+    sorted_runs,
+)
 
 
 def factorize(
@@ -27,20 +32,23 @@ def factorize(
 
     Returns (ids [cap] int32 — padding rows get id ``cap``;
              num_groups scalar int32).
+
+    Scatter-free and gather-free: the canonical lanes ride the chained sort
+    (run boundaries come from the SORTED lanes, no per-column re-gather),
+    and the ids return to original row order through one payload sort keyed
+    by the carried original index (instead of a scatter).
     """
-    order = lexsort_rows(key_cols, n, cap)
-    sorted_cols = [
-        (data[order], None if valid is None else valid[order])
-        for data, valid in key_cols
-    ]
-    diff = rows_differ(sorted_cols, cap)
-    live_sorted = jnp.arange(cap, dtype=jnp.int32) < n  # live rows sort first
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < n
+    lanes = canonical_row_lanes(key_cols, live)  # msb first
+    order, diff = sorted_runs(lanes, idx)
+    live_sorted = idx < n  # live rows sort first (class lane)
     ids_sorted = jnp.cumsum(diff.astype(jnp.int32)) - 1
     num_groups = jnp.where(n > 0, ids_sorted[jnp.maximum(n - 1, 0)] + 1, 0).astype(
         jnp.int32
     )
     ids_sorted = jnp.where(live_sorted, ids_sorted, cap)
-    ids = jnp.zeros((cap,), jnp.int32).at[order].set(ids_sorted)
+    (ids,) = sentinel_compact(order, [ids_sorted])  # back to original order
     return ids, num_groups
 
 
@@ -76,36 +84,20 @@ def factorize_two(
             rvm = jnp.ones((cap_r,), bool) if rv is None else rv
             valid = jnp.concatenate([lvm, rvm])
         cat_cols.append((data, valid))
-    # left live rows are [0, nl); right live rows are [cap_l, cap_l+nr).
-    # factorize() assumes live rows are the first n — build an explicit
-    # live mask instead by reusing its internals.
+    # left live rows are [0, nl); right live rows are [cap_l, cap_l+nr):
+    # the class lane sorts ALL live rows first, so in sorted order live rows
+    # occupy the [0, nl+nr) prefix. Same scatter/gather-free layout as
+    # :func:`factorize`.
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = (idx < nl) | ((idx >= cap_l) & (idx < cap_l + nr))
-    # lexsort with live-mask ordering: piggyback on lexsort_rows by passing a
-    # synthetic "n" equal to cap and a leading class lane via valid trick is
-    # messy; do it directly here.
-    lanes = []
-    for data, valid in reversed(cat_cols):
-        from .sort import orderable_key
-
-        lanes.append(orderable_key(data))
-        if valid is not None:
-            lanes.append((~valid).astype(jnp.int8))
-    lanes.append((~live).astype(jnp.int8))  # most significant: padding last
-    from .sort import lexsort_indices
-
-    order = lexsort_indices(lanes, cap)
-    sorted_cols = [
-        (data[order], None if valid is None else valid[order])
-        for data, valid in cat_cols
-    ]
-    diff = rows_differ(sorted_cols, cap)
-    live_sorted = live[order]
+    lanes = canonical_row_lanes(cat_cols, live)  # msb first
+    order, diff = sorted_runs(lanes, idx)
     n_live = nl + nr
+    live_sorted = idx < n_live
     ids_sorted = jnp.cumsum(diff.astype(jnp.int32)) - 1
     num_groups = jnp.where(
         n_live > 0, ids_sorted[jnp.maximum(n_live - 1, 0)] + 1, 0
     ).astype(jnp.int32)
     ids_sorted = jnp.where(live_sorted, ids_sorted, cap)
-    ids = jnp.zeros((cap,), jnp.int32).at[order].set(ids_sorted)
+    (ids,) = sentinel_compact(order, [ids_sorted])  # back to original order
     return ids[:cap_l], ids[cap_l:], num_groups

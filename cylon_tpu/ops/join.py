@@ -448,31 +448,23 @@ def spec_join(
     )
     total = count_from_probe(cnt, r_cnt, nl, nr, how)
     shadow = count_overflow_check(cnt, r_cnt)
-    # 64-bit payloads stay on the codec path (ops/gather lane codec): the
-    # TPU X64-rewrite pass has no audited lowering for 64-bit operands of a
-    # variadic sort, while the codec's hi/lo int32 lanes are proven
-    ride_sort = all(
-        np.dtype(d.dtype).itemsize <= 4 for d, _ in r_cols
-    )
-    if how in (INNER, LEFT) and ride_sort:
-        ops = [r_ids]
-        has_valid = []
-        for d, v in r_cols:
-            ops.append(d)
-            has_valid.append(v is not None)
-            if v is not None:
-                ops.append(v)
-        sorted_ops = jax.lax.sort(tuple(ops), num_keys=1, is_stable=True)
-        r_sorted = []
-        i = 1
-        for hv in has_valid:
-            d = sorted_ops[i]
-            i += 1
-            v = None
-            if hv:
-                v = sorted_ops[i]
-                i += 1
-            r_sorted.append((d, v))
+    if how in (INNER, LEFT):
+        # <=32-bit right columns ride the key sort as payload operands; any
+        # 64-bit columns are gathered by the carried order through the int32
+        # lane codec (ops/sort split/merge_ride_cols — the TPU X64 rewriter
+        # has no audited lowering for 64-bit variadic-sort operands)
+        from .gather import pack_gather
+        from .sort import merge_ride_cols, split_ride_cols
+
+        ride, payloads, heavy = split_ride_cols(r_cols)
+        iota = jnp.arange(cap_r, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(
+            tuple([r_ids] + payloads + [iota]), num_keys=1, is_stable=True
+        )
+        spays = list(sorted_ops[1:-1])
+        r_order = sorted_ops[-1]
+        heavy_sorted = pack_gather(heavy, r_order)[0] if heavy else []
+        r_sorted = merge_ride_cols(r_cols, ride, spays, heavy_sorted)
         out_cols, n_out = _emit_inner_left(
             lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r
         )

@@ -23,11 +23,10 @@ import jax.numpy as jnp
 
 from .sort import (
     KeyCol,
-    lanes_differ,
-    lexsort_with_payload,
-    orderable_key,
+    canonical_row_lanes,
     run_count_from,
     sentinel_compact,
+    sorted_runs,
 )
 
 
@@ -55,38 +54,10 @@ def compact_mask(mask: jax.Array, cap_out: int) -> Tuple[jax.Array, jax.Array]:
     return idx, total
 
 
-def _sort_lanes(cols: Sequence[KeyCol], live: jax.Array) -> List[jax.Array]:
-    """Canonical key lanes for one combined row ordering, most significant
-    first: [padding-last class, per column: (null lane, value lane)].
-
-    Value lanes are zeroed under null so that a run of nulls is ONE run
-    regardless of the masked payload (rows_differ semantics: null == null).
-    """
-    lanes: List[jax.Array] = [(~live).astype(jnp.uint8)]
-    for data, valid in cols:
-        vlane = orderable_key(data)
-        if valid is not None:
-            lanes.append((~valid).astype(jnp.uint8))
-            vlane = jnp.where(valid, vlane, jnp.zeros_like(vlane))
-        lanes.append(vlane)
-    return lanes
+_sort_lanes = canonical_row_lanes  # shared with factorize (ops/sort.py)
 
 
-def _sorted_runs(lanes: List[jax.Array], pay: jax.Array):
-    """Stable row ordering + run boundaries via chained 1-key sorts
-    (multi-key XLA sorts compile ~4x slower for equal warm time — see
-    ops.sort.lexsort_with_payload).
-
-    Returns (spay [cap] original indices in sorted order, new_run [cap]).
-    """
-    sorted_lanes, pays = lexsort_with_payload(list(reversed(lanes)), [pay])
-    sorted_lanes = list(reversed(sorted_lanes))  # back to msb-first
-    spay = pays[0]
-    diff = jnp.zeros(pay.shape, bool)
-    for lane in sorted_lanes:
-        prev = jnp.roll(lane, 1)
-        diff = diff | lanes_differ(lane, prev)
-    return spay, diff.at[0].set(True)
+_sorted_runs = sorted_runs  # one implementation, ops/sort.py
 
 
 def _emit_by_pay(

@@ -211,6 +211,102 @@ def run_count_from(new_run: jax.Array, flag: jax.Array) -> jax.Array:
     return jnp.flip(excl_r + f_r - start_r)
 
 
+def canonical_row_lanes(
+    cols: Sequence[KeyCol], live: jax.Array
+) -> list:
+    """Canonical key lanes for one combined row ordering, most significant
+    first: [padding-last class, per column: (null lane, value lane)].
+
+    Value lanes are zeroed under null so that a run of nulls is ONE run
+    regardless of the masked payload (rows_differ semantics: null == null).
+    Shared by the set algebra and factorize."""
+    lanes: list = [(~live).astype(jnp.uint8)]
+    for data, valid in cols:
+        vlane = orderable_key(data)
+        if valid is not None:
+            lanes.append((~valid).astype(jnp.uint8))
+            vlane = jnp.where(valid, vlane, jnp.zeros_like(vlane))
+        lanes.append(vlane)
+    return lanes
+
+
+def lane_runs_differ(sorted_lanes: Sequence[jax.Array]) -> jax.Array:
+    """Row-differs-from-predecessor over SORTED canonical lanes (row 0 True);
+    NaN == NaN on float (f64) lanes. The lane-space analog of
+    :func:`rows_differ` — equivalent because canonical lanes encode exactly
+    (value order, null flag) with nulls' value lanes zeroed."""
+    cap = sorted_lanes[0].shape[0]
+    diff = jnp.zeros((cap,), bool)
+    for lane in sorted_lanes:
+        prev = jnp.roll(lane, 1)
+        diff = diff | lanes_differ(lane, prev)
+    return diff.at[0].set(True)
+
+
+def sorted_runs(
+    lanes_msb_first: Sequence[jax.Array], pay: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Stable row ordering + run boundaries over canonical lanes.
+
+    Returns (spay [cap] original indices in sorted order, new_run [cap]).
+    The single implementation of the reversed-lanes chained sort +
+    run-detect idiom shared by factorize and the set algebra.
+    """
+    sorted_lanes, pays = lexsort_with_payload(
+        list(reversed(list(lanes_msb_first))), [pay]
+    )
+    return pays[0], lane_runs_differ(list(reversed(sorted_lanes)))
+
+
+def split_ride_cols(
+    cols: Sequence[KeyCol],
+) -> Tuple[list, list, list]:
+    """Partition columns for the payload-riding sort pattern.
+
+    <=32-bit columns (data + validity lanes) RIDE a variadic sort as payload
+    operands; 64-bit columns can't (the TPU X64 rewriter has no audited
+    lowering for 64-bit variadic-sort operands) and are gathered by the
+    order instead. Returns (ride mask, flattened payloads, heavy columns).
+    """
+    ride = [np.dtype(d.dtype).itemsize <= 4 for d, _ in cols]
+    payloads: list = []
+    for (d, v), r in zip(cols, ride):
+        if r:
+            payloads.append(d)
+            if v is not None:
+                payloads.append(v)
+    heavy = [c for c, r in zip(cols, ride) if not r]
+    return ride, payloads, heavy
+
+
+def merge_ride_cols(
+    cols: Sequence[KeyCol],
+    ride: Sequence[bool],
+    spays: Sequence[jax.Array],
+    heavy_sorted: Sequence[KeyCol],
+) -> list:
+    """Reassemble :func:`split_ride_cols` output after the sort: ridden
+    columns from the sorted payloads (walked in flattening order), heavy
+    columns from their gathered counterparts. Orders are permutations here,
+    so mask-free columns stay mask-free."""
+    out: list = []
+    pi = hi = 0
+    for (d, v), r in zip(cols, ride):
+        if r:
+            sd = spays[pi]
+            pi += 1
+            sv = None
+            if v is not None:
+                sv = spays[pi]
+                pi += 1
+            out.append((sd, sv))
+        else:
+            gd, gv = heavy_sorted[hi]
+            hi += 1
+            out.append((gd, None if v is None else gv))
+    return out
+
+
 def sentinel_compact(key: jax.Array, payloads: Sequence[jax.Array]) -> list:
     """Stable 1-key sort of ``payloads`` by ``key``: rows to keep carry an
     ordering key (e.g. their original index), dropped rows a BIG sentinel

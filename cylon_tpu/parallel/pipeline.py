@@ -99,18 +99,23 @@ def join_shard(
     [join_cap rows] = left cols ++ right cols, overflow count)."""
     lk = [left.cols[i] for i in l_key_idx]
     rk = [right.cols[i] for i in r_key_idx]
-    cap_l = lk[0][0].shape[0]
-    cap_r = rk[0][0].shape[0]
-    lo, cnt, r_order, r_cnt = _j.probe_arrays(
-        lk, rk, left.n, right.n, cap_l, cap_r, how
-    )
-    needed = _j.count_from_probe(cnt, r_cnt, left.n, right.n, how)
-    out, n_out = _j.emit_gather(
-        lo, cnt, r_order, r_cnt, left.cols, right.cols,
+    # spec_join fuses probe + count + emit with the minimal pass count (the
+    # right payload rides the key sort on INNER/LEFT); its exact total both
+    # sizes the overflow lane and equals the emitted row count
+    out, needed, shadow = _j.spec_join(
+        lk, rk, list(left.cols), list(right.cols),
         left.n, right.n, how, join_cap,
     )
-    overflow = jnp.maximum(needed - join_cap, 0)
-    return ShardTable(tuple(out), jnp.minimum(n_out, join_cap)), overflow
+    # int32-wrap guard (the shadow is a float32 mirror of the inner count):
+    # a shard with > 2^31 matches wraps `needed` — report saturated overflow
+    # and an empty shard instead of silently bogus counts (the eager path
+    # raises via _check_join_count; here the flag is the only channel)
+    wrapped = (needed < 0) | (shadow > jnp.float32(2**31))
+    overflow = jnp.where(
+        wrapped, jnp.int32(2**31 - 1), jnp.maximum(needed - join_cap, 0)
+    )
+    n_out = jnp.where(wrapped, 0, jnp.minimum(needed, join_cap))
+    return ShardTable(tuple(out), n_out), overflow
 
 
 def make_distributed_join_step(

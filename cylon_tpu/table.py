@@ -746,43 +746,17 @@ class Table:
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
                 # <=32-bit columns RIDE the sort as payload operands (a lane
-                # per pass instead of a random row gather — ops/sort
-                # lexsort_rows_payload); 64-bit columns fall back to one
-                # packed gather by the order (the int32 lane codec path)
-                ride = [
-                    np.dtype(d.dtype).itemsize <= 4 for d, _ in cols
-                ]
-                payloads = []
-                for (d, v), r in zip(cols, ride):
-                    if r:
-                        payloads.append(d)
-                        if v is not None:
-                            payloads.append(v)
+                # per pass instead of a random row gather); 64-bit columns
+                # fall back to one packed gather by the order (the int32
+                # lane codec path) — ops/sort split/merge_ride_cols
+                ride, payloads, heavy = _sort_mod.split_ride_cols(cols)
                 order, spays = _sort_mod.lexsort_rows_payload(
                     keys, n, cap, payloads, ascending=list(asc)
                 )
-                heavy = [cols[i] for i, r in enumerate(ride) if not r]
                 heavy_out = (
                     _g_pack.pack_gather(heavy, order)[0] if heavy else []
                 )
-                out = []
-                pi = hi = 0
-                for (d, v), r in zip(cols, ride):
-                    if r:
-                        sd = spays[pi]
-                        pi += 1
-                        sv = None
-                        if v is not None:
-                            sv = spays[pi]
-                            pi += 1
-                        out.append((sd, sv))
-                    else:
-                        gd, gv = heavy_out[hi]
-                        hi += 1
-                        # order is a permutation (no -1): keep mask-free
-                        # columns mask-free
-                        out.append((gd, None if v is None else gv))
-                return out
+                return _sort_mod.merge_ride_cols(cols, ride, spays, heavy_out)
 
             return kern
 
