@@ -457,13 +457,20 @@ def spec_join(
         from .sort import merge_ride_cols, split_ride_cols
 
         ride, payloads, heavy = split_ride_cols(r_cols)
-        iota = jnp.arange(cap_r, dtype=jnp.int32)
-        sorted_ops = jax.lax.sort(
-            tuple([r_ids] + payloads + [iota]), num_keys=1, is_stable=True
-        )
-        spays = list(sorted_ops[1:-1])
-        r_order = sorted_ops[-1]
-        heavy_sorted = pack_gather(heavy, r_order)[0] if heavy else []
+        if heavy:
+            # carry the order only when something needs gathering by it
+            iota = jnp.arange(cap_r, dtype=jnp.int32)
+            sorted_ops = jax.lax.sort(
+                tuple([r_ids] + payloads + [iota]), num_keys=1, is_stable=True
+            )
+            spays = list(sorted_ops[1:-1])
+            heavy_sorted = pack_gather(heavy, sorted_ops[-1])[0]
+        else:
+            sorted_ops = jax.lax.sort(
+                tuple([r_ids] + payloads), num_keys=1, is_stable=True
+            )
+            spays = list(sorted_ops[1:])
+            heavy_sorted = []
         r_sorted = merge_ride_cols(r_cols, ride, spays, heavy_sorted)
         out_cols, n_out = _emit_inner_left(
             lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r

@@ -54,12 +54,6 @@ def compact_mask(mask: jax.Array, cap_out: int) -> Tuple[jax.Array, jax.Array]:
     return idx, total
 
 
-_sort_lanes = canonical_row_lanes  # shared with factorize (ops/sort.py)
-
-
-_sorted_runs = sorted_runs  # one implementation, ops/sort.py
-
-
 def _emit_by_pay(
     keep: jax.Array, spay: jax.Array, cap_out: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -83,7 +77,7 @@ def _unique_keep(
     """(keep mask in sorted space, spay) for single-table dedup."""
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = idx < n
-    spay, new_run = _sorted_runs(_sort_lanes(key_cols, live), idx)
+    spay, new_run = sorted_runs(canonical_row_lanes(key_cols, live), idx)
     live_sorted = spay < n
     if keep == "last":
         # stable sort => run's last live element has the max original index
@@ -139,7 +133,7 @@ def _two_table_keep(
             rvm = jnp.ones((cap_r,), bool) if rv is None else rv
             valid = jnp.concatenate([lvm, rvm])
         cat_cols.append((data, valid))
-    spay, new_run = _sorted_runs(_sort_lanes(cat_cols, live), idx)
+    spay, new_run = sorted_runs(canonical_row_lanes(cat_cols, live), idx)
     is_l_live = spay < nl
     is_r_live = (spay >= cap_l) & (spay < cap_l + nr)
     # keep is evaluated at run STARTS only, where count-from == run total

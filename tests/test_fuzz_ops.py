@@ -103,6 +103,36 @@ def test_setops_vs_pandas(ctx8, seed, n, keyspace, dtype, null_p):
     )
 
 
+@pytest.mark.parametrize("seed,n,keyspace", [(0, 120, 6), (1, 73, 3)])
+def test_groupby_full_agg_matrix_vs_pandas(ctx8, seed, n, keyspace):
+    """min/max/var/std/nunique/median across the mesh vs pandas."""
+    rng = np.random.default_rng(seed + 300)
+    a = pd.DataFrame(
+        {
+            "k": rng.integers(0, keyspace, n).astype(np.int32),
+            "v": (rng.normal(size=n) * 4).round(1).astype(np.float32),
+        }
+    )
+    ta = ct.Table.from_pandas(ctx8, a)
+    got = ta.distributed_groupby(
+        "k", {"v": ["min", "max", "var", "std", "nunique", "median"]}
+    ).to_pandas()
+    got = got.set_index(got["k"].astype(np.int64)).sort_index()
+    want = a.groupby("k")["v"].agg(
+        ["min", "max", "var", "std", "nunique", "median"]
+    ).sort_index()
+    assert len(got) == len(want)
+    for ours, theirs in (
+        ("v_min", "min"), ("v_max", "max"), ("v_var", "var"),
+        ("v_std", "std"), ("v_nunique", "nunique"), ("v_median", "median"),
+    ):
+        np.testing.assert_allclose(
+            got[ours].to_numpy(np.float64),
+            want[theirs].to_numpy(np.float64),
+            rtol=1e-3, atol=1e-3, err_msg=ours, equal_nan=True,
+        )
+
+
 @pytest.mark.parametrize("seed,n,keyspace,dtype,null_p", CASES[:6])
 def test_groupby_sum_mean_vs_pandas(ctx8, seed, n, keyspace, dtype, null_p):
     rng = np.random.default_rng(seed + 200)
