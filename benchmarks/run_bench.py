@@ -269,8 +269,17 @@ def main():
     if args.out:
         hdr = (f"# BENCH — cylon_tpu op suite (platform={d0.platform}, "
                f"mesh={len(devices)}, rows={args.rows:,})")
+        # preserve any hand-written trailing "Notes:" narrative across
+        # regeneration (the table is generated; the notes are not)
+        notes = ""
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                prev = f.read()
+            i = prev.find("\nNotes")
+            if i >= 0:
+                notes = prev[i:]
         with open(args.out, "w") as f:
-            f.write(to_markdown(results, hdr))
+            f.write(to_markdown(results, hdr) + notes)
 
 
 if __name__ == "__main__":

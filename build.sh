@@ -48,5 +48,11 @@ if [ "$TEST" = 1 ]; then
 fi
 
 if [ "$WHEEL" = 1 ]; then
-  python -m pip wheel --no-deps -w dist .
+  # --no-build-isolation: zero-egress images cannot fetch build deps; the
+  # ambient env must provide them (checked here with a clear error)
+  python -c "import setuptools, wheel" 2>/dev/null || {
+    echo "wheel build needs setuptools>=64 and wheel in the active env" >&2
+    exit 1
+  }
+  python -m pip wheel --no-deps --no-build-isolation -w dist .
 fi
