@@ -17,6 +17,11 @@ Run on a virtual CPU mesh:
 
 On a TPU host just run it plain.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 import numpy as np

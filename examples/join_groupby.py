@@ -8,6 +8,11 @@ Run locally on a virtual CPU mesh:
 
 On a TPU host just run it plain — the mesh is whatever jax.devices() gives.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import pandas as pd
 

@@ -6,6 +6,11 @@ Reference analog: the experimental ArrowTaskAllToAll / LogicalTaskPlan
     XLA_FLAGS=--xla_force_host_platform_device_count=4 \
     CYLON_TPU_PLATFORM=cpu python examples/task_parallel.py
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 import pandas as pd
 
