@@ -88,11 +88,6 @@ def _unique_keep(
     return keepm, spay
 
 
-def unique_count(key_cols: Sequence[KeyCol], n: jax.Array, cap: int) -> jax.Array:
-    keepm, _ = _unique_keep(key_cols, n, cap, "first")
-    return jnp.sum(keepm).astype(jnp.int32)
-
-
 def unique_emit(
     key_cols: Sequence[KeyCol], n: jax.Array, cap: int, cap_out: int, keep: str = "first"
 ) -> Tuple[jax.Array, jax.Array]:
@@ -143,19 +138,9 @@ def _two_table_keep(
     return keepm, spay
 
 
-def subtract_count(l_cols, r_cols, nl, nr, cap_l, cap_r) -> jax.Array:
-    keepm, _ = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, False)
-    return jnp.sum(keepm).astype(jnp.int32)
-
-
 def subtract_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
     keepm, spay = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, False)
     return _emit_by_pay(keepm, spay, cap_out)
-
-
-def intersect_count(l_cols, r_cols, nl, nr, cap_l, cap_r) -> jax.Array:
-    keepm, _ = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, True)
-    return jnp.sum(keepm).astype(jnp.int32)
 
 
 def intersect_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):

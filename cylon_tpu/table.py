@@ -1290,45 +1290,39 @@ class Table:
         lflat = a._flat_cols()
         rflat = b._flat_cols()
         nc = len(lflat)
-        key = ("setop", op, nc)
-        cnt_fn = _s.subtract_count if op == "subtract" else _s.intersect_count
         emit_fn = _s.subtract_emit if op == "subtract" else _s.intersect_emit
 
-        def build_count():
-            def kern(dp, rep):
-                (lk, rk, nl, nr) = dp
-                cap_l = lk[0][0].shape[0]
-                cap_r = rk[0][0].shape[0]
-                return _scalar(cnt_fn(lk, rk, nl[0], nr[0], cap_l, cap_r))
-
-            return kern
-
-        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
-            (lflat, rflat, a.counts_dev, b.counts_dev), ()
-        )
-        cnts = self._out_counts(cnts)
-        cap_out = round_cap(int(cnts.max()))
+        # Single-dispatch: the output is a subset of the LEFT rows, so
+        # cap_out = a.shard_cap is a static exact upper bound — no count
+        # phase, no overflow possible, ONE host sync (the join speculative
+        # design, but with speculation that can never miss). A selective
+        # result is compacted after the fact like the join's.
+        cap_out = a.shard_cap
+        key = ("setop", op, nc, cap_out)  # cap_out is a closure constant
 
         def build_emit():
             def kern(dp, rep):
                 (lk, rk, nl, nr) = dp
-                (dummy,) = rep
-                co = dummy.shape[0]
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
-                idx, total = emit_fn(lk, rk, nl[0], nr[0], cap_l, cap_r, co)
+                idx, total = emit_fn(lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out)
                 out, _ = _g_pack.pack_gather(list(lk), idx)
                 return out, _scalar(total)
 
             return kern
 
-        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-            (lflat, rflat, a.counts_dev, b.counts_dev),
-            (jnp.zeros((cap_out,), jnp.int8),),
+        with span(f"setop.{op}", rows=int(self.row_count)):
+            out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+                (lflat, rflat, a.counts_dev, b.counts_dev), ()
+            )
+            counts = self._out_counts(nout)  # the ONE host sync
+        res = a._rebuild_cols(
+            list(zip(a.column_names, a._columns.values())), out, counts, cap_out
         )
-        return a._rebuild_cols(
-            list(zip(a.column_names, a._columns.values())), out, self._out_counts(nout), cap_out
-        )
+        tight = round_cap(int(counts.max()))
+        if tight * 4 <= cap_out:
+            res = res._compact(tight)
+        return res
 
     def distributed_union(self, other: "Table") -> "Table":
         return self._dist_setop(other, "union")
@@ -1362,44 +1356,36 @@ class Table:
         all_names = self.column_names
         key_idx = tuple(all_names.index(n) for n in names)
         flat = self._flat_cols()
-        key = ("unique", key_idx, keep, len(flat))
-
-        def build_count():
-            def kern(dp, rep):
-                (cols, counts) = dp
-                n = counts[0]
-                cap = cols[0][0].shape[0]
-                keys = [cols[i] for i in key_idx]
-                return _scalar(_s.unique_count(keys, n, cap))
-
-            return kern
-
-        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
-            (flat, self.counts_dev), ()
-        )
-        cnts = self._out_counts(cnts)
-        cap_out = round_cap(int(cnts.max()))
+        # Single-dispatch: dedup output is a subset of the input rows, so
+        # cap_out = shard_cap is a static exact upper bound — no count phase,
+        # ONE host sync; selective results are compacted afterwards.
+        cap_out = self.shard_cap
+        key = ("unique", key_idx, keep, len(flat), cap_out)
 
         def build_emit():
             def kern(dp, rep):
                 (cols, counts) = dp
-                (dummy,) = rep
-                co = dummy.shape[0]
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                idx, total = _s.unique_emit(keys, n, cap, co, keep)
+                idx, total = _s.unique_emit(keys, n, cap, cap_out, keep)
                 out, _ = _g_pack.pack_gather(list(cols), idx)
                 return out, _scalar(total)
 
             return kern
 
-        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-            (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+        with span("unique", rows=int(self.row_count)):
+            out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+                (flat, self.counts_dev), ()
+            )
+            counts = self._out_counts(nout)  # the ONE host sync
+        res = self._rebuild_cols(
+            list(zip(all_names, self._columns.values())), out, counts, cap_out
         )
-        return self._rebuild_cols(
-            list(zip(all_names, self._columns.values())), out, self._out_counts(nout), cap_out
-        )
+        tight = round_cap(int(counts.max()))
+        if tight * 4 <= cap_out:
+            res = res._compact(tight)
+        return res
 
     def distributed_unique(
         self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
