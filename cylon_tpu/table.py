@@ -520,6 +520,15 @@ class Table:
         bump("host_sync")
         return _fetch(per_shard).astype(np.int64)
 
+    def _maybe_compact(self, counts: np.ndarray, factor: int = 4) -> "Table":
+        """Single-sourced overshoot policy: slice the physical capacity down
+        when the speculative/static cap exceeded the realized max shard count
+        by >= ``factor`` (one cheap jitted slice, no host sync)."""
+        tight = round_cap(int(counts.max()))
+        if tight * factor <= self._shard_cap:
+            return self._compact(tight)
+        return self
+
     def _compact(self, new_cap: int) -> "Table":
         """Slice every column's physical buffer down to ``new_cap`` rows per
         shard (all live rows must fit). One cheap jitted slice, no host sync."""
@@ -940,10 +949,7 @@ class Table:
                 )
         res = rounds[0] if n_rounds == 1 else _concat_tables(rounds)
         # compact single-round output when the uniform bucket sizing overshot
-        tight = round_cap(int(new_counts.max()))
-        if tight * 2 <= res._shard_cap:
-            res = res._compact(tight)
-        return res
+        return res._maybe_compact(new_counts, factor=2)
 
     def task_partition(
         self, hash_columns: Sequence[Union[str, int]], plan
@@ -1077,12 +1083,9 @@ class Table:
                 res = self._rebuild_cols(
                     list(zip(out_names, src_cols)), out, totals, spec_cap
                 )
-                # compact when the speculative cap overshot by >=2 buckets so
-                # downstream ops don't pay for dead padding
-                tight = round_cap(int(totals.max()))
-                if tight * 4 <= spec_cap:
-                    res = res._compact(tight)
-                return res
+                # compact when the speculative cap overshot so downstream
+                # ops don't pay for dead padding
+                return res._maybe_compact(totals)
             # speculation overflowed: remember the observed size so the next
             # join with this signature speculates wide enough immediately
             hints[key] = round_cap(int(totals.max()))
@@ -1319,10 +1322,7 @@ class Table:
         res = a._rebuild_cols(
             list(zip(a.column_names, a._columns.values())), out, counts, cap_out
         )
-        tight = round_cap(int(counts.max()))
-        if tight * 4 <= cap_out:
-            res = res._compact(tight)
-        return res
+        return res._maybe_compact(counts)
 
     def distributed_union(self, other: "Table") -> "Table":
         return self._dist_setop(other, "union")
@@ -1382,10 +1382,7 @@ class Table:
         res = self._rebuild_cols(
             list(zip(all_names, self._columns.values())), out, counts, cap_out
         )
-        tight = round_cap(int(counts.max()))
-        if tight * 4 <= cap_out:
-            res = res._compact(tight)
-        return res
+        return res._maybe_compact(counts)
 
     def distributed_unique(
         self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
@@ -1428,30 +1425,19 @@ class Table:
         val_idx = tuple(all_names.index(c) for c, _, _ in specs)
         ops_t = tuple(oid for _, oid, _ in specs)
         flat = self._flat_cols()
-        key = ("groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat), _sorted)
-
-        def build_count():
-            def kern(dp, rep):
-                (cols, counts) = dp
-                n = counts[0]
-                cap = cols[0][0].shape[0]
-                keys = [cols[i] for i in key_idx]
-                _, ng = ids_fn(keys, n, cap)
-                return _scalar(ng)
-
-            return kern
-
-        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
-            (flat, self.counts_dev), ()
+        # Single-dispatch: num_groups <= live rows, so cap_out = shard_cap is
+        # a static exact upper bound — no count phase, ONE host sync (same
+        # design as the set-ops); selective results compact afterwards.
+        cap_out = self.shard_cap
+        key = (
+            "groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat),
+            _sorted, cap_out,
         )
-        cnts = self._out_counts(cnts)
-        cap_out = round_cap(int(cnts.max()))
 
         def build_emit():
             def kern(dp, rep):
                 (cols, counts) = dp
-                (dummy,) = rep
-                co = dummy.shape[0]
+                co = cap_out
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
@@ -1472,8 +1458,9 @@ class Table:
 
         with span("groupby.emit", rows=int(self.row_count)):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-                (flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+                (flat, self.counts_dev), ()
             )
+            counts_np = self._out_counts(nout)  # the ONE host sync
         # build output schema
         names_src: List[Tuple[str, Column]] = [
             (n, self._columns[n]) for n in key_names
@@ -1481,13 +1468,13 @@ class Table:
         agg_cols = []
         for (coln, oid, oname), (a, av) in zip(specs, out[len(key_names):]):
             agg_cols.append((f"{coln}_{oname}", a, av))
-        counts_np = self._out_counts(nout)
         cols_od: "OrderedDict[str, Column]" = OrderedDict()
         for (n, src), (d, v) in zip(names_src, out[: len(key_names)]):
             cols_od[n] = Column(d, src.dtype, v, src.dictionary)
         for cname, d, v in agg_cols:
             cols_od[cname] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
-        return Table(self.ctx, cols_od, counts_np, cap_out)
+        res = Table(self.ctx, cols_od, counts_np, cap_out)
+        return res._maybe_compact(counts_np)
 
     def distributed_groupby(
         self,
