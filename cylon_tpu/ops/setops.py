@@ -72,15 +72,40 @@ def _emit_by_pay(
 
 
 def _unique_keep(
-    key_cols: Sequence[KeyCol], n: jax.Array, cap: int, keep: str
+    key_cols: Sequence[KeyCol],
+    n: jax.Array,
+    cap: int,
+    keep: str,
+    order_lane: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """(keep mask in sorted space, spay) for single-table dedup."""
+    """(keep mask in sorted space, spay) for single-table dedup.
+
+    ``order_lane``: optional least-significant ORDERING lane (e.g. a global
+    row id carried through a shuffle) deciding which duplicate is "first"/
+    "last" instead of the local row position — runs are still detected from
+    the key lanes only. Needed because a multi-round (respill) shuffle does
+    not preserve within-key arrival order across shards.
+    """
+    from .sort import lane_runs_differ, lexsort_with_payload
+
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = idx < n
-    spay, new_run = sorted_runs(canonical_row_lanes(key_cols, live), idx)
+    lanes = canonical_row_lanes(key_cols, live)  # msb first
+    if order_lane is None:
+        spay, new_run = sorted_runs(lanes, idx)
+    else:
+        all_lanes = lanes + [order_lane]  # order = least significant key
+        sorted_lanes, pays = lexsort_with_payload(
+            list(reversed(all_lanes)), [idx]
+        )
+        spay = pays[0]
+        # run boundaries from the KEY lanes only (drop the order lane, which
+        # is the FIRST entry of the reversed/lsb-first sorted list)
+        new_run = lane_runs_differ(list(reversed(sorted_lanes[1:])))
     live_sorted = spay < n
     if keep == "last":
-        # stable sort => run's last live element has the max original index
+        # within a run rows are ordered by (order_lane, original index):
+        # the run's last live element is the keeper
         run_end = jnp.concatenate([new_run[1:], jnp.ones((1,), bool)])
         keepm = run_end & live_sorted
     else:
@@ -89,10 +114,15 @@ def _unique_keep(
 
 
 def unique_emit(
-    key_cols: Sequence[KeyCol], n: jax.Array, cap: int, cap_out: int, keep: str = "first"
+    key_cols: Sequence[KeyCol],
+    n: jax.Array,
+    cap: int,
+    cap_out: int,
+    keep: str = "first",
+    order_lane: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Row indices of the deduplicated table (first-occurrence order)."""
-    keepm, spay = _unique_keep(key_cols, n, cap, keep)
+    keepm, spay = _unique_keep(key_cols, n, cap, keep, order_lane)
     return _emit_by_pay(keepm, spay, cap_out)
 
 

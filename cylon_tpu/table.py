@@ -587,6 +587,25 @@ class Table:
         cols[name] = col
         return self._replace(columns=cols)
 
+    def _global_rowid_column(self) -> Column:
+        """int32 column: each live row's GLOBAL index in table order (shard
+        offsets + local position; padding values are don't-care). Carried
+        through a shuffle it lets order-sensitive ops (unique keep=first/
+        last) recover original order, which multi-round exchanges do not
+        preserve."""
+        cap = self._shard_cap
+        counts = self.counts_dev  # [P] sharded
+
+        def f(counts):
+            offs = jnp.cumsum(counts) - counts
+            return (
+                offs[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+            ).reshape(-1).astype(jnp.int32)
+
+        return Column(
+            jax.jit(f)(counts), DataType.from_numpy_dtype(np.dtype(np.int32))
+        )
+
     def live_mask(self) -> jax.Array:
         """Public [P*cap] bool device mask of live rows (False = padding).
 
@@ -1350,17 +1369,31 @@ class Table:
         self,
         columns: Optional[Sequence[Union[str, int]]] = None,
         keep: str = "first",
+        _order_col: Optional[str] = None,
     ) -> "Table":
-        """Per-shard dedup (reference Unique, table.cpp:923-982)."""
+        """Per-shard dedup (reference Unique, table.cpp:923-982).
+
+        ``_order_col``: internal — name of a column whose VALUES define the
+        first/last ordering among duplicates (instead of row position); the
+        column is consumed (absent from the output). Used by
+        :meth:`distributed_unique` to carry global row order across the
+        shuffle."""
         names = self.column_names if columns is None else self._resolve_cols(columns)
         all_names = self.column_names
+        if _order_col is not None:
+            names = [n for n in names if n != _order_col]
         key_idx = tuple(all_names.index(n) for n in names)
+        order_idx = all_names.index(_order_col) if _order_col is not None else -1
+        out_pairs = [
+            (n, c) for n, c in self._columns.items() if n != _order_col
+        ]
+        out_idx = tuple(all_names.index(n) for n, _ in out_pairs)
         flat = self._flat_cols()
         # Single-dispatch: dedup output is a subset of the input rows, so
         # cap_out = shard_cap is a static exact upper bound — no count phase,
         # ONE host sync; selective results are compacted afterwards.
         cap_out = self.shard_cap
-        key = ("unique", key_idx, keep, len(flat), cap_out)
+        key = ("unique", key_idx, keep, len(flat), cap_out, order_idx)
 
         def build_emit():
             def kern(dp, rep):
@@ -1368,8 +1401,15 @@ class Table:
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                idx, total = _s.unique_emit(keys, n, cap, cap_out, keep)
-                out, _ = _g_pack.pack_gather(list(cols), idx)
+                order_lane = None
+                if order_idx >= 0:
+                    from .ops.sort import orderable_key
+
+                    order_lane = orderable_key(cols[order_idx][0])
+                idx, total = _s.unique_emit(
+                    keys, n, cap, cap_out, keep, order_lane=order_lane
+                )
+                out, _ = _g_pack.pack_gather([cols[i] for i in out_idx], idx)
                 return out, _scalar(total)
 
             return kern
@@ -1379,20 +1419,27 @@ class Table:
                 (flat, self.counts_dev), ()
             )
             counts = self._out_counts(nout)  # the ONE host sync
-        res = self._rebuild_cols(
-            list(zip(all_names, self._columns.values())), out, counts, cap_out
-        )
+        res = self._rebuild_cols(out_pairs, out, counts, cap_out)
         return res._maybe_compact(counts)
 
     def distributed_unique(
         self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
     ) -> "Table":
         """Reference DistributedUnique (table.cpp:984-999): shuffle on the
-        key columns then local unique."""
+        key columns then local unique. A global row-id column rides the
+        shuffle so keep='first'/'last' selects by ORIGINAL table order —
+        multi-round exchanges do not preserve within-key arrival order (the
+        reference's MPI arrival order is likewise nondeterministic; pandas
+        order semantics are kept here)."""
         if self.world_size == 1:
             return self.unique(columns, keep)
         names = self.column_names if columns is None else self._resolve_cols(columns)
-        return self._shuffle_impl(kind="hash", key_names=names).unique(columns, keep)
+        rid = "__rowid__"
+        while rid in self.column_names:  # never collide with user columns
+            rid += "_"
+        t = self.add_column(rid, self._global_rowid_column())
+        shuffled = t._shuffle_impl(kind="hash", key_names=names)
+        return shuffled.unique(names, keep, _order_col=rid)
 
     # ------------------------------------------------------------------
     # groupby

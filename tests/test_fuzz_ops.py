@@ -103,6 +103,29 @@ def test_setops_vs_pandas(ctx8, seed, n, keyspace, dtype, null_p):
     )
 
 
+@pytest.mark.parametrize("keep", ["first", "last"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_unique_keep_first_last_vs_pandas(ctx8, keep, seed):
+    """keep-first/last pick the right representative ROW (not just count):
+    the v payload disambiguates which duplicate survived."""
+    rng = np.random.default_rng(seed + 400)
+    n = 90
+    a = pd.DataFrame(
+        {
+            "k": rng.integers(0, 7, n).astype(np.int32),
+            "v": np.arange(n, dtype=np.float32),  # unique -> identifies rows
+        }
+    )
+    ta = ct.Table.from_pandas(ctx8, a)
+    got = ta.distributed_unique(columns=["k"], keep=keep).to_pandas()
+    want = a.drop_duplicates(subset=["k"], keep=keep)
+    assert len(got) == len(want)
+    g = got.sort_values("k").reset_index(drop=True)
+    w = want.sort_values("k").reset_index(drop=True)
+    np.testing.assert_array_equal(g["k"].to_numpy(), w["k"].to_numpy())
+    np.testing.assert_array_equal(g["v"].to_numpy(), w["v"].to_numpy())
+
+
 @pytest.mark.parametrize("seed,n,keyspace", [(0, 120, 6), (1, 73, 3)])
 def test_groupby_full_agg_matrix_vs_pandas(ctx8, seed, n, keyspace):
     """min/max/var/std/nunique/median across the mesh vs pandas."""
