@@ -10,7 +10,8 @@ TPU-native design: group ids come from :func:`factorize` (lexsort +
 run-detect — ids are dense AND in sorted key order, so the output doubles as
 the sorted-key pipeline groupby, groupby/pipeline_groupby.cpp); aggregates are
 XLA ``segment_sum/min/max`` ops, which lower to efficient sorted-segment
-reductions. Count/emit split: ``num_groups`` is the only host sync.
+reductions. Single dispatch: num_groups <= live rows bounds the output
+statically, so one kernel + one host sync covers count AND emit.
 """
 from __future__ import annotations
 

@@ -11,7 +11,9 @@ jax Arrays, row-sharded over the context mesh (PartitionSpec('dp')). Each of
 the P shards owns ``shard_cap`` physical rows of every column, of which the
 first ``row_counts[i]`` are live (front-packed); the rest are padding. All
 relational kernels are static-shaped jit programs under shard_map; data-
-dependent output sizes use a count->emit two-phase with exactly one host sync.
+dependent output sizes use a single dispatch with a static bound where one
+exists (set ops/unique/groupby; joins speculate, falling back to the exact
+count->emit two-phase on overflow) — one host sync per op either way.
 
 "Local" ops act independently per shard (== per MPI rank in the reference);
 "distributed_*" ops are collective over the mesh.
