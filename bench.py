@@ -16,6 +16,18 @@ on failure the benchmark falls back to the host CPU backend so a valid JSON
 line exists either way, with "platform"/"device" fields recording what
 actually ran. Any late error still emits JSON with an "error" field.
 
+Round-3 hardening (VERDICT.md item 1):
+- probe attempts are spread across time (default 5 tries x 120 s with growing
+  sleeps) because the tunnel flakes in multi-minute windows;
+- a persistent XLA compilation cache (.jax_cache/) is shared by every process
+  so the measured child starts warm and fits its watchdog budget;
+- completion is fenced by fetching a scalar checksum of every output column —
+  jax.block_until_ready returns WITHOUT waiting through the remote tunnel, so
+  naive device-side timings are fantasy;
+- every successful TPU measurement also writes a timestamped
+  BENCH_TPU_attempt.json next to this file, so a mid-round TPU number
+  survives even if the end-of-round capture flakes.
+
 Env knobs: BENCH_ROWS, BENCH_REPS, BENCH_INIT_TIMEOUT (s), BENCH_INIT_TRIES,
 BENCH_FORCE_CPU=1, BENCH_CHILD_TIMEOUT (s — watchdog on the measured TPU run,
 which executes in a killable subprocess; BENCH_CHILD is internal).
@@ -33,10 +45,48 @@ import numpy as np
 os.environ.setdefault("CYLON_TPU_NO_X64", "1")
 
 BASELINE_ROWS_PER_SEC = 400e6 / 141.5  # cylon 1-worker input rows/sec
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# persistent compile cache shared by every process touching the repo (the
+# watchdog's in-round runs pre-populate it, so the measured child starts
+# warm and fits its watchdog budget). Routed through the framework's own
+# env knob so CylonContext init applies the SAME directory instead of
+# re-pointing the cache at its default location.
+os.environ.setdefault(
+    "CYLON_TPU_COMPILE_CACHE", os.path.join(REPO_DIR, ".jax_cache")
+)
+
+
+def fence(tbl) -> float:
+    """Completion fence: fetch a scalar that depends on every output column.
+    jax.block_until_ready returns WITHOUT waiting through the remote TPU
+    tunnel (measured in round 2), so a host fetch of a dependent scalar is
+    the only trustworthy end-of-work marker."""
+    import jax.numpy as jnp
+
+    s = jnp.float32(0)
+    for c in tbl._columns.values():
+        s = s + jnp.sum(c.data.astype(jnp.float32))
+    return float(s)
 
 
 def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+
+
+def record_tpu_attempt(payload: dict) -> None:
+    """Persist a timestamped copy of any successful TPU measurement so a
+    mid-round number survives an end-of-round tunnel flake."""
+    if payload.get("platform") == "cpu" or "error" in payload:
+        return
+    try:
+        path = os.path.join(REPO_DIR, "BENCH_TPU_attempt.json")
+        stamped = dict(payload, captured_unix=int(time.time()))
+        with open(path, "w") as f:
+            json.dump(stamped, f)
+            f.write("\n")
+    except OSError:
+        pass  # recording is best-effort; never break the bench line
 
 
 def probe_tpu(timeout_s: float, tries: int) -> bool:
@@ -70,7 +120,8 @@ def probe_tpu(timeout_s: float, tries: int) -> bool:
                 file=sys.stderr,
             )
         if attempt + 1 < tries:
-            time.sleep(min(10.0 * (attempt + 1), 30.0))
+            # the tunnel flakes in multi-minute windows: spread the attempts
+            time.sleep(min(20.0 * (attempt + 1), 90.0))
     return False
 
 
@@ -107,6 +158,7 @@ def run_child_tpu(timeout_s: float) -> bool:
     # the child's own fail-soft handler exits 0 with an "error" payload;
     # that must NOT count as a TPU measurement or the CPU fallback is lost
     if payload is not None and "error" not in payload and payload.get("value"):
+        # (the child already wrote BENCH_TPU_attempt.json itself)
         print(lines[-1], flush=True)
         return True
     print(f"bench: TPU child failed rc={r.returncode}", file=sys.stderr)
@@ -120,8 +172,8 @@ def main():
     # HBM with ~6x headroom (sort intermediates included).
     n = int(os.environ.get("BENCH_ROWS", 16_000_000))
     reps = int(os.environ.get("BENCH_REPS", 3))
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
-    init_tries = int(os.environ.get("BENCH_INIT_TRIES", 2))
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 120))
+    init_tries = int(os.environ.get("BENCH_INIT_TRIES", 5))
     child = os.environ.get("BENCH_CHILD", "0") == "1"
 
     force_cpu = os.environ.get("BENCH_FORCE_CPU", "0") == "1"
@@ -175,29 +227,29 @@ def main():
     # warmup (compile) — measured separately so the JSON records both
     t0 = time.perf_counter()
     out = left.distributed_join(right, on="k", how="inner")
-    _ = out.row_count
+    fence(out)
     compile_s = time.perf_counter() - t0
 
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         out = left.distributed_join(right, on="k", how="inner")
-        jax.block_until_ready([c.data for c in out._columns.values()])
+        fence(out)
         dt = time.perf_counter() - t0
         best = min(best, dt)
 
     rate = 2 * n / best / ctx.world_size  # per-chip
-    emit(
-        {
-            "metric": "dist_inner_join_input_rows_per_sec_per_chip",
-            "value": round(rate),
-            "unit": "rows/s",
-            "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
-            "warm_s": round(best, 4),
-            "compile_s": round(compile_s, 2),
-            **info,
-        }
-    )
+    payload = {
+        "metric": "dist_inner_join_input_rows_per_sec_per_chip",
+        "value": round(rate),
+        "unit": "rows/s",
+        "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
+        "warm_s": round(best, 4),
+        "compile_s": round(compile_s, 2),
+        **info,
+    }
+    record_tpu_attempt(payload)
+    emit(payload)
 
 
 if __name__ == "__main__":

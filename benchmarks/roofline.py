@@ -1,0 +1,158 @@
+"""Roofline accounting for the sort-based kernel designs (VERDICT round-2
+item 2): how close does each op run to the HBM-bandwidth bound implied by
+its algorithm?
+
+Model
+-----
+TPU XLA ``sort`` is a bitonic sorting network: ``P(n) = k*(k+1)/2`` passes
+for ``k = ceil(log2 n)``, each pass streaming every operand lane once.
+Gathers/scatters pay per element (measured round 2: ~25-36 ms for a 4M f32
+random gather ≈ 10x a sequential pass), modeled as ``GATHER_PASS_EQ``
+sequential-pass equivalents per lane. Everything elementwise fuses into
+one read + one write pass (XLA fusion).
+
+The op's **model time** is total modeled traffic / peak HBM bandwidth; the
+**%membw** column of BENCH_TPU.md is ``model_time / measured_time`` — the
+fraction of the algorithm's own bandwidth bound the implementation
+achieves. A low %membw means dispatch overhead or unfused overhead; a high
+%membw with a slow op means the *algorithm* is the cost (too many passes)
+— that is the signal a Pallas kernel with fewer passes can cash in.
+
+The traffic count is not hand-maintained: ``analyze(fn, *args)`` traces the
+jitted function and walks the ClosedJaxpr, summing operand bytes per sort
+(weighted by its pass count), per gather/scatter (weighted by
+GATHER_PASS_EQ), and one pass over everything else that touches data.
+
+Usage:
+    from benchmarks.roofline import analyze, model_seconds
+    rep = analyze(fn, *example_args)
+    t_model = model_seconds(rep, hbm_gbps=819)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+# v5e (tpu v5 litepod) peak HBM bandwidth, GB/s. Override per device.
+HBM_GBPS_DEFAULT = 819.0
+# measured (round 2, scan-slope method): random 4M-row gather ~25-36 ms vs
+# ~2.4 ms for a sequential pass of the same bytes -> ~10 pass-equivalents
+GATHER_PASS_EQ = 10.0
+
+_SORT_PRIMS = {"sort"}
+_GATHER_PRIMS = {"gather", "dynamic_slice", "take"}
+_SCATTER_PRIMS = {
+    "scatter", "scatter-add", "scatter_add", "scatter_max", "scatter_min",
+    "scatter_mul",
+}
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _bitonic_passes(n: int) -> float:
+    if n <= 1:
+        return 1.0
+    k = math.ceil(math.log2(n))
+    return k * (k + 1) / 2.0
+
+
+@dataclass
+class Report:
+    sort_bytes_per_pass: int = 0
+    sort_pass_bytes: float = 0.0  # sum over sorts: operand bytes * passes
+    sort_count: int = 0
+    gather_bytes: float = 0.0  # pass-equivalent weighted
+    scatter_bytes: float = 0.0
+    elementwise_bytes: float = 0.0
+    collective_bytes: int = 0
+    by_prim: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def total_model_bytes(self) -> float:
+        return (
+            self.sort_pass_bytes
+            + self.gather_bytes
+            + self.scatter_bytes
+            + self.elementwise_bytes
+        )
+
+
+def _walk(jaxpr, rep: Report) -> None:
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        # recurse into nested jaxprs (pjit/closed_call/scan/while/cond/
+        # shard_map). A param may hold a raw Jaxpr (has .eqns) or a
+        # ClosedJaxpr (has .jaxpr) — shard_map uses the former.
+        def _sub(v):
+            if hasattr(v, "eqns"):
+                return v
+            inner = getattr(v, "jaxpr", None)
+            return inner if inner is not None and hasattr(inner, "eqns") else None
+
+        for v in eqn.params.values():
+            sub = _sub(v)
+            if sub is not None:
+                _walk(sub, rep)
+            elif isinstance(v, (list, tuple)):
+                for vi in v:
+                    sub = _sub(vi)
+                    if sub is not None:
+                        _walk(sub, rep)
+        in_bytes = sum(_nbytes(x.aval) for x in eqn.invars if hasattr(x, "aval"))
+        out_bytes = sum(_nbytes(x.aval) for x in eqn.outvars if hasattr(x, "aval"))
+        if prim in _SORT_PRIMS:
+            n = 0
+            for x in eqn.invars:
+                if hasattr(x, "aval") and x.aval.shape:
+                    n = max(n, int(x.aval.shape[eqn.params.get("dimension", -1)]))
+            passes = _bitonic_passes(n)
+            rep.sort_count += 1
+            rep.sort_bytes_per_pass += in_bytes
+            rep.sort_pass_bytes += in_bytes * passes
+            rep.by_prim["sort"] = rep.by_prim.get("sort", 0.0) + in_bytes * passes
+        elif prim in _GATHER_PRIMS:
+            w = (in_bytes + out_bytes) * GATHER_PASS_EQ
+            rep.gather_bytes += w
+            rep.by_prim[prim] = rep.by_prim.get(prim, 0.0) + w
+        elif prim in _SCATTER_PRIMS:
+            w = (in_bytes + out_bytes) * GATHER_PASS_EQ
+            rep.scatter_bytes += w
+            rep.by_prim[prim] = rep.by_prim.get(prim, 0.0) + w
+        elif prim in ("all_to_all", "all_gather", "psum", "ppermute",
+                      "reduce_scatter"):
+            rep.collective_bytes += in_bytes
+            rep.by_prim[prim] = rep.by_prim.get(prim, 0.0) + in_bytes
+        else:
+            # elementwise/reduction: fused — count one read + one write
+            w = in_bytes + out_bytes
+            rep.elementwise_bytes += w
+
+
+def analyze(fn, *args, **kwargs) -> Report:
+    """Trace ``fn(*args)`` and return its modeled HBM traffic."""
+    closed = jax.make_jaxpr(fn, **kwargs)(*args)
+    rep = Report()
+    _walk(closed.jaxpr, rep)
+    return rep
+
+
+def model_seconds(rep: Report, hbm_gbps: float = HBM_GBPS_DEFAULT) -> float:
+    """Bandwidth-bound lower time for the modeled traffic."""
+    return rep.total_model_bytes / (hbm_gbps * 1e9)
+
+
+def pct_membw(rep: Report, measured_s: float,
+              hbm_gbps: float = HBM_GBPS_DEFAULT) -> float:
+    """Fraction (0-1) of the algorithm's bandwidth bound achieved."""
+    if measured_s <= 0:
+        return 0.0
+    return model_seconds(rep, hbm_gbps) / measured_s

@@ -54,6 +54,11 @@ def _bench(fn, reps: int):
     return best, compile_s
 
 
+# the ONE tunnel-safe completion fence (dependent-scalar fetch; see its
+# docstring for why block_until_ready cannot be trusted here)
+from bench import fence as _sync  # noqa: E402
+
+
 def make_tables(ct, ctx, n, keyspace, seed=0):
     rng = np.random.default_rng(seed)
     left = ct.Table.from_pydict(
@@ -96,7 +101,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
     def local_join():
         out = left.join(right, on="k", how="inner")
-        jax.block_until_ready([c.data for c in out._columns.values()])
+        _sync(out)
 
     s, c = _bench(local_join, reps)
     record("local_inner_join", s, c, 2 * n_rows, 1,
@@ -109,7 +114,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
     def dist_join():
         out = left.distributed_join(right, on="k", how="inner")
-        jax.block_until_ready([c.data for c in out._columns.values()])
+        _sync(out)
 
     s, c = _bench(dist_join, reps)
     record("dist_inner_join", s, c, 2 * n_rows, world,
@@ -123,7 +128,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
     def dist_join_fused():
         out = left.distributed_join(right, on="k", how="inner", mode="fused")
-        jax.block_until_ready([c.data for c in out._columns.values()])
+        _sync(out)
 
     s, c = _bench(dist_join_fused, reps)
     reset_trace()
@@ -140,7 +145,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     def q3():
         out = left.distributed_join(right, on="k", how="inner")
         g = out.distributed_groupby("k_x", {"v": "sum"})
-        jax.block_until_ready([col.data for col in g._columns.values()])
+        _sync(g)
 
     s, c = _bench(q3, reps)
     record("dist_join_groupby_q3", s, c, 2 * n_rows, world)
@@ -167,13 +172,34 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _ = np.asarray(out[3])  # the single fetch
 
     s, c = _bench(q3_fused, reps)
-    record("dist_join_groupby_q3_fused", s, c, 2 * n_rows, world,
-           {"host_syncs": 1})
+    q3f_extra = {"host_syncs": 1}
+    # roofline (VERDICT round-2 item 2): model the fused program's HBM
+    # traffic from its jaxpr and report achieved fraction of the bandwidth
+    # bound. Only meaningful on a real accelerator (BENCH_HBM_GBPS overrides).
+    hbm = float(os.environ.get(
+        "BENCH_HBM_GBPS",
+        0 if mesh_devices[0].platform == "cpu" else 819.0,
+    ))
+    if hbm > 0:
+        try:
+            from benchmarks.roofline import analyze, model_seconds, pct_membw
+
+            rep = analyze(
+                step, (lflat, left.counts_dev, rflat, right.counts_dev), ()
+            )
+            q3f_extra["model_s"] = round(model_seconds(rep, hbm), 4)
+            q3f_extra["pct_membw"] = round(100 * pct_membw(rep, s, hbm), 1)
+            q3f_extra["sort_passes_bytes_gb"] = round(
+                rep.sort_pass_bytes / 1e9, 2
+            )
+        except Exception as e:  # the model must never sink the bench
+            print(f"# roofline analyze failed: {e}", file=sys.stderr)
+    record("dist_join_groupby_q3_fused", s, c, 2 * n_rows, world, q3f_extra)
 
     # config 3: distributed sort (sample sort)
     def dsort():
         out = left.distributed_sort("k")
-        jax.block_until_ready([col.data for col in out._columns.values()])
+        _sync(out)
 
     s, c = _bench(dsort, reps)
     record("dist_sort", s, c, n_rows, world)
@@ -188,10 +214,39 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     ):
         def setop(f=f):
             out = f()
-            jax.block_until_ready([col.data for col in out._columns.values()])
+            _sync(out)
 
         s, c = _bench(setop, reps)
         record(name, s, c, 2 * n_rows, world)
+
+    # config 5: out-of-core join — both inputs stream through bounded device
+    # memory (Grace-style partitioned dag join, parallel/ooc.py; the analog
+    # of the reference's byte-chunked streaming shuffle + DisJoinOP)
+    from cylon_tpu.parallel.ooc import OutOfCoreJoin
+
+    rng5 = np.random.default_rng(2)
+    ooc_n = n_rows
+    lk = rng5.integers(0, ooc_n, ooc_n).astype(np.int32)
+    lv = rng5.normal(size=ooc_n).astype(np.float32)
+    rk = rng5.integers(0, ooc_n, ooc_n).astype(np.int32)
+    rv = rng5.normal(size=ooc_n).astype(np.float32)
+    chunk_rows = max(ooc_n // 16, 1)
+
+    def chunks(k, v, vname):
+        for i in range(0, ooc_n, chunk_rows):
+            yield {"k": k[i : i + chunk_rows], vname: v[i : i + chunk_rows]}
+
+    def ooc():
+        job = OutOfCoreJoin(ctx, on="k", how="inner", num_buckets=16)
+        sink = job.execute(chunks(lk, lv, "v"), chunks(rk, rv, "w"))
+        return sink.rows
+
+    s, c = _bench(ooc, max(1, reps - 1))
+    # gate_exempt: first-call time here is a full host-bound streaming run
+    # (16 spills + 16 joins), not XLA compile tax — the compile gate would
+    # misfire on runtime
+    record("ooc_join_16chunks", s, c, 2 * ooc_n, world,
+           {"chunk_rows": chunk_rows, "gate_exempt": True})
 
     # ---- scaling sweep: strong scaling of the distributed join -------------
     if scaling and world > 1:
@@ -241,6 +296,13 @@ def main():
     ap.add_argument("--mesh", type=int, default=8, help="max mesh size (CPU)")
     ap.add_argument("--scaling", action="store_true", help="mesh-size sweep")
     ap.add_argument("--out", type=str, default=None, help="write markdown table")
+    ap.add_argument(
+        "--compile-gate", type=float,
+        default=float(os.environ.get("BENCH_COMPILE_GATE", 30.0)),
+        help="fail (exit 1) if any benchmark's compile_s exceeds this many "
+             "seconds; <=0 disables. The TPU-tax regression gate "
+             "(VERDICT round 2: q3 fused was 165 s).",
+    )
     args = ap.parse_args()
 
     import __graft_entry__ as ge
@@ -280,6 +342,25 @@ def main():
                 notes = prev[i:]
         with open(args.out, "w") as f:
             f.write(to_markdown(results, hdr) + notes)
+
+    if args.compile_gate > 0:
+        slow = [
+            r for r in results
+            if r["compile_s"] > args.compile_gate and not r.get("gate_exempt")
+        ]
+        if slow:
+            for r in slow:
+                print(
+                    f"COMPILE GATE FAIL: {r['benchmark']} compiled in "
+                    f"{r['compile_s']}s (> {args.compile_gate}s)",
+                    file=sys.stderr,
+                )
+            sys.exit(1)
+        print(
+            f"# compile gate ok: all {len(results)} benchmarks compiled "
+            f"under {args.compile_gate}s",
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":

@@ -70,16 +70,23 @@ class Column:
                         # exact int64 with a validity mask — a float64 fall-
                         # back would corrupt keys above 2^53 (pyarrow infers
                         # int64 + validity bitmap here too)
-                        num = np.where(is_null, 0, vals).astype(np.int64)
-                        if not is_null.any():
-                            return Column.encode_host(num)
-                        return (
-                            num, ~is_null,
-                            DataType.from_numpy_dtype(np.dtype(np.int64)), None,
-                        )
-                    num = np.full(len(vals), np.nan, np.float64)
-                    num[~is_null] = [float(v) for v in live]
-                    return Column.encode_host(num)
+                        try:
+                            num = np.where(is_null, 0, vals).astype(np.int64)
+                        except OverflowError:
+                            # Python int outside int64 range: keep the column
+                            # exact via the dictionary/string encoding below
+                            num = None
+                        if num is not None:
+                            if not is_null.any():
+                                return Column.encode_host(num)
+                            return (
+                                num, ~is_null,
+                                DataType.from_numpy_dtype(np.dtype(np.int64)), None,
+                            )
+                    else:
+                        num = np.full(len(vals), np.nan, np.float64)
+                        num[~is_null] = [float(v) for v in live]
+                        return Column.encode_host(num)
             filler = ""
             # stray bools inside a string column stringify as 'true'/'false',
             # matching promote_encoded_shards' BOOL->STRING promotion so the

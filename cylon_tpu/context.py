@@ -18,6 +18,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .config import CommConfig, CommType, LocalConfig, TPUConfig
 
+_compile_cache_set = False
+
+
+def _enable_compile_cache(platform: str) -> None:
+    """Persistent XLA compilation cache, on by default on accelerators
+    (opt out with CYLON_TPU_COMPILE_CACHE=0; redirect with
+    CYLON_TPU_COMPILE_CACHE=<dir>; set a dir to force-enable on CPU).
+
+    The reference compiles its kernels AOT to native code once at build time;
+    the XLA analog is this cache — every (program, shapes) combination
+    compiles once per machine, not once per process. On TPU the big fused
+    programs cost minutes to compile cold, so this is a product-level fix,
+    not just a bench convenience. CPU is excluded by default: XLA:CPU AOT
+    reloads warn (and may SIGILL) across host-feature drift, and CPU
+    compiles are cheap anyway."""
+    global _compile_cache_set
+    if _compile_cache_set:
+        return
+    _compile_cache_set = True
+    import os
+
+    loc = os.environ.get("CYLON_TPU_COMPILE_CACHE", "")
+    if loc == "0":
+        return
+    if platform == "cpu" and not loc:
+        return
+    if not loc:
+        loc = os.path.join(
+            os.path.expanduser("~"), ".cache", "cylon_tpu", "xla_cache"
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", loc)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without the knobs: in-process caching still applies
+
 
 class CylonContext:
     """Holds the mesh, config KV map, and collective sequence numbers.
@@ -28,6 +64,7 @@ class CylonContext:
     """
 
     def __init__(self, mesh: Mesh, axis_name: str, comm_type: CommType):
+        _enable_compile_cache(mesh.devices.flat[0].platform)
         self.mesh = mesh
         self.axis_name = axis_name
         self.comm_type = comm_type

@@ -409,6 +409,10 @@ class DataFrame:
     def astype(self, dtype) -> "DataFrame":
         return self._wrap(self._table.astype(dtype))
 
+    def applymap(self, fn) -> "DataFrame":
+        """Per-element host UDF (pandas/pycylon applymap parity)."""
+        return self._wrap(self._table.applymap(fn))
+
     # -- indexing ------------------------------------------------------
     def set_index(self, column) -> "DataFrame":
         return self._wrap(self._table.set_index(column))

@@ -159,16 +159,16 @@ class HashIndex(BaseIndex):
     def loc_positions(self, values) -> np.ndarray:
         """Row positions for a batch of lookups, in REQUEST order with
         duplicate index entries expanded (pandas loc list semantics).
-        Raises KeyError on a missing value, like pandas."""
+        Missing labels are skipped — the SAME lenient semantics as the
+        eager path (indexer._loc_list_positions), so behavior does not flip
+        when build_index() has been called. (pandas raises KeyError.)"""
         enc = self._encode(values)
         lo = np.searchsorted(self._sorted, enc, side="left")
         hi = np.searchsorted(self._sorted, enc, side="right")
-        if (lo == hi).any():
-            missing = np.asarray(values)[lo == hi]
-            raise KeyError(f"index values not found: {missing[:5].tolist()}")
-        return np.concatenate(
-            [np.sort(self._positions[a:b]) for a, b in zip(lo, hi)]
-        )
+        parts = [np.sort(self._positions[a:b]) for a, b in zip(lo, hi) if b > a]
+        if not parts:
+            return np.empty(0, np.int64)
+        return np.concatenate(parts)
 
     def __contains__(self, value) -> bool:
         try:

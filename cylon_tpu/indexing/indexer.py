@@ -50,6 +50,34 @@ def _encode_values(col, values):
     return encode_lookup_values(dictionary, np.dtype(col.data.dtype), values)
 
 
+def _loc_list_positions(table, col, vals) -> np.ndarray:
+    """Global row positions for ``loc[list]`` with exact pandas semantics:
+    labels in REQUEST order; each label's matches expand in index order
+    (duplicate index entries repeat, duplicate request labels repeat).
+    Labels absent from the index are skipped — this layer's established
+    lenient semantics (pandas raises KeyError; the reference's LocIndexer
+    errors too, indexing/indexer.cpp) — so ``loc[[missing]]`` is empty, not
+    an exception.
+
+    Host-side by design: list-loc is a point lookup, not a scan — the
+    repeated-lookup fast path is the built HashIndex/LinearIndex
+    (index.py), which keeps its own position map."""
+    enc = _encode_values(col, vals)  # request order
+    data, valid = table._host_physical(table.index_name)
+    pos_all = np.arange(len(data), dtype=np.int64)
+    if valid is not None:
+        data = data[valid]
+        pos_all = pos_all[valid]
+    order = np.argsort(data, kind="stable")  # stable: index order per label
+    sdata = data[order]
+    los = np.searchsorted(sdata, enc, side="left")
+    his = np.searchsorted(sdata, enc, side="right")
+    parts = [pos_all[order[lo:hi]] for lo, hi in zip(los, his) if hi > lo]
+    if not parts:
+        return np.empty(0, np.int64)
+    return np.concatenate(parts)
+
+
 def _encode_bound(col, value, side: str):
     """Encode a slice bound. For dictionary columns a missing bound maps to
     its insertion point so range semantics hold (e.g. 'c' between 'b' and
@@ -88,9 +116,13 @@ class LocIndexer:
         elif _is_bool_mask(rows):
             # boolean-mask mode (pandas loc[df['a'] > 0])
             return t.filter(self._t._as_mask(rows))
+        elif np.isscalar(rows) or isinstance(rows, str):
+            # scalar label: all matching rows in index order == the mask
+            # filter's order, so the vectorized device path is exact
+            enc = _encode_values(col, [rows])
+            mask = jnp.asarray(enc[0]) == col.data
         else:
-            scalar = np.isscalar(rows) or isinstance(rows, str)
-            vals = [rows] if scalar else list(rows)
+            vals = list(rows)
             if len(vals) == 0:
                 return t.filter(jnp.zeros(col.data.shape, bool))
             built = getattr(self._t, "_built_index", None)
@@ -99,11 +131,7 @@ class LocIndexer:
                 # index entries expanded — exact pandas loc list semantics
                 positions = built[1].loc_positions(vals)
                 return t.take(positions)
-            enc = np.sort(_encode_values(col, vals))
-            dev = jnp.asarray(enc)
-            pos = jnp.searchsorted(dev, col.data)
-            pos = jnp.clip(pos, 0, len(enc) - 1)
-            mask = dev[pos] == col.data
+            return t.take(_loc_list_positions(self._t, col, vals))
         if col.valid is not None:
             mask = mask & col.valid
         return t.filter(mask)

@@ -34,6 +34,9 @@ class CSVReadOptions:
         self._block_size = 1 << 20
         self._skip_rows = 0
         self._column_names: Optional[List[str]] = None
+        self._na_values: Optional[List[str]] = None
+        self._ignore_empty_lines = True
+        self._column_types: Optional[Dict[str, Any]] = None
 
     def with_delimiter(self, d: str) -> "CSVReadOptions":
         self._delimiter = d
@@ -54,6 +57,33 @@ class CSVReadOptions:
     def with_column_names(self, names: Sequence[str]) -> "CSVReadOptions":
         self._column_names = list(names)
         return self
+
+    def na_values(self, vals: Sequence[str]) -> "CSVReadOptions":
+        """Strings parsed as null (reference CSVReadOptions::NullValues,
+        io/csv_read_config.hpp)."""
+        self._na_values = [str(v) for v in vals]
+        return self
+
+    def ignore_empty_lines(self, flag: bool) -> "CSVReadOptions":
+        """False keeps empty lines as all-null rows (reference
+        CSVReadOptions::IgnoreEmptyLines)."""
+        self._ignore_empty_lines = bool(flag)
+        return self
+
+    def with_column_types(self, types: Dict[str, Any]) -> "CSVReadOptions":
+        """Per-column dtype overrides (numpy dtypes or strings; reference
+        CSVReadOptions::WithColumnTypes)."""
+        self._column_types = dict(types)
+        return self
+
+    def _needs_arrow(self) -> bool:
+        """The native mmap codec covers the hot defaults; the breadth options
+        route through the pyarrow codec instead of duplicating its parser."""
+        return (
+            self._na_values is not None
+            or not self._ignore_empty_lines
+            or self._column_types is not None
+        )
 
 
 class CSVWriteOptions:
@@ -101,6 +131,7 @@ from ..table import unify_encoded_shards as _unify_shards  # noqa: E402
 
 
 def _read_one(path: str, options: CSVReadOptions) -> Dict[str, np.ndarray]:
+    import pyarrow as pa
     from pyarrow import csv as pacsv
 
     ropts = pacsv.ReadOptions(
@@ -109,8 +140,23 @@ def _read_one(path: str, options: CSVReadOptions) -> Dict[str, np.ndarray]:
         skip_rows=options._skip_rows,
         column_names=options._column_names,
     )
-    popts = pacsv.ParseOptions(delimiter=options._delimiter)
-    at = pacsv.read_csv(path, read_options=ropts, parse_options=popts)
+    popts = pacsv.ParseOptions(
+        delimiter=options._delimiter,
+        ignore_empty_lines=options._ignore_empty_lines,
+    )
+    ckw: Dict[str, Any] = {}
+    if options._na_values is not None:
+        ckw["null_values"] = options._na_values
+        ckw["strings_can_be_null"] = True
+    if options._column_types is not None:
+        ckw["column_types"] = {
+            name: pa.from_numpy_dtype(np.dtype(t))
+            for name, t in options._column_types.items()
+        }
+    copts = pacsv.ConvertOptions(**ckw) if ckw else None
+    at = pacsv.read_csv(
+        path, read_options=ropts, parse_options=popts, convert_options=copts
+    )
     out = {}
     for name in at.column_names:
         col = at.column(name)
@@ -132,7 +178,7 @@ def read_csv(
       multi-file read, table.cpp:791-829 — here a thread pool).
     """
     options = options or CSVReadOptions()
-    if native.available():
+    if native.available() and not options._needs_arrow():
         if isinstance(paths, (list, tuple)):
             with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
                 shards = list(ex.map(lambda p: _read_one_native(p, options), paths))

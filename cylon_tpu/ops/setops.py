@@ -133,13 +133,17 @@ def _two_table_keep(
     nr: jax.Array,
     cap_l: int,
     cap_r: int,
-    want_in_r: bool,
+    want_in_r,
 ) -> Tuple[jax.Array, jax.Array]:
     """(keep mask, spay) over the combined sort: keep = first live LEFT row
     of each run whose run does (intersect) / does not (subtract) contain a
     live right row. Lefts precede rights within a run (stable sort over the
     [left ++ right] concatenation), so the run's first element is a left
-    whenever the run has one."""
+    whenever the run has one.
+
+    ``want_in_r`` may be a TRACED bool scalar: subtract and intersect then
+    share one compiled program (the op is data, not a compile-time constant —
+    the select is the only point where they differ)."""
     cap = cap_l + cap_r
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = (idx < nl) | ((idx >= cap_l) & (idx < cap_l + nr))
@@ -163,16 +167,23 @@ def _two_table_keep(
     is_r_live = (spay >= cap_l) & (spay < cap_l + nr)
     # keep is evaluated at run STARTS only, where count-from == run total
     r_in_run = run_count_from(new_run, is_r_live)
-    hit = (r_in_run > 0) if want_in_r else (r_in_run == 0)
+    hit = jnp.where(jnp.asarray(want_in_r), r_in_run > 0, r_in_run == 0)
     keepm = new_run & is_l_live & hit
     return keepm, spay
 
 
-def subtract_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
-    keepm, spay = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, False)
+def setop_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out, want_in_r):
+    """Shared subtract/intersect emit; ``want_in_r`` is a traced scalar so
+    both ops compile to the SAME XLA program (compile-time halves)."""
+    keepm, spay = _two_table_keep(
+        l_cols, r_cols, nl, nr, cap_l, cap_r, want_in_r
+    )
     return _emit_by_pay(keepm, spay, cap_out)
+
+
+def subtract_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
+    return setop_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out, False)
 
 
 def intersect_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
-    keepm, spay = _two_table_keep(l_cols, r_cols, nl, nr, cap_l, cap_r, True)
-    return _emit_by_pay(keepm, spay, cap_out)
+    return setop_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out, True)

@@ -1,0 +1,196 @@
+"""Out-of-core (beyond-HBM) streaming join over the op-DAG.
+
+Reference analog: the byte-chunked streaming shuffle
+(arrow/arrow_all_to_all.cpp:83-141) exists precisely so tables larger than
+one node's memory can move through fixed-size buffers, and the streaming
+DisJoinOP graph (ops/dis_join_op.cpp:26-71) rides it. XLA programs are
+static-shaped and HBM-resident, so the TPU-native equivalent restructures
+the problem instead of streaming bytes: a **Grace-style partitioned join**.
+
+- Each host-staged input chunk is hash-partitioned into K buckets ON DEVICE
+  (vectorized murmur3 — the same family every shuffle uses, so bucket
+  assignment is consistent across chunks and across the two inputs);
+- buckets spill back to the HOST arena immediately (chunk-sized device
+  footprint);
+- after both streams drain, bucket i of the left joins bucket i of the
+  right (equal hash => co-partitioned), ONE bucket pair device-resident at
+  a time, each bucket-join running as a normal mesh-distributed join;
+- results leave the device through a chunked host sink, never concatenated
+  on device.
+
+Device memory is bounded by max(chunk, bucket-pair + join intermediates),
+never by table size: with K buckets a table of N rows needs ~N/K device
+rows at the join stage, so any table fits by raising K.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..table import Table
+from .dag import Op, RootOp, RoundRobinExecution
+
+__all__ = ["OutOfCoreJoin", "SpillPartitionOp", "HostSink"]
+
+
+def _host_concat(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    names = list(parts[0].keys())
+    return {n: np.concatenate([p[n] for p in parts]) for n in names}
+
+
+class SpillPartitionOp(Op):
+    """Hash-partition each chunk into K buckets and spill them to host
+    (reference PartitionOp + the spill role of the chunked shuffle). The
+    device footprint per quantum is one chunk + its K filtered buckets."""
+
+    def __init__(self, op_id: str, keys: Sequence[str], k: int):
+        super().__init__(op_id, 1)
+        self.keys = list(keys)
+        self.k = k
+        self.spill: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(k)]
+        self.max_device_cap = 0  # observability: largest device table built
+
+    def process(self, chunk: Table, edge: int) -> None:
+        self.max_device_cap = max(self.max_device_cap, chunk.shard_cap)
+        parts = chunk.hash_partition(self.keys, self.k)
+        for p, t in parts.items():
+            if t.row_count:
+                self.spill[p].append(t.to_pydict())
+        return None
+
+
+class BucketJoinOp(Op):
+    """At finalize, join spilled bucket i of the left with bucket i of the
+    right — one bucket pair on device at a time — and emit each bucket's
+    result downstream (reference JoinOp, but without the all-chunks concat
+    that would defeat out-of-core)."""
+
+    def __init__(
+        self,
+        op_id: str,
+        ctx,
+        left_spill: SpillPartitionOp,
+        right_spill: SpillPartitionOp,
+        **join_kwargs,
+    ):
+        super().__init__(op_id, 2)
+        self.ctx = ctx
+        self.left_spill = left_spill
+        self.right_spill = right_spill
+        self.join_kwargs = join_kwargs
+        self.max_device_cap = 0
+
+    def process(self, table: Table, edge: int) -> None:
+        return None  # data arrives via the spills, not the queues
+
+    def on_finalize(self) -> Optional[Table]:
+        k = self.left_spill.k
+        for b in range(k):
+            lparts = self.left_spill.spill[b]
+            rparts = self.right_spill.spill[b]
+            if not lparts or not rparts:
+                continue  # inner join of an empty side is empty
+            lt = Table.from_pydict(self.ctx, _host_concat(lparts))
+            rt = Table.from_pydict(self.ctx, _host_concat(rparts))
+            self.max_device_cap = max(
+                self.max_device_cap, lt.shard_cap, rt.shard_cap
+            )
+            out = lt.distributed_join(rt, **self.join_kwargs)
+            self._emit(out)
+            # spilled buckets are consumed; free the host arena as we go
+            self.left_spill.spill[b] = []
+            self.right_spill.spill[b] = []
+        return None
+
+
+class HostSink(RootOp):
+    """Chunked sink: every result chunk leaves the device immediately; the
+    combined result lives on the HOST (reference: per-rank CSV writes are the
+    same pattern). ``result_pydict()`` is the host concat; ``RootOp.result()``
+    (device concat) is deliberately unavailable."""
+
+    def __init__(self, op_id: str = "host_sink"):
+        super().__init__(op_id, 1)
+        self.host_chunks: List[Dict[str, np.ndarray]] = []
+        self.rows = 0
+
+    def process(self, table: Table, edge: int) -> None:
+        self.rows += table.row_count
+        self.host_chunks.append(table.to_pydict())
+        return None
+
+    def result(self) -> Table:  # pragma: no cover - guard
+        raise RuntimeError(
+            "HostSink keeps results on the host; use result_pydict()"
+        )
+
+    def result_pydict(self) -> Dict[str, np.ndarray]:
+        if not self.host_chunks:
+            return {}
+        return _host_concat(self.host_chunks)
+
+
+class OutOfCoreJoin:
+    """Join two chunk streams whose totals exceed device capacity.
+
+    ``execute(left_chunks, right_chunks)`` accepts iterables of host
+    column-dicts (the host-staged chunk source); returns the HostSink. K
+    buckets bound the device-resident bucket size to ~total/K rows.
+    """
+
+    def __init__(self, ctx, on, how: str = "inner", num_buckets: int = 8,
+                 **join_kwargs):
+        if how != "inner":
+            # outer joins need null-extension for one-sided buckets, which
+            # BucketJoinOp's skip-empty-bucket logic would silently drop
+            raise NotImplementedError(
+                "OutOfCoreJoin supports how='inner' only"
+            )
+        keys = on if isinstance(on, (list, tuple)) else [on]
+        self.ctx = ctx
+        self.lp = SpillPartitionOp("spill_l", keys, num_buckets)
+        self.rp = SpillPartitionOp("spill_r", keys, num_buckets)
+        self.join = BucketJoinOp(
+            "bucket_join", ctx, self.lp, self.rp,
+            on=on, how=how, **join_kwargs,
+        )
+        self.sink = HostSink()
+        self.lp.add_child(self.join, edge=0)
+        self.rp.add_child(self.join, edge=1)
+        self.join.add_child(self.sink)
+
+    def execute(
+        self,
+        left_chunks: Iterable[Dict[str, np.ndarray]],
+        right_chunks: Iterable[Dict[str, np.ndarray]],
+    ) -> HostSink:
+        execution = RoundRobinExecution(self.lp, self.rp)
+        li, ri = iter(left_chunks), iter(right_chunks)
+        # stream: at most ONE pending chunk per source per quantum — the
+        # host-staged source is pull-based, so the whole input is never
+        # resident anywhere at once
+        exhausted = [False, False]
+        while not all(exhausted):
+            for i, (it, src) in enumerate(((li, self.lp), (ri, self.rp))):
+                if exhausted[i]:
+                    continue
+                try:
+                    chunk = next(it)
+                except StopIteration:
+                    exhausted[i] = True
+                    src.finish()
+                    continue
+                src.insert(Table.from_pydict(self.ctx, dict(chunk)))
+            execution.step()
+        execution.run()
+        return self.sink
+
+    @property
+    def max_device_cap(self) -> int:
+        """Largest per-shard device capacity any stage ever allocated —
+        the out-of-core guarantee is max_device_cap << total rows."""
+        return max(
+            self.lp.max_device_cap, self.rp.max_device_cap,
+            self.join.max_device_cap,
+        )

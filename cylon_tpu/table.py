@@ -134,6 +134,18 @@ def _host_col_like(
     return Column(data_dev, dtype, valid_dev, dictionary)
 
 
+@jax.jit
+def _minmax_kernel(d, ok, big, small):
+    """Both bounds in one program: XLA fuses the two masked reductions into a
+    single pass and the result pair comes back in one host fetch."""
+    return jnp.stack(
+        [
+            jnp.min(jnp.where(ok, d, big)),
+            jnp.max(jnp.where(ok, d, small)),
+        ]
+    )
+
+
 class Table:
     """See module docstring. Construct via the ``from_*`` factories."""
 
@@ -594,9 +606,18 @@ class Table:
         offsets + local position; padding values are don't-care). Carried
         through a shuffle it lets order-sensitive ops (unique keep=first/
         last) recover original order, which multi-round exchanges do not
-        preserve."""
+        preserve. Global ids are int32; the static bound shard_cap * shards
+        caps every possible id, so exceeding int32 raises here instead of
+        silently wrapping (which would pick the wrong duplicate in
+        distributed_unique keep='first'/'last')."""
         cap = self._shard_cap
         counts = self.counts_dev  # [P] sharded
+        if cap * self.world_size > 2**31 - 1:
+            raise ValueError(
+                f"global row ids exceed int32 range (shard_cap={cap} x "
+                f"{self.world_size} shards); order-sensitive distributed ops "
+                "(unique keep='first'/'last') are limited to 2^31-1 global rows"
+            )
 
         def f(counts):
             offs = jnp.cumsum(counts) - counts
@@ -1272,6 +1293,15 @@ class Table:
                 return self._rebuild_cols(
                     list(zip(out_names, src_cols)), out, nout_h, join_cap,
                 )
+            if ov_join >= 2**31 - 1:
+                # the pipeline's saturated wrap sentinel (pipeline.py:113-115):
+                # a shard's join count overflowed int32. Resizing to
+                # join_cap + 2^31 would overflow the int32 iotas/allocation
+                # downstream, so diagnose cleanly instead of recompiling.
+                raise RuntimeError(
+                    "fused join per-shard output count exceeds int32 "
+                    "(extreme skew); use mode='eager'"
+                )
             if ov_shuffle > 0:
                 bucket_cap *= 2
                 join_cap = max(
@@ -1314,7 +1344,6 @@ class Table:
         lflat = a._flat_cols()
         rflat = b._flat_cols()
         nc = len(lflat)
-        emit_fn = _s.subtract_emit if op == "subtract" else _s.intersect_emit
 
         # Single-dispatch: the output is a subset of the LEFT rows, so
         # cap_out = a.shard_cap is a static exact upper bound — no count
@@ -1322,14 +1351,19 @@ class Table:
         # design, but with speculation that can never miss). A selective
         # result is compacted after the fact like the join's.
         cap_out = a.shard_cap
-        key = ("setop", op, nc, cap_out)  # cap_out is a closure constant
+        # subtract and intersect share ONE program: the op rides in as a
+        # replicated traced scalar (setops.setop_emit), not a cache key
+        key = ("setop2", nc, cap_out)  # cap_out is a closure constant
 
         def build_emit():
             def kern(dp, rep):
                 (lk, rk, nl, nr) = dp
+                (want_in_r,) = rep
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
-                idx, total = emit_fn(lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out)
+                idx, total = _s.setop_emit(
+                    lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out, want_in_r
+                )
                 out, _ = _g_pack.pack_gather(list(lk), idx)
                 return out, _scalar(total)
 
@@ -1337,7 +1371,8 @@ class Table:
 
         with span(f"setop.{op}", rows=int(self.row_count)):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-                (lflat, rflat, a.counts_dev, b.counts_dev), ()
+                (lflat, rflat, a.counts_dev, b.counts_dev),
+                (jnp.asarray(op == "intersect"),),
             )
             counts = self._out_counts(nout)  # the ONE host sync
         res = a._rebuild_cols(
@@ -1642,6 +1677,27 @@ class Table:
         c = jnp.sum(ok)
         return (s / jnp.maximum(c, 1)).item()
 
+    def minmax(self, column: Union[str, int]):
+        """Fused MinMax (reference compute/aggregates.cpp:82-121: one pass +
+        one AllReduce for both bounds). Both reductions live in ONE jitted
+        program — XLA fuses them into a single pass over the column and a
+        single collective pair — and both scalars come back in ONE host
+        fetch, vs two programs + two fetches for separate min()/max()."""
+        col, ok = self._masked_col(column)
+        d = col.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            big = jnp.asarray(jnp.inf, d.dtype)
+            small = jnp.asarray(-jnp.inf, d.dtype)
+        else:
+            info = jnp.iinfo(d.dtype)
+            big = jnp.asarray(info.max, d.dtype)
+            small = jnp.asarray(info.min, d.dtype)
+        both = np.asarray(_minmax_kernel(d, ok, big, small))
+        return (
+            self._decode_scalar(col, both[0]),
+            self._decode_scalar(col, both[1]),
+        )
+
     @staticmethod
     def _decode_scalar(col: Column, value):
         if col.dtype.is_dictionary:
@@ -1651,6 +1707,28 @@ class Table:
     # ------------------------------------------------------------------
     # elementwise / pandas-flavored utilities (pycylon table.pyx surface)
     # ------------------------------------------------------------------
+    def applymap(self, fn) -> "Table":
+        """Per-element Python UDF over every column (reference pycylon
+        ``Table.applymap``, python/pycylon/data/table.pyx:2222-2240).
+        Arbitrary host callables can't be traced, so each shard round-trips
+        through the host and is re-encoded in place — sharding is preserved
+        and string-valued UDFs work (results re-infer their encoding).
+        Device-traceable fns belong on :func:`compute.map_columns`."""
+        shards: List[Dict[str, Any]] = []
+        for s in range(self.world_size):
+            data: Dict[str, Any] = {}
+            for name in self.column_names:
+                d, v = self._host_physical_shard(name, s)
+                vals = self._columns[name].decode_host(d, v)
+                data[name] = np.asarray([fn(x) for x in vals], dtype=object)
+            shards.append(data)
+        if self.world_size == 1:
+            out = Table.from_pydict(self.ctx, shards[0])
+        else:
+            out = Table.from_shards(self.ctx, shards)
+        out.index_name = self.index_name  # row-preserving op: index survives
+        return out
+
     def isnull(self) -> "Table":
         cols = OrderedDict()
         for n, c in self._columns.items():

@@ -40,6 +40,13 @@ public final class CylonTpu {
   final MethodHandle writeCsv;
   final MethodHandle release;
   final MethodHandle shutdown;
+  final MethodHandle select;
+  final MethodHandle filterColumn;
+  final MethodHandle mapColumn;
+  final MethodHandle hashPartition;
+  final MethodHandle merge;
+  final MethodHandle print;
+  final Linker linker;
   final Arena arena = Arena.ofShared();
 
   private static CylonTpu instance;
@@ -87,7 +94,7 @@ public final class CylonTpu {
   }
 
   private CylonTpu(String capiSoPath) {
-    Linker linker = Linker.nativeLinker();
+    linker = Linker.nativeLinker();
     SymbolLookup lib = SymbolLookup.libraryLookup(capiSoPath, arena);
     lastError = handle(linker, lib, "ct_api_last_error",
         FunctionDescriptor.of(ADDRESS));
@@ -109,6 +116,94 @@ public final class CylonTpu {
     release = handle(linker, lib, "ct_api_release",
         FunctionDescriptor.ofVoid(JAVA_LONG));
     shutdown = handle(linker, lib, "ct_api_shutdown", FunctionDescriptor.ofVoid());
+    // round-3 surface: callback-driven select/filter/map + partition/merge
+    select = handle(linker, lib, "ct_api_select",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, ADDRESS, ADDRESS));
+    filterColumn = handle(linker, lib, "ct_api_filter_column",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, JAVA_INT, ADDRESS, ADDRESS));
+    mapColumn = handle(linker, lib, "ct_api_map_column",
+        FunctionDescriptor.of(JAVA_LONG, JAVA_LONG, JAVA_INT, ADDRESS, ADDRESS));
+    hashPartition = handle(linker, lib, "ct_api_hash_partition",
+        FunctionDescriptor.of(JAVA_INT, JAVA_LONG, ADDRESS, JAVA_INT, ADDRESS));
+    merge = handle(linker, lib, "ct_api_merge",
+        FunctionDescriptor.of(JAVA_LONG, ADDRESS, JAVA_INT));
+    print = handle(linker, lib, "ct_api_print",
+        FunctionDescriptor.of(JAVA_INT, JAVA_LONG));
+  }
+
+  /** Upcall stub for ct_row_pred: int32 (*)(int64 row, const char* csv,
+   *  void* user). The Java predicate sees (row index, the row as CSV). */
+  MemorySegment rowPredStub(Arena a, java.util.function.BiPredicate<Long, String> pred) {
+    try {
+      MethodHandle target = java.lang.invoke.MethodHandles.lookup().bind(
+          new Object() {
+            @SuppressWarnings("unused")
+            int call(long row, MemorySegment csv, MemorySegment user) {
+              String s = csv.reinterpret(Long.MAX_VALUE).getString(0);
+              return pred.test(row, s) ? 1 : 0;
+            }
+          },
+          "call",
+          java.lang.invoke.MethodType.methodType(
+              int.class, long.class, MemorySegment.class, MemorySegment.class));
+      return linker.upcallStub(target,
+          FunctionDescriptor.of(JAVA_INT, JAVA_LONG, ADDRESS, ADDRESS), a);
+    } catch (ReflectiveOperationException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  /** Upcall stub for ct_val_pred: int32 (*)(const char* value, void* user). */
+  MemorySegment valPredStub(Arena a, java.util.function.Predicate<String> pred) {
+    try {
+      MethodHandle target = java.lang.invoke.MethodHandles.lookup().bind(
+          new Object() {
+            @SuppressWarnings("unused")
+            int call(MemorySegment value, MemorySegment user) {
+              return pred.test(value.reinterpret(Long.MAX_VALUE).getString(0))
+                  ? 1 : 0;
+            }
+          },
+          "call",
+          java.lang.invoke.MethodType.methodType(
+              int.class, MemorySegment.class, MemorySegment.class));
+      return linker.upcallStub(target,
+          FunctionDescriptor.of(JAVA_INT, ADDRESS, ADDRESS), a);
+    } catch (ReflectiveOperationException e) {
+      throw new RuntimeException(e);
+    }
+  }
+
+  /** Upcall stub for ct_val_map: int32 (*)(const char* in, char* out,
+   *  int32 cap, void* user) — writes the mapped string, returns its length. */
+  MemorySegment valMapStub(Arena a, java.util.function.UnaryOperator<String> fn) {
+    try {
+      MethodHandle target = java.lang.invoke.MethodHandles.lookup().bind(
+          new Object() {
+            @SuppressWarnings("unused")
+            int call(MemorySegment in, MemorySegment out, int cap,
+                MemorySegment user) {
+              String s = fn.apply(in.reinterpret(Long.MAX_VALUE).getString(0));
+              byte[] b = s.getBytes(java.nio.charset.StandardCharsets.UTF_8);
+              if (b.length + 1 > cap) {
+                return -1;
+              }
+              MemorySegment seg = out.reinterpret(cap);
+              MemorySegment.copy(b, 0, seg, java.lang.foreign.ValueLayout.JAVA_BYTE, 0, b.length);
+              seg.set(java.lang.foreign.ValueLayout.JAVA_BYTE, b.length, (byte) 0);
+              return b.length;
+            }
+          },
+          "call",
+          java.lang.invoke.MethodType.methodType(int.class,
+              MemorySegment.class, MemorySegment.class, int.class,
+              MemorySegment.class));
+      return linker.upcallStub(target,
+          FunctionDescriptor.of(JAVA_INT, ADDRESS, ADDRESS, JAVA_INT, ADDRESS),
+          a);
+    } catch (ReflectiveOperationException e) {
+      throw new RuntimeException(e);
+    }
   }
 
   private static MethodHandle handle(Linker linker, SymbolLookup lib,

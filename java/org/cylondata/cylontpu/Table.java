@@ -110,6 +110,88 @@ public final class Table implements AutoCloseable {
     });
   }
 
+  /**
+   * Row-UDF select (reference select(Selector) :226-238 — the one callback
+   * method the reference actually implements through JNI). The predicate
+   * receives (row index, the row rendered as a CSV line).
+   */
+  public Table select(java.util.function.BiPredicate<Long, String> pred) {
+    return call(rt, "select", a ->
+        wrap(rt, (long) rt.select.invokeExact(
+            handle, rt.rowPredStub(a, pred),
+            java.lang.foreign.MemorySegment.NULL), "select"));
+  }
+
+  /**
+   * Single-column value filter (reference filter(col, Filter) :214 — which
+   * throws unSupportedException in the reference; implemented for real
+   * here). Values arrive as their string rendering.
+   */
+  public Table filter(int colIndex, java.util.function.Predicate<String> pred) {
+    return call(rt, "filter", a ->
+        wrap(rt, (long) rt.filterColumn.invokeExact(
+            handle, colIndex, rt.valPredStub(a, pred),
+            java.lang.foreign.MemorySegment.NULL), "filter"));
+  }
+
+  /**
+   * Per-element column map (reference mapColumn :156 — unSupportedException
+   * there; real here). Returns a new 1-column table; the result dtype is
+   * re-inferred from the mapped strings.
+   */
+  public Table mapColumn(int colIndex, java.util.function.UnaryOperator<String> fn) {
+    return call(rt, "mapColumn", a ->
+        wrap(rt, (long) rt.mapColumn.invokeExact(
+            handle, colIndex, rt.valMapStub(a, fn),
+            java.lang.foreign.MemorySegment.NULL), "mapColumn"));
+  }
+
+  /**
+   * Hash partition into k tables (reference hashPartition :166 —
+   * unSupportedException there; the C++ core HashPartition is the analog).
+   */
+  public java.util.List<Table> hashPartition(String columnsCsv, int k) {
+    return call(rt, "hashPartition", a -> {
+      java.lang.foreign.MemorySegment out = a.allocate(
+          java.lang.foreign.ValueLayout.JAVA_LONG, k);
+      int rc = (int) rt.hashPartition.invokeExact(
+          handle, rt.cstr(a, columnsCsv), k, out);
+      if (rc != 0) {
+        throw new RuntimeException("hashPartition failed: " + rt.errorMessage());
+      }
+      java.util.List<Table> parts = new java.util.ArrayList<>(k);
+      for (int p = 0; p < k; p++) {
+        parts.add(new Table(rt,
+            out.getAtIndex(java.lang.foreign.ValueLayout.JAVA_LONG, p)));
+      }
+      return parts;
+    });
+  }
+
+  /** Merge same-schema tables (reference static merge :187). */
+  public static Table merge(CylonTpu rt, Table... tables) {
+    return call(rt, "merge", a -> {
+      java.lang.foreign.MemorySegment hs = a.allocate(
+          java.lang.foreign.ValueLayout.JAVA_LONG, tables.length);
+      for (int i = 0; i < tables.length; i++) {
+        hs.setAtIndex(java.lang.foreign.ValueLayout.JAVA_LONG, i,
+            tables[i].handle);
+      }
+      return wrap(rt, (long) rt.merge.invokeExact(hs, tables.length), "merge");
+    });
+  }
+
+  /** Print the table head to stdout (reference print -> JNI print). */
+  public void print() {
+    call(rt, "print", a -> {
+      int rc = (int) rt.print.invokeExact(handle);
+      if (rc != 0) {
+        throw new RuntimeException("print failed: " + rt.errorMessage());
+      }
+      return null;
+    });
+  }
+
   /** Write the table to CSV (gathered on the host edge). Reference :233. */
   public void writeCSV(String path) {
     call(rt, "write_csv", a -> {

@@ -1,0 +1,70 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md):
+
+1. object-column ints outside int64 range must not crash encode_host
+   (column.py int inference path);
+2. _global_rowid_column must refuse >2^31-1 global rows instead of wrapping
+   (table.py global rowid lane);
+3. the fused-join retry loop must diagnose the int32 wrap sentinel cleanly
+   instead of recompiling with an overflowing capacity (table.py retry loop,
+   sentinel from parallel/pipeline.py:113-115).
+"""
+import numpy as np
+import pytest
+from unittest import mock
+
+import cylon_tpu as ct
+from cylon_tpu.column import Column
+from cylon_tpu.dtypes import Type
+
+
+def test_object_int_beyond_int64_falls_back_to_dictionary():
+    vals = np.array([2**70, 3, None], dtype=object)
+    data, valid, dtype, dictionary = Column.encode_host(vals)
+    assert dtype.type == Type.STRING
+    assert dictionary is not None
+    decoded = dictionary[data]
+    assert str(2**70) in set(decoded.tolist())
+    assert valid is not None and valid.tolist() == [True, True, False]
+
+
+def test_object_int_within_int64_still_exact():
+    vals = np.array([2**62, -5, None], dtype=object)
+    data, valid, dtype, dictionary = Column.encode_host(vals)
+    assert dictionary is None
+    assert data.dtype == np.int64
+    assert data[0] == 2**62
+
+
+def test_object_mixed_int_float_still_float64():
+    vals = np.array([1, 2.5, None], dtype=object)
+    data, valid, dtype, dictionary = Column.encode_host(vals)
+    assert data.dtype == np.float64
+    assert data[1] == 2.5
+
+
+def test_global_rowid_refuses_int32_overflow(ctx8):
+    tbl = ct.Table.from_pydict(ctx8, {"a": np.arange(16, dtype=np.int32)})
+    tbl._shard_cap = (2**31 - 1) // ctx8.world_size + 1
+    with pytest.raises(ValueError, match="int32 range"):
+        tbl._global_rowid_column()
+
+
+def test_fused_join_wrap_sentinel_raises_cleanly(ctx8):
+    n = 64
+    rng = np.random.default_rng(0)
+    tbl = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 8, n).astype(np.int32)}
+    )
+    other = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 8, n).astype(np.int32)}
+    )
+    P = ctx8.world_size
+
+    # fused join's single host sync fetches concat(nout[P], overflow[P,2]);
+    # forge the saturated join-lane sentinel the pipeline emits on int32 wrap
+    forged = np.concatenate(
+        [np.zeros(P, np.int64), np.tile([0, 2**31 - 1], P)]
+    )
+    with mock.patch("cylon_tpu.table._fetch", return_value=forged):
+        with pytest.raises(RuntimeError, match="mode='eager'"):
+            tbl.distributed_join(other, on="k", how="inner", mode="fused")

@@ -200,6 +200,37 @@ def test_speculative_overflow_falls_back(world_ctx, rng):
     )
 
 
+def test_fused_overflow_retry_on_mesh(world_ctx, rng):
+    """Fused mode with undersized capacities on a mesh: the overflow lane
+    must trigger the capacity-doubling retry (table.py _fused_join loop) and
+    the retried result must match pandas. Extreme skew (every row the same
+    key) lands the whole join on ONE shard, so the initial join_cap of
+    2*(1+respill)*world*bucket_cap is guaranteed too small."""
+    n = 64
+    k = np.zeros(n, np.int32)
+    lt = ct.Table.from_pydict(
+        world_ctx, {"k": k, "v": np.arange(n, dtype=np.int32)}
+    )
+    rt = ct.Table.from_pydict(
+        world_ctx, {"k": k, "w": np.arange(n, dtype=np.int32)}
+    )
+    out = lt.distributed_join(rt, on="k", how="inner", mode="fused")
+    assert out.row_counts.sum() == n * n
+    expect = (
+        pd.DataFrame({"k": k, "v": np.arange(n)})
+        .merge(pd.DataFrame({"k": k, "w": np.arange(n)}), on="k")
+        .sort_values(["v", "w"])
+        .reset_index(drop=True)
+    )
+    got = (
+        out.to_pandas()[["k_x", "v", "w"]]
+        .rename(columns={"k_x": "k"})
+        .sort_values(["v", "w"])
+        .reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+
 def test_join_compacts_tiny_output(ctx8, rng):
     """A selective join output is compacted below the speculative cap."""
     n = 3000

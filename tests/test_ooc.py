@@ -1,0 +1,75 @@
+"""Out-of-core join (VERDICT round-2 item 7): both inputs exceed any single
+device allocation we permit; the Grace-style partitioned dag join streams
+chunks through bounded device memory and matches pandas.
+
+Reference analog: the byte-chunked streaming shuffle
+(arrow/arrow_all_to_all.cpp:83-141) + DisJoinOP (ops/dis_join_op.cpp:26-71).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel.ooc import OutOfCoreJoin
+
+
+def _chunks(df, chunk_rows):
+    for i in range(0, len(df), chunk_rows):
+        part = df.iloc[i : i + chunk_rows]
+        yield {c: part[c].to_numpy() for c in df.columns}
+
+
+def test_ooc_join_exceeds_device_budget(ctx8):
+    rng = np.random.default_rng(3)
+    n = 60_000  # per side
+    chunk_rows = 4_000
+    ldf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 20_000, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+        }
+    )
+    rdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 20_000, n).astype(np.int32),
+            "w": rng.normal(size=n).astype(np.float32),
+        }
+    )
+
+    job = OutOfCoreJoin(ctx8, on="k", how="inner", num_buckets=16)
+    sink = job.execute(_chunks(ldf, chunk_rows), _chunks(rdf, chunk_rows))
+
+    expect = ldf.merge(rdf, on="k", how="inner")
+    assert sink.rows == len(expect)
+
+    got = pd.DataFrame(sink.result_pydict())
+    got = (
+        got[["k_x", "v", "w"]]
+        .rename(columns={"k_x": "k"})
+        .sort_values(["k", "v", "w"], kind="mergesort")
+        .reset_index(drop=True)
+    )
+    want = (
+        expect.sort_values(["k", "v", "w"], kind="mergesort")
+        .reset_index(drop=True)[["k", "v", "w"]]
+    )
+    pd.testing.assert_frame_equal(got, want, check_dtype=False, atol=1e-6)
+
+    # the out-of-core guarantee: no stage ever allocated device capacity
+    # anywhere near the full table — the whole-table join would need a
+    # shard_cap of ~n/8 = 7.5k; every stage stayed at chunk/bucket scale
+    full_cap_needed = n // ctx8.world_size
+    assert job.max_device_cap < full_cap_needed, (
+        job.max_device_cap, full_cap_needed,
+    )
+
+
+def test_ooc_join_empty_bucket_sides(ctx8):
+    """Keys chosen so some buckets are one-sided or empty: inner join must
+    skip them without error."""
+    ldf = pd.DataFrame({"k": np.array([1, 1, 2], np.int32), "v": np.arange(3.0)})
+    rdf = pd.DataFrame({"k": np.array([2, 3], np.int32), "w": np.arange(2.0)})
+    job = OutOfCoreJoin(ctx8, on="k", how="inner", num_buckets=8)
+    sink = job.execute(_chunks(ldf, 2), _chunks(rdf, 1))
+    expect = ldf.merge(rdf, on="k")
+    assert sink.rows == len(expect) == 1

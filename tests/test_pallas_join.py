@@ -1,0 +1,76 @@
+"""Pallas PK-FK join probe (ops/pallas_join.py) vs a pandas oracle.
+
+Runs in pallas interpret mode on the CPU mesh; the same kernel compiles to
+Mosaic on a real TPU (benchmarks/pallas_bench.py measures it head-to-head
+against the sort-based spec_join).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from cylon_tpu.ops.pallas_join import pk_inner_join
+
+
+def _run(lk, rk, B=64, nb=0):
+    cap_l, cap_r = len(lk), len(rk)
+    l_idx, r_idx, total, bad = pk_inner_join(
+        jnp.asarray(lk), jnp.asarray(rk),
+        jnp.int32(cap_l), jnp.int32(cap_r),
+        nb=nb, B=B, interpret=True,
+    )
+    return (
+        np.asarray(l_idx), np.asarray(r_idx), int(total), int(bad),
+    )
+
+
+def test_pk_join_matches_pandas():
+    rng = np.random.default_rng(0)
+    n = 512
+    rk = rng.permutation(1024)[:n].astype(np.int32)  # unique PK
+    lk = rng.choice(rk, size=n, replace=True).astype(np.int32)  # FK hits
+    lk[::7] = 5000 + np.arange(len(lk[::7]))  # some misses
+    l_idx, r_idx, total, bad = _run(lk, rk)
+    assert bad == 0
+
+    expect = pd.DataFrame({"k": lk, "li": np.arange(n)}).merge(
+        pd.DataFrame({"k": rk, "ri": np.arange(n)}), on="k"
+    )
+    assert total == len(expect)
+    got = set(zip(l_idx[:total].tolist(), r_idx[:total].tolist()))
+    want = set(zip(expect["li"].tolist(), expect["ri"].tolist()))
+    assert got == want
+
+
+def test_pk_join_reports_duplicate_right():
+    lk = np.arange(32, dtype=np.int32)
+    rk = np.array([1, 2, 2, 3] + list(range(10, 38)), dtype=np.int32)
+    _, _, _, bad = _run(lk, rk)
+    assert bad != 0  # caller must fall back to the exact join
+
+
+def test_pk_join_reports_bucket_overflow():
+    # nb=2 buckets of B=4: 32 keys cannot fit -> overflow flag
+    lk = np.arange(32, dtype=np.int32)
+    rk = np.arange(32, dtype=np.int32)
+    _, _, _, bad = _run(lk, rk, B=4, nb=2)
+    assert bad != 0
+
+
+def test_pk_join_partial_live_counts():
+    lk = np.array([5, 6, 7, 99, 99, 99], dtype=np.int32)
+    rk = np.array([7, 5, 42, 99, 99, 99], dtype=np.int32)
+    cap = len(lk)
+    l_idx, r_idx, total, bad = (
+        np.asarray(x) if not np.isscalar(x) else x
+        for x in pk_inner_join(
+            jnp.asarray(lk), jnp.asarray(rk),
+            jnp.int32(3), jnp.int32(3),  # only first 3 rows live
+            B=8, interpret=True,
+        )
+    )
+    assert int(bad) == 0
+    assert int(total) == 2  # 5 and 7 match; padding 99s must not
+    pairs = set(zip(np.asarray(l_idx)[:2].tolist(), np.asarray(r_idx)[:2].tolist()))
+    assert pairs == {(0, 1), (2, 0)}
