@@ -41,9 +41,16 @@ def shard_caps(total_rows: int, world: int) -> Tuple[np.ndarray, int]:
 
 
 def get_kernel(
-    ctx: CylonContext, key: Tuple, builder: Callable[[], Callable]
+    ctx: CylonContext,
+    key: Tuple,
+    builder: Callable[[], Callable],
+    check_vma: bool = True,
 ) -> Callable:
-    """Fetch (or build+jit) the shard_map-wrapped kernel for this context."""
+    """Fetch (or build+jit) the shard_map-wrapped kernel for this context.
+
+    ``check_vma=False`` disables shard_map's varying-mesh-axes checker —
+    needed by kernels embedding ``pallas_call`` (its output vma interplay
+    with unvarying iotas trips the checker)."""
     cache = ctx.__dict__.setdefault("_jit_cache", {})
     fn = cache.get(key)
     if fn is None:
@@ -54,6 +61,7 @@ def get_kernel(
                 mesh=ctx.mesh,
                 in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
                 out_specs=PartitionSpec(ctx.axis_name),
+                check_vma=check_vma,
             )
         )
         cache[key] = fn

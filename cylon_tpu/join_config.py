@@ -17,6 +17,9 @@ from typing import Optional, Sequence, Tuple, Union
 class JoinAlgorithm:
     SORT = "sort"
     HASH = "hash"
+    # TPU-only extension: bucketed Pallas PK-FK probe (ops/pallas_join.py);
+    # speculative — falls back to SORT on duplicate right keys or overflow
+    PALLAS_PK = "pallas_pk"
 
 
 class JoinConfig:
@@ -32,7 +35,9 @@ class JoinConfig:
         from .ops.join import join_type_id
 
         join_type_id(join_type)  # validate early
-        if algorithm not in (JoinAlgorithm.SORT, JoinAlgorithm.HASH):
+        if algorithm not in (
+            JoinAlgorithm.SORT, JoinAlgorithm.HASH, JoinAlgorithm.PALLAS_PK
+        ):
             raise ValueError(f"unknown join algorithm {algorithm!r}")
         self.join_type = join_type
         self.on = on

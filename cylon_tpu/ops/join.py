@@ -111,12 +111,32 @@ def _merged_counts(
 
 
 def _repeat_ss(ends: jax.Array, cap_out: int) -> jax.Array:
-    """``jnp.repeat(arange(n), counts, total_repeat_length=cap_out)`` via the
-    same argsort trick: li[k] = #(ends <= k) with ends = inclusive cumsum of
-    counts. The arange queries are already sorted so their rank is the
-    identity — one combined double-argsort replaces the repeat's
-    scatter+cumsum lowering."""
+    """``jnp.repeat(arange(n), counts, total_repeat_length=cap_out)``.
+
+    Default: the argsort trick — li[k] = #(ends <= k) with ends = inclusive
+    cumsum of counts; the arange queries are already sorted so their rank is
+    the identity, and one combined double-argsort replaces the repeat's
+    scatter+cumsum lowering.
+
+    ``CYLON_TPU_REPEAT_IMPL=scatter`` selects the scatter+cummax variant:
+    row index i lands at its start offset, cummax forward-fills the run. The
+    roofline model prices the two n+cap_out argsorts at ~35%% of the whole
+    16M-row join, vs one n-element scatter (~10 pass-equivalents) + a scan —
+    but round-2 measurements showed XLA TPU scatters sometimes lose to
+    sorts, so the sort stays default until benchmarks/micro_bench.py decides
+    on real hardware."""
+    import os
+
     n = ends.shape[0]
+    if os.environ.get("CYLON_TPU_REPEAT_IMPL", "sort") == "scatter":
+        starts = jnp.concatenate([jnp.zeros((1,), ends.dtype), ends[:-1]])
+        cnt = ends - starts
+        rows = jnp.arange(n, dtype=jnp.int32)
+        tgt = jnp.where(cnt > 0, starts, cap_out).astype(jnp.int32)
+        fill = jnp.full((cap_out + 1,), -1, jnp.int32)
+        # distinct targets (strictly increasing among cnt>0 rows): plain set
+        fill = fill.at[tgt].set(rows, mode="drop")
+        return jax.lax.cummax(fill[:cap_out])
     pos = jnp.arange(cap_out, dtype=ends.dtype)
     comb = _inv_perm(jnp.argsort(jnp.concatenate([ends, pos]), stable=True))
     return (comb[n:] - pos).astype(jnp.int32)
@@ -497,3 +517,99 @@ def gather_column(
     if valid is None:
         return out, ok
     return out, ok & valid[safe]
+
+
+def join_sum_by_key_pushdown(
+    l_key_cols: Sequence[KeyCol],
+    r_key_cols: Sequence[KeyCol],
+    l_val: KeyCol,
+    nl: jax.Array,
+    nr: jax.Array,
+    group_cap: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """INNER join + groupby-SUM(left column) BY the join key, fused into the
+    probe sort itself — no join emit, no groupby sort.
+
+    The query-optimizer pushdown the reference never does (it always
+    materializes the join, then groups: groupby/groupby.cpp:33-91). Within
+    one equal-key run of the merged probe sort every live left row pairs
+    with every live right row, so the group's sum of the left value over
+    the JOIN RESULT is ``count(live rights) * sum(left values)`` and the
+    group's join-row count is ``c_l * c_r`` — all computable with run scans
+    and segment scatter-adds. Cost: ONE merged kv-sort
+    (value riding as a payload lane) + ONE compaction sort, vs ~8-9 sorts
+    for join-then-groupby; the roofline model prices that at >3x.
+
+    Returns (group sums [group_cap] float, ng UNCLAMPED, n_join,
+    overflow_groups). ``ng`` may exceed ``group_cap`` (the caller detects
+    truncation, mirroring the generic group_ids contract); ``n_join``
+    saturates to 2^31-1 on int32 wrap (a float32 shadow mirrors the count,
+    exactly like join_shard's count_overflow_check policy). Null/padding
+    values contribute 0 (SUM skip-null). Intended for floating aggregate
+    columns; the caller keeps the generic path for ints.
+
+    Per-group accumulation is SEGMENT SCATTER-ADD, not prefix-sum
+    differences: differencing a global float32 running sum would give every
+    group an absolute error scaling with the GLOBAL total (catastrophic at
+    the 16M-row target), while scatter-add error scales with each group's
+    own magnitude — the same reason the groupby float kernels kept
+    scatter-add.
+    """
+    from .sort import run_count_from
+
+    cap_l = l_key_cols[0][0].shape[0]
+    cap_r = r_key_cols[0][0].shape[0]
+    cap_cat = cap_r + cap_l
+    l_ids, r_ids = _canonical_ids(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+
+    vd, vv = l_val
+    acc = vd if jnp.issubdtype(vd.dtype, jnp.floating) else vd.astype(jnp.float32)
+    live_l_row = jnp.arange(cap_l, dtype=jnp.int32) < nl
+    vok = live_l_row if vv is None else (live_l_row & vv)
+    vsafe = jnp.where(vok, acc, jnp.zeros_like(acc))
+
+    keys = jnp.concatenate([r_ids, l_ids])  # rights FIRST (matches probe)
+    pay = jnp.arange(cap_cat, dtype=jnp.int32)
+    ride = jnp.concatenate([jnp.zeros((cap_r,), vsafe.dtype), vsafe])
+    skey, spay, sval = jax.lax.sort(
+        (keys, pay, ride), num_keys=1, is_stable=True
+    )
+    is_l = spay >= cap_r
+    is_l_live = is_l & (spay < cap_r + nl)
+    is_r_live = (~is_l) & (spay < nr)
+    new_run = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+
+    # run-start totals decide which runs are GROUPS (>=1 live left AND right)
+    c_r = run_count_from(new_run, is_r_live)
+    c_l = run_count_from(new_run, is_l_live)
+    group_start = new_run & (c_l > 0) & (c_r > 0)
+    # broadcast the start's verdict over its whole run (monotone gather by
+    # the run-start index) and number the groups in key order
+    iota = jnp.arange(cap_cat, dtype=jnp.int32)
+    start_idx = jax.lax.cummax(jnp.where(new_run, iota, 0))
+    ok_run = group_start[start_idx]
+    gid = jnp.cumsum(group_start.astype(jnp.int32)) - 1  # constant per run
+    ng = jnp.sum(group_start).astype(jnp.int32)
+
+    # segment scatter-adds into group slots; rows past group_cap drop (the
+    # unclamped ng reveals the truncation to the caller)
+    tgt = jnp.where(ok_run, gid, group_cap)
+    sums = jnp.zeros((group_cap + 1,), vsafe.dtype).at[tgt].add(
+        jnp.where(is_l_live, sval, jnp.zeros_like(sval)), mode="drop"
+    )
+    cntr = jnp.zeros((group_cap + 1,), jnp.int32).at[tgt].add(
+        is_r_live.astype(jnp.int32), mode="drop"
+    )
+    cntl = jnp.zeros((group_cap + 1,), jnp.int32).at[tgt].add(
+        is_l_live.astype(jnp.int32), mode="drop"
+    )
+    s = sums[:group_cap] * cntr[:group_cap].astype(vsafe.dtype)
+
+    nj_i = jnp.sum(cntl[:group_cap] * cntr[:group_cap]).astype(jnp.int32)
+    nj_f = jnp.sum(
+        cntl[:group_cap].astype(jnp.float32) * cntr[:group_cap].astype(jnp.float32)
+    )
+    wrapped = (nj_i < 0) | (nj_f > jnp.float32(2**31))
+    n_join = jnp.where(wrapped, jnp.int32(2**31 - 1), nj_i)
+    overflow_groups = jnp.maximum(ng - group_cap, 0)
+    return s, ng, n_join, overflow_groups

@@ -107,12 +107,19 @@ def _pallas_probe(
         raise RuntimeError("pallas unavailable")
     grid = (nb,)
     spec = pl.BlockSpec((B,), lambda b: (b,))
+    try:
+        # under shard_map with vma checking, the output must declare how it
+        # varies across mesh axes: same as the (per-shard) inputs
+        vma = jax.typeof(lkeys_b).vma
+        out_shape = jax.ShapeDtypeStruct((nb * B,), jnp.int32, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((nb * B,), jnp.int32)
     return pl.pallas_call(
         _probe_block,
         grid=grid,
         in_specs=[spec, spec, spec],
         out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((nb * B,), jnp.int32),
+        out_shape=out_shape,
         interpret=interpret,
     )(lkeys_b, rkeys_b, ridx_b)
 
@@ -141,8 +148,11 @@ def pk_inner_join(
     """
     cap_l = l_key.shape[0]
     if nb == 0:
-        # target ~half-full buckets at expected live occupancy
-        need = max(int(cap_l // max(B // 2, 1)), 1)
+        # target ~half-full buckets at expected live occupancy; size from the
+        # LARGER side or the smaller one is guaranteed to overflow by
+        # pigeonhole (a permanent speculation miss)
+        biggest = max(cap_l, r_key.shape[0])
+        need = max(int(biggest // max(B // 2, 1)), 1)
         nb = 1 << (need - 1).bit_length()
     pad = jnp.asarray(jnp.iinfo(l_key.dtype).min, l_key.dtype)
     lkb, lib, ov_l = _bucket_layout(l_key, nl, nb, B, pad)

@@ -195,16 +195,41 @@ def make_join_groupby_step(
         if world > 1:
             lt, _ = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name, respill)
             rt, _ = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name, respill)
-        jt, _ = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
-        # group on the (left) join key, sum the aggregate column
-        keys = [jt.cols[i] for i in l_key_idx]
-        ids, ng = _g.group_ids(keys, jt.n, join_cap)
-        d, v = jt.cols[agg_col_idx]
-        s, _sv = _g.aggregate_column(_g.SUM, d, v, ids, ng, group_cap)
+        # group key == join key and SUM over a floating LEFT column: the
+        # whole join+groupby collapses into the probe sort (per key run,
+        # sum = c_r * sum(v_l)) — ops/join.join_sum_by_key_pushdown. ~2
+        # sorts instead of ~8-9; the reference always materializes the join
+        # first (groupby/groupby.cpp:33-91).
+        agg_is_left = agg_col_idx < len(lt.cols)
+        agg_dtype = (lt.cols if agg_is_left else rt.cols)[
+            agg_col_idx if agg_is_left else agg_col_idx - len(lt.cols)
+        ][0].dtype
+        if (
+            how == _j.INNER
+            and agg_is_left
+            and jnp.issubdtype(agg_dtype, jnp.floating)
+            and np.dtype(agg_dtype).itemsize <= 4
+            # 64-bit ride lanes have no audited TPU variadic-sort lowering
+            # (ops/sort.split_ride_cols rationale) — f64 takes the generic
+            # path
+        ):
+            lk = [lt.cols[i] for i in l_key_idx]
+            rk = [rt.cols[i] for i in r_key_idx]
+            s, ng, n_join, _og = _j.join_sum_by_key_pushdown(
+                lk, rk, lt.cols[agg_col_idx], lt.n, rt.n, group_cap
+            )
+        else:
+            jt, _ = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
+            # group on the (left) join key, sum the aggregate column
+            keys = [jt.cols[i] for i in l_key_idx]
+            ids, ng = _g.group_ids(keys, jt.n, join_cap)
+            d, v = jt.cols[agg_col_idx]
+            s, _sv = _g.aggregate_column(_g.SUM, d, v, ids, ng, group_cap)
+            n_join = jt.n
         total = s.sum()
         if world > 1:
             total = jax.lax.psum(total, axis_name)
-        return s, ng.reshape(1), jt.n.reshape(1), total.reshape(1)
+        return s, ng.reshape(1), n_join.reshape(1), total.reshape(1)
 
     return jax.jit(
         jax.shard_map(

@@ -1040,11 +1040,16 @@ class Table:
         config: Optional["object"] = None,
     ) -> "Table":
         """Per-shard (local) equi-join — all 4 types (reference Join,
-        table.cpp:428-480; join/hash_join.cpp + sort_join.cpp). ``algorithm``
-        is accepted for API parity; the TPU implementation is always the
-        sort/searchsorted join (SURVEY.md §7: argsort is native, hash
-        multimaps are not). ``config`` takes a JoinConfig object (reference
-        join_config.hpp:33-189) and must then be the ONLY join argument."""
+        table.cpp:428-480; join/hash_join.cpp + sort_join.cpp).
+
+        ``algorithm``: 'sort' and 'hash' both execute the sort/searchsorted
+        join (SURVEY.md §7: argsort is native, hash multimaps are not —
+        accepted for reference JoinConfig parity); 'pallas_pk' selects the
+        bucketed Pallas PK-FK probe (single null-free <=32-bit integer key,
+        inner only; speculative — duplicate right keys or bucket overflow
+        silently rerun the exact sort join). ``config`` takes a JoinConfig
+        object (reference join_config.hpp:33-189) and must then be the ONLY
+        join argument."""
         if config is not None:
             if (
                 on is not None or left_on is not None or right_on is not None
@@ -1056,6 +1061,8 @@ class Table:
                 )
             return self.join(other, **config.kwargs())
         l_names, r_names = self._resolve_join_keys(other, on, left_on, right_on)
+        if algorithm == "pallas_pk":
+            return self._pallas_pk_join(other, l_names, r_names, how, suffixes)
         howi = _j.join_type_id(how)
         left, right = _unify_dict_pair(self, other, l_names, r_names)
         lflat_k = left._flat_cols(l_names)
@@ -1177,6 +1184,92 @@ class Table:
         return self._rebuild_cols(
             list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
         )
+
+    def _pallas_pk_join(
+        self,
+        other: "Table",
+        l_names,
+        r_names,
+        how: str,
+        suffixes: Tuple[str, str],
+    ) -> "Table":
+        """``algorithm='pallas_pk'``: the bucketed Pallas PK-FK probe
+        (ops/pallas_join.py — VMEM broadcast-compare, no probe sort) as a
+        selectable join algorithm, the way the reference's JoinConfig picks
+        SORT vs HASH (join_config.hpp:26-189).
+
+        Single integer (or dictionary-code) key, inner join, no nulls on
+        the key. Right-key uniqueness and bucket overflow are SPECULATED:
+        the kernel reports a ``bad`` flag and the join silently reruns on
+        the exact sort-based path — same single-sync philosophy as
+        spec_join, never a wrong answer."""
+        if how != "inner":
+            raise ValueError("algorithm='pallas_pk' supports how='inner' only")
+        left, right = _unify_dict_pair(self, other, l_names, r_names)
+        left, right = _promote_key_pair(left, right, l_names, r_names)
+        lk = left._flat_cols(l_names)
+        rk = right._flat_cols(r_names)
+        if len(lk) != 1 or lk[0][1] is not None or rk[0][1] is not None:
+            raise ValueError(
+                "algorithm='pallas_pk' needs a single null-free key column"
+            )
+        kd = lk[0][0].dtype
+        if not (jnp.issubdtype(kd, jnp.integer) and np.dtype(kd).itemsize <= 4):
+            raise ValueError(
+                "algorithm='pallas_pk' needs an integer (or dictionary-"
+                f"encoded) key <= 32 bits, got {np.dtype(kd)}"
+            )
+        from .ops import pallas_join as _pk
+
+        lflat = left._flat_cols()
+        rflat = right._flat_cols()
+        # inner PK-FK output has <= 1 match per left row: cap_out = cap_l is
+        # a static exact bound -> single dispatch, ONE host sync
+        cap_out = left.shard_cap
+        B = 256
+        interp = self.ctx.mesh.devices.flat[0].platform == "cpu"
+        key = (
+            "pallas_pk_join", len(lflat), len(rflat), cap_out, B, interp,
+        )
+
+        def build():
+            def kern(dp, rep):
+                (lkc, rkc, lcols, rcols, nl, nr) = dp
+                l_idx, r_idx, total, bad = _pk.pk_inner_join(
+                    lkc[0][0], rkc[0][0], nl[0], nr[0], B=B, interpret=interp,
+                )
+                out_l, _ = _g_pack.pack_gather(list(lcols), l_idx)
+                out_r, _ = _g_pack.pack_gather(list(rcols), r_idx)
+                return list(out_l) + list(out_r), jnp.stack([total, bad])
+
+            return kern
+
+        with span("join.pallas_pk", rows=int(self.row_count)):
+            # check_vma=False: pallas_call output vma interplay with
+            # unvarying iotas trips shard_map's checker (jax limitation)
+            out, stats = get_kernel(self.ctx, key, build, check_vma=False)(
+                (lk, rk, lflat, rflat, left.counts_dev, right.counts_dev), ()
+            )
+            bump("host_sync")
+            stats = _fetch(stats).reshape(-1, 2)  # the ONE host sync
+        if int(stats[:, 1].sum()) != 0:
+            # speculation miss (duplicate right keys / bucket overflow):
+            # exact sort-based join, correctness never depends on the hint
+            return self.join(
+                other,
+                left_on=l_names if l_names != r_names else None,
+                right_on=r_names if l_names != r_names else None,
+                on=l_names if l_names == r_names else None,
+                how=how,
+                suffixes=suffixes,
+            )
+        out_names = _suffix_names(left.column_names, right.column_names, suffixes)
+        src_cols = list(left._columns.values()) + list(right._columns.values())
+        res = self._rebuild_cols(
+            list(zip(out_names, src_cols)), out, stats[:, 0].astype(np.int64),
+            cap_out,
+        )
+        return res._maybe_compact(res._row_counts)
 
     def distributed_join(
         self,

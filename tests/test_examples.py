@@ -14,6 +14,24 @@ def test_etl_logreg_end_to_end(devices):
     assert acc > 0.85
 
 
+def test_ooc_join_example_flow(devices):
+    """examples/ooc_join.py's exact flow at test size: out-of-core join with
+    bounded device allocations."""
+    import cylon_tpu as ct
+    from cylon_tpu.parallel.ooc import OutOfCoreJoin
+    from examples.ooc_join import chunk_stream
+
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig())
+    n, chunk_rows = 40_000, 4_000
+    job = OutOfCoreJoin(ctx, on="k", how="inner", num_buckets=16)
+    sink = job.execute(
+        chunk_stream(np.random.default_rng(0), n, chunk_rows, "x"),
+        chunk_stream(np.random.default_rng(1), n, chunk_rows, "y"),
+    )
+    assert sink.rows > 0
+    assert job.max_device_cap < n // ctx.world_size
+
+
 def test_join_groupby_example_flow(devices):
     # the example's exact flow at test size (the 1M-row original is the
     # bench config; this keeps the suite fast)

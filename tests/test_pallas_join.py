@@ -58,6 +58,67 @@ def test_pk_join_reports_bucket_overflow():
     assert bad != 0
 
 
+# ------------------------------------------------------- algorithm surface
+def test_join_algorithm_pallas_pk(world_ctx, rng):
+    """Table.join(algorithm='pallas_pk') — the JoinConfig SORT/HASH-style
+    algorithm selector with the Pallas probe; values checked per shard."""
+    import cylon_tpu as ct
+
+    n = 240
+    rkeys = rng.permutation(5000)[:n].astype(np.int32)
+    lkeys = rng.choice(rkeys, n).astype(np.int32)
+    lkeys[::6] = 90000 + np.arange(len(lkeys[::6]))  # misses
+    lt = ct.Table.from_pydict(
+        world_ctx, {"k": lkeys, "v": rng.normal(size=n).astype(np.float32)}
+    )
+    rt = ct.Table.from_pydict(
+        world_ctx, {"k": rkeys, "w": rng.normal(size=n).astype(np.float32)}
+    )
+    got = lt.join(rt, on="k", algorithm="pallas_pk")
+    want = lt.join(rt, on="k")  # the exact sort-based local join
+    assert got.row_count == want.row_count
+    g = got.to_pandas().sort_values(["k_x", "v"]).reset_index(drop=True)
+    w = want.to_pandas().sort_values(["k_x", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w, check_dtype=False, atol=1e-6)
+
+
+def test_join_algorithm_pallas_pk_falls_back_on_duplicates(ctx8, rng):
+    import cylon_tpu as ct
+
+    lkeys = rng.integers(0, 40, 160).astype(np.int32)
+    rkeys = rng.integers(0, 40, 120).astype(np.int32)  # heavy duplicates
+    lt = ct.Table.from_pydict(ctx8, {"k": lkeys})
+    rt = ct.Table.from_pydict(ctx8, {"k": rkeys})
+    got = lt.join(rt, on="k", algorithm="pallas_pk")
+    want = lt.join(rt, on="k")
+    assert got.row_count == want.row_count  # exact fallback, no wrong answer
+
+
+def test_join_algorithm_pallas_pk_rejects_unsupported(ctx8, rng):
+    import cylon_tpu as ct
+
+    lt = ct.Table.from_pydict(ctx8, {"k": rng.normal(size=16).astype(np.float32)})
+    rt = ct.Table.from_pydict(ctx8, {"k": rng.normal(size=16).astype(np.float32)})
+    with pytest.raises(ValueError, match="pallas_pk"):
+        lt.join(rt, on="k", algorithm="pallas_pk")
+    lt2 = ct.Table.from_pydict(ctx8, {"k": np.arange(8, dtype=np.int32)})
+    with pytest.raises(ValueError, match="inner"):
+        lt2.join(lt2, on="k", how="left", algorithm="pallas_pk")
+
+
+def test_join_config_pallas_pk_algorithm(ctx8, rng):
+    import cylon_tpu as ct
+    from cylon_tpu.join_config import JoinConfig
+
+    rkeys = rng.permutation(500)[:60].astype(np.int32)
+    lt = ct.Table.from_pydict(ctx8, {"k": rng.choice(rkeys, 60).astype(np.int32)})
+    rt = ct.Table.from_pydict(ctx8, {"k": rkeys})
+    cfg = JoinConfig.inner_join(on="k", algorithm="pallas_pk")
+    got = lt.join(rt, config=cfg)
+    want = lt.join(rt, on="k")
+    assert got.row_count == want.row_count
+
+
 def test_pk_join_partial_live_counts():
     lk = np.array([5, 6, 7, 99, 99, 99], dtype=np.int32)
     rk = np.array([7, 5, 42, 99, 99, 99], dtype=np.int32)

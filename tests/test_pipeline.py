@@ -212,6 +212,72 @@ def test_join_groupby_step_total(devices, rng, world):
     assert np.isclose(t[0], exp["v_l"].sum(), rtol=1e-4)
 
 
+@pytest.mark.parametrize("world", [1, 2, 8])
+def test_join_groupby_pushdown_group_sums(devices, rng, world):
+    """The join+groupby-SUM pushdown (ops/join.join_sum_by_key_pushdown,
+    used by make_join_groupby_step when group key == join key and the agg
+    column is floating): per-group sums must match pandas as a multiset,
+    not just in total."""
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n_l = np.full((world,), 28, np.int32)
+    n_r = np.full((world,), 22, np.int32)
+    l_cols, l_counts, l_df = _mk_table(mesh, rng, world, shard_cap, n_l, keyspace=9)
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n_r, keyspace=9)
+
+    step = make_join_groupby_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), agg_col_idx=1, how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=world * shard_cap * 16, group_cap=64,
+    )
+    sums, ng, n_join, total = step((l_cols, l_counts, r_cols, r_counts), ())
+    exp = (
+        l_df.merge(r_df, on="k", how="inner", suffixes=("_l", "_r"))
+        .groupby("k")["v_l"].sum()
+    )
+    got = []
+    sums = np.asarray(sums).reshape(world, -1)
+    for s_i, ng_i in zip(sums, np.asarray(ng).reshape(-1)):
+        got += s_i[: int(ng_i)].tolist()
+    assert int(np.asarray(n_join).sum()) == len(
+        l_df.merge(r_df, on="k", how="inner")
+    )
+    assert len(got) == len(exp)
+    assert np.allclose(sorted(got), sorted(exp.values), rtol=1e-4)
+
+
+def test_join_groupby_step_int_agg_generic_path(devices, rng):
+    """An integer aggregate column must route through the generic
+    join-then-groupby path (the pushdown accumulates in float)."""
+    world = 2
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n_l = np.full((world,), 20, np.int32)
+    n_r = np.full((world,), 20, np.int32)
+    l_cols, l_counts, l_df = _mk_table(mesh, rng, world, shard_cap, n_l, keyspace=7)
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n_r, keyspace=7)
+    # replace the value column with ints
+    import jax
+
+    iv = []
+    for (d, v) in l_cols:
+        iv.append((d, v))
+    int_vals = np.arange(world * shard_cap, dtype=np.int32)
+    iv[1] = (jax.device_put(jnp.asarray(int_vals), l_cols[0][0].sharding), None)
+    l_df = l_df.copy()
+    per = [int_vals.reshape(world, shard_cap)[i, :20] for i in range(world)]
+    l_df["v"] = np.concatenate(per)
+
+    step = make_join_groupby_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), agg_col_idx=1, how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=world * shard_cap * 16, group_cap=64,
+    )
+    sums, ng, n_join, total = step((iv, l_counts, r_cols, r_counts), ())
+    exp = l_df.merge(r_df, on="k", how="inner", suffixes=("_l", "_r"))
+    assert int(np.asarray(n_join).sum()) == len(exp)
+    t = np.asarray(total)
+    assert np.isclose(t[0], exp["v_l"].sum(), rtol=1e-5)
+
+
 def test_join_step_overflow_flags(devices, rng):
     """Undersized bucket_cap / join_cap must raise the overflow flag, not
     silently truncate counts."""
