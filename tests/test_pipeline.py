@@ -245,6 +245,30 @@ def test_join_groupby_pushdown_group_sums(devices, rng, world):
     assert np.allclose(sorted(got), sorted(exp.values), rtol=1e-4)
 
 
+@pytest.mark.parametrize("world", [1, 4])
+def test_join_groupby_pushdown_null_values(devices, rng, world):
+    """Null aggregate values contribute 0 (SUM skip-null), matching pandas
+    groupby sum over the join result."""
+    mesh = _mk_mesh(devices, world)
+    shard_cap = 32
+    n_l = np.full((world,), 26, np.int32)
+    n_r = np.full((world,), 20, np.int32)
+    l_cols, l_counts, l_df = _mk_table(
+        mesh, rng, world, shard_cap, n_l, keyspace=8, with_nulls=True
+    )
+    r_cols, r_counts, r_df = _mk_table(mesh, rng, world, shard_cap, n_r, keyspace=8)
+
+    step = make_join_groupby_step(
+        mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), agg_col_idx=1, how=_j.INNER,
+        bucket_cap=world * shard_cap, join_cap=world * shard_cap * 16, group_cap=64,
+    )
+    sums, ng, n_join, total = step((l_cols, l_counts, r_cols, r_counts), ())
+    exp = l_df.merge(r_df, on="k", how="inner", suffixes=("_l", "_r"))
+    assert int(np.asarray(n_join).sum()) == len(exp)
+    t = np.asarray(total)
+    assert np.isclose(t[0], exp["v_l"].sum(), rtol=1e-4)
+
+
 def test_join_groupby_step_int_agg_generic_path(devices, rng):
     """An integer aggregate column must route through the generic
     join-then-groupby path (the pushdown accumulates in float)."""

@@ -1,0 +1,89 @@
+"""benchmarks/roofline.py analyzer sanity: primitive counting and traffic
+math on known-shape programs (the model feeds BENCH_TPU.md's %membw column,
+so its bookkeeping needs a regression net)."""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.roofline import (
+    GATHER_PASS_EQ,
+    _bitonic_passes,
+    analyze,
+    model_seconds,
+)
+
+
+def test_counts_one_sort_with_pass_weighting():
+    n = 1 << 12
+
+    def f(x, p):
+        return jax.lax.sort((x, p), num_keys=1, is_stable=True)
+
+    rep = analyze(
+        f,
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
+    assert rep.sort_count == 1
+    assert rep.sort_bytes_per_pass == 2 * n * 4
+    assert rep.sort_pass_bytes == 2 * n * 4 * _bitonic_passes(n)
+
+
+def test_counts_gather_pass_equivalents():
+    n = 1 << 10
+
+    def f(x, idx):
+        return x[idx]
+
+    rep = analyze(
+        f,
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
+    assert rep.sort_count == 0
+    assert rep.gather_bytes > 0
+    # weighted: in+out bytes x pass-equivalents
+    assert rep.gather_bytes == pytest.approx(3 * n * 4 * GATHER_PASS_EQ)
+
+
+def test_recurses_into_jit_and_shard_map():
+    import __graft_entry__ as ge
+
+    devs = ge._force_cpu_mesh(2)
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(np.array(devs[:2]), ("dp",))
+    n = 256
+
+    def kern(x):
+        s, = jax.lax.sort((x,), num_keys=1)
+        return s
+
+    f = jax.jit(
+        jax.shard_map(
+            kern, mesh=mesh,
+            in_specs=PartitionSpec("dp"), out_specs=PartitionSpec("dp"),
+        )
+    )
+    rep = analyze(f, jax.ShapeDtypeStruct((2 * n,), jnp.int32))
+    assert rep.sort_count == 1  # found through jit -> shard_map nesting
+
+
+def test_model_seconds_scales_with_bandwidth():
+    def f(x, p):
+        return jax.lax.sort((x, p), num_keys=1)
+
+    rep = analyze(
+        f,
+        jax.ShapeDtypeStruct((1 << 16,), jnp.int32),
+        jax.ShapeDtypeStruct((1 << 16,), jnp.int32),
+    )
+    assert model_seconds(rep, 100.0) == pytest.approx(
+        2 * model_seconds(rep, 200.0)
+    )
