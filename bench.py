@@ -250,12 +250,17 @@ def main():
     record_tpu_attempt(payload)
     if payload.get("platform") == "cpu":
         # surface any mid-round TPU capture alongside the CPU fallback so
-        # the evidence survives an end-of-round tunnel flake (clearly
-        # labeled as the earlier attempt, not this run's measurement)
+        # the evidence survives an end-of-round tunnel flake — with its AGE,
+        # so a stale file from an earlier round is visibly stale rather
+        # than silently presented as current
         try:
             with open(os.path.join(REPO_DIR, "BENCH_TPU_attempt.json")) as f:
-                payload["mid_round_tpu_attempt"] = json.load(f)
-        except (OSError, json.JSONDecodeError):
+                attempt = json.load(f)
+            cap = attempt.get("captured_unix")
+            if cap is not None:
+                attempt["age_s"] = int(time.time()) - int(cap)
+            payload["mid_round_tpu_attempt"] = attempt
+        except (OSError, json.JSONDecodeError, ValueError):
             pass
     emit(payload)
 

@@ -59,6 +59,43 @@ def _bench(fn, reps: int):
 from bench import fence as _sync  # noqa: E402
 
 
+def _roofline_recorded(extra: dict, hbm: float, measured_s: float, op) -> None:
+    """%membw for an EAGER op chain: record every kernel dispatch during one
+    warm call (engine.record_kernels) and sum the traced models — the model
+    covers exactly the programs the op executed."""
+    if hbm <= 0:
+        return
+    try:
+        from benchmarks.roofline import Report, analyze, model_seconds, pct_membw
+        from cylon_tpu import engine
+
+        engine.record_kernels(True)
+        try:
+            op()
+        finally:
+            kernels = engine.recorded_kernels()
+            engine.record_kernels(False)
+        if not kernels:
+            return
+        total = Report()
+        for fn, args in kernels:
+            rep = analyze(fn, *args)
+            total.sort_count += rep.sort_count
+            total.sort_bytes_per_pass += rep.sort_bytes_per_pass
+            total.sort_pass_bytes += rep.sort_pass_bytes
+            total.gather_bytes += rep.gather_bytes
+            total.scatter_bytes += rep.scatter_bytes
+            total.elementwise_bytes += rep.elementwise_bytes
+            total.collective_bytes += rep.collective_bytes
+        extra["model_s"] = round(model_seconds(total, hbm), 4)
+        extra["pct_membw"] = round(100 * pct_membw(total, measured_s, hbm), 1)
+        extra["kernels"] = len(kernels)
+        if total.sort_pass_bytes:
+            extra["sort_passes_bytes_gb"] = round(total.sort_pass_bytes / 1e9, 2)
+    except Exception as e:
+        print(f"# roofline(recorded) failed: {e}", file=sys.stderr)
+
+
 def _roofline(extra: dict, hbm: float, measured_s: float, fn, *args) -> None:
     """Attach model_s / pct_membw for a traced program to a record's extras.
     The traced (fn, args) MUST reproduce the measured path's exact
@@ -113,6 +150,12 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         results.append(row)
         print(json.dumps(row), flush=True)
 
+    # bandwidth assumption for every roofline row (0 disables the model)
+    hbm = float(os.environ.get(
+        "BENCH_HBM_GBPS",
+        0 if mesh_devices[0].platform == "cpu" else 819.0,
+    ))
+
     # ---- config 1: local inner join, single shard --------------------------
     ctx1 = ct.CylonContext.init_distributed(ct.TPUConfig(devices=mesh_devices[:1]))
     left, right = make_tables(ct, ctx1, n_rows, keyspace=n_rows)
@@ -123,10 +166,6 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
     s, c = _bench(local_join, reps)
     lj_extra = {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC, 3)}
-    hbm = float(os.environ.get(
-        "BENCH_HBM_GBPS",
-        0 if mesh_devices[0].platform == "cpu" else 819.0,
-    ))
     if hbm > 0:
         import jax as _jax
         import jax.numpy as jnp
@@ -165,8 +204,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(out)
 
     s, c = _bench(dist_join, reps)
-    record("dist_inner_join", s, c, 2 * n_rows, world,
-           {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3)})
+    dj_extra = {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3)}
+    _roofline_recorded(dj_extra, hbm, s, dist_join)
+    record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra)
 
     # fused execution mode: whole shuffle->join chain as ONE XLA program
     # with a single host sync (vs one sync per op phase in eager mode) —
@@ -221,7 +261,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(g)
 
     s, c = _bench(q3, reps)
-    record("dist_join_groupby_q3", s, c, 2 * n_rows, world)
+    q3_extra = {}
+    _roofline_recorded(q3_extra, hbm, s, q3)
+    record("dist_join_groupby_q3", s, c, 2 * n_rows, world, q3_extra)
 
     # config 2b: the same chain fully fused (join + groupby + psum in one
     # program, parallel/pipeline.make_join_groupby_step — what the multichip
@@ -259,7 +301,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(out)
 
     s, c = _bench(dsort, reps)
-    record("dist_sort", s, c, n_rows, world)
+    ds_extra = {}
+    _roofline_recorded(ds_extra, hbm, s, dsort)
+    record("dist_sort", s, c, n_rows, world, ds_extra)
 
     # config 4: set ops (shuffle on all columns + sorted dedup) — identical
     # schemas required, so pair ``left`` with a second (k, v) table
@@ -274,7 +318,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
             _sync(out)
 
         s, c = _bench(setop, reps)
-        record(name, s, c, 2 * n_rows, world)
+        so_extra = {}
+        _roofline_recorded(so_extra, hbm, s, setop)
+        record(name, s, c, 2 * n_rows, world, so_extra)
 
     # config 5: out-of-core join — both inputs stream through bounded device
     # memory (Grace-style partitioned dag join, parallel/ooc.py; the analog

@@ -26,6 +26,20 @@ from jax.sharding import PartitionSpec
 
 from .context import CylonContext
 
+# kernel-invocation recording for roofline analysis (benchmarks/roofline.py):
+# when enabled, every get_kernel dispatch appends (compiled_fn, args) so a
+# bench can re-trace exactly the programs an eager op chain executed.
+_KERNEL_RECORD = None
+
+
+def record_kernels(enable: bool) -> None:
+    global _KERNEL_RECORD
+    _KERNEL_RECORD = [] if enable else None
+
+
+def recorded_kernels():
+    return list(_KERNEL_RECORD or [])
+
 
 def round_cap(n: int, minimum: int = 8) -> int:
     """Round a capacity up to a power of two (>= minimum)."""
@@ -65,7 +79,24 @@ def get_kernel(
             )
         )
         cache[key] = fn
-    return fn
+    if _KERNEL_RECORD is None:
+        return fn
+
+    def recording(*args, _fn=fn):
+        # record SHAPES, not the live arrays: pinning every dispatched
+        # kernel's inputs for a whole op chain would hold intermediates XLA
+        # otherwise frees, inflating peak HBM exactly on the big TPU runs
+        # the recorder exists to model
+        spec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype")
+            else x,
+            args,
+        )
+        _KERNEL_RECORD.append((_fn, spec))
+        return _fn(*args)
+
+    return recording
 
 
 def run(ctx: CylonContext, key: Tuple, builder, dp_args, rep_args=()):

@@ -52,13 +52,10 @@ def test_counts_gather_pass_equivalents():
     assert rep.gather_bytes == pytest.approx(3 * n * 4 * GATHER_PASS_EQ)
 
 
-def test_recurses_into_jit_and_shard_map():
-    import __graft_entry__ as ge
-
-    devs = ge._force_cpu_mesh(2)
+def test_recurses_into_jit_and_shard_map(devices):
     from jax.sharding import Mesh, PartitionSpec
 
-    mesh = Mesh(np.array(devs[:2]), ("dp",))
+    mesh = Mesh(np.array(devices[:2]), ("dp",))
     n = 256
 
     def kern(x):
@@ -73,6 +70,33 @@ def test_recurses_into_jit_and_shard_map():
     )
     rep = analyze(f, jax.ShapeDtypeStruct((2 * n,), jnp.int32))
     assert rep.sort_count == 1  # found through jit -> shard_map nesting
+
+
+def test_engine_kernel_recording(ctx8, rng):
+    """engine.record_kernels captures every get_kernel dispatch (fn, args)
+    so eager op chains can be roofline-modeled; disabled leaves dispatch
+    untouched."""
+    import cylon_tpu as ct
+    from cylon_tpu import engine
+
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 9, 64).astype(np.int32)}
+    )
+    engine.record_kernels(True)
+    try:
+        t.unique()
+    finally:
+        ks = engine.recorded_kernels()
+        engine.record_kernels(False)
+    assert len(ks) >= 1
+    fn, args = ks[0]
+    from benchmarks.roofline import analyze
+
+    rep = analyze(fn, *args)
+    assert rep.sort_count >= 1  # unique is sort-based
+
+    engine.record_kernels(False)
+    assert engine.recorded_kernels() == []
 
 
 def test_model_seconds_scales_with_bandwidth():
