@@ -74,6 +74,7 @@ class Report:
     scatter_bytes: float = 0.0
     elementwise_bytes: float = 0.0
     collective_bytes: int = 0
+    collective_count: int = 0
     by_prim: Dict[str, float] = field(default_factory=dict)
 
     @property
@@ -130,6 +131,7 @@ def _walk(jaxpr, rep: Report) -> None:
         elif prim in ("all_to_all", "all_gather", "psum", "ppermute",
                       "reduce_scatter"):
             rep.collective_bytes += in_bytes
+            rep.collective_count += 1
             rep.by_prim[prim] = rep.by_prim.get(prim, 0.0) + in_bytes
         else:
             # elementwise/reduction: fused — count one read + one write

@@ -87,9 +87,14 @@ def _roofline_recorded(extra: dict, hbm: float, measured_s: float, op) -> None:
             total.scatter_bytes += rep.scatter_bytes
             total.elementwise_bytes += rep.elementwise_bytes
             total.collective_bytes += rep.collective_bytes
+            total.collective_count += rep.collective_count
         extra["model_s"] = round(model_seconds(total, hbm), 4)
         extra["pct_membw"] = round(100 * pct_membw(total, measured_s, hbm), 1)
         extra["kernels"] = len(kernels)
+        # bytes-over-ICI accounting (per op): the collective volume the
+        # op ships across the mesh + how many collectives it issues
+        extra["collectives"] = total.collective_count
+        extra["collective_mb"] = round(total.collective_bytes / 1e6, 2)
         if total.sort_pass_bytes:
             extra["sort_passes_bytes_gb"] = round(total.sort_pass_bytes / 1e9, 2)
     except Exception as e:
@@ -365,7 +370,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
             s, c = _bench(djw, reps)
-            record("dist_join_strong_scaling", s, c, 2 * n_rows, w)
+            sc_extra = {}
+            _roofline_recorded(sc_extra, hbm, s, djw)
+            record("dist_join_strong_scaling", s, c, 2 * n_rows, w, sc_extra)
             # weak scaling: n_rows per shard
             lww, rww = make_tables(ct, ctxw, n_rows * w // max(sizes), keyspace=n_rows)
 
@@ -374,7 +381,9 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
             s, c = _bench(djww, reps)
-            record("dist_join_weak_scaling", s, c, 2 * len(lww), w)
+            wc_extra = {}
+            _roofline_recorded(wc_extra, hbm, s, djww)
+            record("dist_join_weak_scaling", s, c, 2 * len(lww), w, wc_extra)
 
     return results
 

@@ -1289,6 +1289,8 @@ class Table:
         XLA program with static capacities and a single host sync (the
         product surface of parallel/pipeline.py — the analog of the
         reference's streaming DisJoinOP graph, ops/dis_join_op.cpp:26-71).
+        Extra kwargs (``suffixes``, ``algorithm`` — incl. 'pallas_pk', which
+        the shuffle co-partitions for) pass through to the per-shard join.
         Undersized capacities are detected via the overflow flag and retried
         with doubled capacities (no wrong answers, just a recompile)."""
         if on is not None:

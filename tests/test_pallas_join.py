@@ -106,6 +106,28 @@ def test_join_algorithm_pallas_pk_rejects_unsupported(ctx8, rng):
         lt2.join(lt2, on="k", how="left", algorithm="pallas_pk")
 
 
+def test_distributed_join_pallas_pk(world_ctx, rng):
+    """algorithm= flows through the distributed path: shuffle co-partitions
+    the keys, then the per-shard Pallas probe answers globally."""
+    import cylon_tpu as ct
+
+    n = 300
+    rkeys = rng.permutation(3000)[:n].astype(np.int32)
+    lkeys = rng.choice(rkeys, n).astype(np.int32)
+    lt = ct.Table.from_pydict(
+        world_ctx, {"k": lkeys, "v": rng.normal(size=n).astype(np.float32)}
+    )
+    rt = ct.Table.from_pydict(
+        world_ctx, {"k": rkeys, "w": rng.normal(size=n).astype(np.float32)}
+    )
+    got = lt.distributed_join(rt, on="k", how="inner", algorithm="pallas_pk")
+    want = lt.distributed_join(rt, on="k", how="inner")
+    assert got.row_count == want.row_count
+    g = got.to_pandas().sort_values(["k_x", "v"]).reset_index(drop=True)
+    w = want.to_pandas().sort_values(["k_x", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w, check_dtype=False, atol=1e-6)
+
+
 def test_join_config_pallas_pk_algorithm(ctx8, rng):
     import cylon_tpu as ct
     from cylon_tpu.join_config import JoinConfig
