@@ -371,6 +371,22 @@ class Table:
         return cls.from_pydict(ctx, dict(zip(names, arrays)))
 
     @classmethod
+    def from_list(
+        cls, ctx: CylonContext, names: Sequence[str], data_list: Sequence
+    ) -> "Table":
+        """Column-per-list construction (reference pycylon Table.from_list,
+        data/table.pyx:829). Values re-infer their encoding like pydict."""
+        return cls.from_pydict(
+            ctx,
+            {
+                n: np.asarray(col, dtype=object)
+                if any(isinstance(v, str) for v in col)
+                else np.asarray(col)
+                for n, col in zip(names, data_list)
+            },
+        )
+
+    @classmethod
     def from_arrow(cls, ctx: CylonContext, atable) -> "Table":
         """From a pyarrow.Table, typed (reference Table::FromArrowTable,
         table.hpp:67; arrow_builder.cpp raw-buffer ingest analog): dictionary
@@ -1289,14 +1305,22 @@ class Table:
         XLA program with static capacities and a single host sync (the
         product surface of parallel/pipeline.py — the analog of the
         reference's streaming DisJoinOP graph, ops/dis_join_op.cpp:26-71).
-        Extra kwargs (``suffixes``, ``algorithm`` — incl. 'pallas_pk', which
-        the shuffle co-partitions for) pass through to the per-shard join.
+        In EAGER mode extra kwargs (``suffixes``, ``algorithm`` — incl.
+        'pallas_pk', which the shuffle co-partitions for) pass through to
+        the per-shard join; fused mode rejects a non-default ``algorithm``
+        (its join is baked into the fused program).
         Undersized capacities are detected via the overflow flag and retried
         with doubled capacities (no wrong answers, just a recompile)."""
         if on is not None:
             kwargs["on"] = on
         kwargs.setdefault("how", how)
         if mode == "fused":
+            if kwargs.get("algorithm", "sort") not in ("sort", "hash"):
+                raise ValueError(
+                    "mode='fused' bakes the sort join into the fused "
+                    f"program; algorithm={kwargs['algorithm']!r} needs "
+                    "mode='eager'"
+                )
             return self._fused_join(other, **kwargs)
         if mode != "eager":
             raise ValueError(f"unknown join mode {mode!r}")

@@ -50,6 +50,13 @@ def test_applymap_on_strings(local_ctx):
     assert out.to_pandas()["s"].tolist() == [2, 3, 1]
 
 
+def test_from_list(local_ctx):
+    t = ct.Table.from_list(local_ctx, ["a", "s"], [[1, 2, 3], ["x", "y", "z"]])
+    df = t.to_pandas()
+    assert df["a"].tolist() == [1, 2, 3]
+    assert df["s"].tolist() == ["x", "y", "z"]
+
+
 # ----------------------------------------------------------------- minmax
 def test_minmax_matches_separate(world_ctx, rng):
     vals = rng.normal(size=301).astype(np.float32)

