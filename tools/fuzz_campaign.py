@@ -198,6 +198,21 @@ def round_once(seed) -> bool:
     if not (np.diff(got) >= 0).all():
         print(f"MISMATCH sort order params={params}", flush=True)
         ok = False
+
+    # multi-key sort with mixed directions vs pandas (nulls last, stable)
+    asc2 = bool(rng.integers(0, 2))
+    got = lt.distributed_sort(["k", "v"], ascending=[True, asc2]).to_pandas()
+    want = ldf.sort_values(
+        ["k", "v"], ascending=[True, asc2], kind="mergesort",
+        na_position="last",
+    )
+    gk = got["k"].map(canon).tolist()
+    wk = want["k"].map(canon).tolist()
+    gv = got["v"].to_numpy()
+    wv = want["v"].to_numpy()
+    if gk != wk or not np.allclose(gv, wv, rtol=1e-4, atol=1e-5):
+        print(f"MISMATCH multikey_sort params={params} asc2={asc2}", flush=True)
+        ok = False
     return ok
 
 
