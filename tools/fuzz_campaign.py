@@ -199,6 +199,42 @@ def round_once(seed) -> bool:
         print(f"MISMATCH sort order params={params}", flush=True)
         ok = False
 
+    # out-of-core join (chunked, spill, bucket pairs) vs pandas inner
+    if null_p == 0.0 and dtype in ("int32", "int64"):
+        from cylon_tpu.parallel.ooc import OutOfCoreJoin
+
+        chunk = max(int(rng.integers(8, 64)), 1)
+        nb = int(rng.choice([4, 8, 16]))
+        lo = ldf.copy()
+        ro = rdf.copy()
+        lo["k"] = lo["k"].astype(np.int64)
+        ro["k"] = ro["k"].astype(np.int64)
+        job = OutOfCoreJoin(ctx, on="k", how="inner", num_buckets=nb)
+        sink = job.execute(
+            ({c: lo[c].to_numpy()[i:i + chunk] for c in lo.columns}
+             for i in range(0, len(lo), chunk)),
+            ({c: ro[c].to_numpy()[i:i + chunk] for c in ro.columns}
+             for i in range(0, len(ro), chunk)),
+        )
+        if sink.rows != len(lo.merge(ro, on="k", how="inner")):
+            print(f"MISMATCH ooc_join params={params} chunk={chunk} nb={nb}",
+                  flush=True)
+            ok = False
+
+    # loc[list] on a (possibly duplicated) index vs pandas order/duplication
+    if null_p == 0.0:
+        ti = lt.set_index("k")
+        pdi = ldf.set_index("k")
+        labels = list(rng.choice(ldf["k"].to_numpy(), size=3, replace=True))
+        want_loc = pdi.loc[labels, "v"]
+        got_loc = ti.loc[labels].to_pandas()["v"]
+        if not np.allclose(
+            got_loc.to_numpy(), want_loc.to_numpy(), rtol=1e-4, atol=1e-5
+        ):
+            print(f"MISMATCH loc_list params={params} labels={labels}",
+                  flush=True)
+            ok = False
+
     # multi-key sort with mixed directions vs pandas (nulls last, stable)
     asc2 = bool(rng.integers(0, 2))
     got = lt.distributed_sort(["k", "v"], ascending=[True, asc2]).to_pandas()
