@@ -97,10 +97,13 @@ def check(got_df, want_df, what, params):
     return True
 
 
+MAX_N = 400
+
+
 def round_once(seed) -> bool:
     rng = np.random.default_rng(seed)
-    n_l = int(rng.integers(1, 400))
-    n_r = int(rng.integers(1, 400))
+    n_l = int(rng.integers(1, MAX_N))
+    n_r = int(rng.integers(1, MAX_N))
     keyspace = int(rng.integers(1, 40))
     dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
     null_p = float(rng.choice([0.0, 0.15, 0.4]))
@@ -256,7 +259,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
     ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--max-n", type=int, default=400,
+                    help="upper bound on random table sizes (bigger stresses "
+                         "respill/overflow/capacity-retry paths)")
     args = ap.parse_args()
+    global MAX_N
+    MAX_N = args.max_n
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
