@@ -107,15 +107,26 @@ def main():
                 [(a, None), (v, None)], [(b, None)],
                 jnp.int32(n), jnp.int32(n), _j.INNER, cap_j,
             )
-            return total
+            # checksum every output lane: returning only `total` lets XLA
+            # dead-code-eliminate the emit (gather + repeat), which is
+            # exactly the part the impl choice changes — the r03 capture
+            # showed a 0.86x "slowdown" that was a DCE artifact
+            s = jnp.float32(0)
+            for d, _v in out:
+                s = s + jnp.sum(d.astype(jnp.float32))
+            return total, s
 
         t0 = time.perf_counter()
-        tot = int(np.asarray(f(lk, rk, lv)))
+        tot, chk = f(lk, rk, lv)
+        tot = int(np.asarray(tot)); float(chk)
         compile_s = time.perf_counter() - t0
         best = float("inf")
         for _ in range(args.reps):
             t0 = time.perf_counter()
-            tot = int(np.asarray(f(lk, rk, lv)))
+            # ONE host fetch for both scalars (two sequential fetches would
+            # add a full tunnel round-trip per rep to warm_s)
+            tot, _chk = jax.device_get(f(lk, rk, lv))
+            tot = int(tot)
             best = min(best, time.perf_counter() - t0)
         print(json.dumps({
             "benchmark": f"spec_join_repeat_{impl}", "rows": 2 * n,

@@ -110,25 +110,40 @@ def _merged_counts(
     return lo, cnt, r_cnt
 
 
+def impl_tag() -> tuple:
+    """Env-selected kernel-impl choices, as a cache-key component.
+
+    ``CYLON_TPU_REPEAT_IMPL`` / ``CYLON_TPU_SEGSUM_IMPL`` are read at TRACE
+    time, so any kernel cached by an env-independent key (ctx._jit_cache via
+    engine.get_kernel) would silently keep the impl it was first compiled
+    with after a mid-process env flip. Join-family cache keys append this
+    tag so an A/B flip recompiles instead of reusing the stale program."""
+    import os
+
+    return (
+        os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter"),
+        os.environ.get("CYLON_TPU_SEGSUM_IMPL", "scatter"),
+    )
+
+
 def _repeat_ss(ends: jax.Array, cap_out: int) -> jax.Array:
     """``jnp.repeat(arange(n), counts, total_repeat_length=cap_out)``.
 
-    Default: the argsort trick — li[k] = #(ends <= k) with ends = inclusive
-    cumsum of counts; the arange queries are already sorted so their rank is
-    the identity, and one combined double-argsort replaces the repeat's
-    scatter+cumsum lowering.
+    Default: the scatter+cummax variant — row index i lands at its start
+    offset, cummax forward-fills the run. Decided on real v5e hardware by
+    benchmarks/micro_bench.py (r03, with the emit DCE-proofed): 2.4x the
+    isolated repeat and 1.11x the full 32M-row join vs the argsort trick.
 
-    ``CYLON_TPU_REPEAT_IMPL=scatter`` selects the scatter+cummax variant:
-    row index i lands at its start offset, cummax forward-fills the run. The
-    roofline model prices the two n+cap_out argsorts at ~35%% of the whole
-    16M-row join, vs one n-element scatter (~10 pass-equivalents) + a scan —
-    but round-2 measurements showed XLA TPU scatters sometimes lose to
-    sorts, so the sort stays default until benchmarks/micro_bench.py decides
-    on real hardware."""
+    ``CYLON_TPU_REPEAT_IMPL=sort`` selects the argsort trick instead —
+    li[k] = #(ends <= k) with ends = inclusive cumsum of counts; the arange
+    queries are already sorted so their rank is the identity, and one
+    combined double-argsort replaces the repeat's scatter+cumsum lowering.
+    (Kept selectable: round-2 measurements showed XLA TPU scatters can lose
+    to sorts in other fusion contexts.)"""
     import os
 
     n = ends.shape[0]
-    if os.environ.get("CYLON_TPU_REPEAT_IMPL", "sort") == "scatter":
+    if os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter") == "scatter":
         starts = jnp.concatenate([jnp.zeros((1,), ends.dtype), ends[:-1]])
         cnt = ends - starts
         rows = jnp.arange(n, dtype=jnp.int32)
@@ -593,15 +608,33 @@ def join_sum_by_key_pushdown(
 
     # segment scatter-adds into group slots; rows past group_cap drop (the
     # unclamped ng reveals the truncation to the caller)
-    tgt = jnp.where(ok_run, gid, group_cap)
+    import os
+
+    if os.environ.get("CYLON_TPU_SEGSUM_IMPL", "scatter") == "sorted":
+        # gid is monotone non-decreasing over sorted space, so the scatter
+        # indices are sorted — XLA's TPU lowering can then accumulate
+        # sequentially instead of the general scatter path. Non-group rows
+        # carry gid of the PREVIOUS group, so their contributions must be
+        # zeroed (not redirected); gid=-1 before the first group would WRAP
+        # (negative .at indices are numpy-style even under mode="drop"),
+        # breaking both the value and the sortedness claim -> clamp to 0,
+        # where the zeroed contribution is harmless. gid>=group_cap past
+        # the cap is out-of-bounds -> mode="drop".
+        tgt = jnp.maximum(gid, 0)
+        grp = ok_run
+        kw = dict(mode="drop", indices_are_sorted=True)
+    else:
+        tgt = jnp.where(ok_run, gid, group_cap)
+        grp = jnp.ones_like(ok_run)
+        kw = dict(mode="drop")
     sums = jnp.zeros((group_cap + 1,), vsafe.dtype).at[tgt].add(
-        jnp.where(is_l_live, sval, jnp.zeros_like(sval)), mode="drop"
+        jnp.where(grp & is_l_live, sval, jnp.zeros_like(sval)), **kw
     )
     cntr = jnp.zeros((group_cap + 1,), jnp.int32).at[tgt].add(
-        is_r_live.astype(jnp.int32), mode="drop"
+        (grp & is_r_live).astype(jnp.int32), **kw
     )
     cntl = jnp.zeros((group_cap + 1,), jnp.int32).at[tgt].add(
-        is_l_live.astype(jnp.int32), mode="drop"
+        (grp & is_l_live).astype(jnp.int32), **kw
     )
     s = sums[:group_cap] * cntr[:group_cap].astype(vsafe.dtype)
 

@@ -126,27 +126,15 @@ def unique_emit(
     return _emit_by_pay(keepm, spay, cap_out)
 
 
-def _two_table_keep(
+def concat_two_tables(
     l_cols: Sequence[KeyCol],
     r_cols: Sequence[KeyCol],
-    nl: jax.Array,
-    nr: jax.Array,
     cap_l: int,
     cap_r: int,
-    want_in_r,
-) -> Tuple[jax.Array, jax.Array]:
-    """(keep mask, spay) over the combined sort: keep = first live LEFT row
-    of each run whose run does (intersect) / does not (subtract) contain a
-    live right row. Lefts precede rights within a run (stable sort over the
-    [left ++ right] concatenation), so the run's first element is a left
-    whenever the run has one.
-
-    ``want_in_r`` may be a TRACED bool scalar: subtract and intersect then
-    share one compiled program (the op is data, not a compile-time constant —
-    the select is the only point where they differ)."""
-    cap = cap_l + cap_r
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    live = (idx < nl) | ((idx >= cap_l) & (idx < cap_l + nr))
+) -> List[KeyCol]:
+    """Column-wise [left ++ right] concatenation with key-dtype promotion
+    and validity merging. Row i < cap_l is left row i; row cap_l + j is
+    right row j."""
     cat_cols: List[KeyCol] = []
     for (ld, lv), (rd, rv) in zip(l_cols, r_cols):
         if ld.dtype != rd.dtype:
@@ -162,14 +150,84 @@ def _two_table_keep(
             rvm = jnp.ones((cap_r,), bool) if rv is None else rv
             valid = jnp.concatenate([lvm, rvm])
         cat_cols.append((data, valid))
+    return cat_cols
+
+
+def _two_table_sorted(
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+):
+    """One stable sort of both tables' rows by canonical key lanes.
+
+    Returns (spay, new_run, is_l_live, is_r_live, cat_cols) in sorted
+    space; spay indexes the [left ++ right] concatenation (right row j is
+    cap_l + j) and ``cat_cols`` IS that concatenation — returned so callers
+    gather from the same columns the sort keyed on (no second trace, no
+    drift). Lefts precede rights within a run (stable sort over the
+    concatenation), and dead slots sort after all live rows."""
+    cap = cap_l + cap_r
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = (idx < nl) | ((idx >= cap_l) & (idx < cap_l + nr))
+    cat_cols = concat_two_tables(l_cols, r_cols, cap_l, cap_r)
     spay, new_run = sorted_runs(canonical_row_lanes(cat_cols, live), idx)
     is_l_live = spay < nl
     is_r_live = (spay >= cap_l) & (spay < cap_l + nr)
+    return spay, new_run, is_l_live, is_r_live, cat_cols
+
+
+def _two_table_keep(
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    want_in_r,
+) -> Tuple[jax.Array, jax.Array]:
+    """(keep mask, spay) over the combined sort: keep = first live LEFT row
+    of each run whose run does (intersect) / does not (subtract) contain a
+    live right row. Lefts precede rights within a run, so the run's first
+    element is a left whenever the run has one.
+
+    ``want_in_r`` may be a TRACED bool scalar: subtract and intersect then
+    share one compiled program (the op is data, not a compile-time constant —
+    the select is the only point where they differ)."""
+    spay, new_run, is_l_live, is_r_live, _cat = _two_table_sorted(
+        l_cols, r_cols, nl, nr, cap_l, cap_r
+    )
     # keep is evaluated at run STARTS only, where count-from == run total
     r_in_run = run_count_from(new_run, is_r_live)
     hit = jnp.where(jnp.asarray(want_in_r), r_in_run > 0, r_in_run == 0)
     keepm = new_run & is_l_live & hit
     return keepm, spay
+
+
+def union_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
+    """Distinct-union emit over the shared two-table sort: keep the first
+    live element of EVERY run, whichever table it comes from.
+
+    Replaces the concat-then-unique formulation (reference Union,
+    table.cpp:531-603 dedups the concatenation the same way): the concat
+    never materializes as a table — one program sorts both inputs' key
+    lanes and emits combined row indices (i < cap_l → left row i, else
+    right row i - cap_l). Because all lefts precede all rights in the
+    concatenation and the sort is stable, the run's first element is
+    exactly the first occurrence in concat order, and ascending-spay
+    emission (:func:`_emit_by_pay`) reproduces concat+unique keep='first'
+    output order.
+
+    Returns (idx, total, cat_cols): ``idx`` indexes ``cat_cols``, the
+    [left ++ right] concatenation the sort itself keyed on."""
+    spay, new_run, is_l_live, is_r_live, cat_cols = _two_table_sorted(
+        l_cols, r_cols, nl, nr, cap_l, cap_r
+    )
+    keepm = new_run & (is_l_live | is_r_live)
+    idx, total = _emit_by_pay(keepm, spay, cap_out)
+    return idx, total, cat_cols
 
 
 def setop_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out, want_in_r):

@@ -1087,7 +1087,9 @@ class Table:
         rflat = right._flat_cols()
         lk_idx = tuple(left.column_names.index(n) for n in l_names)
         rk_idx = tuple(right.column_names.index(n) for n in r_names)
-        key = ("join", howi, lk_idx, rk_idx, len(lflat), len(rflat))
+        key = (
+            "join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
+        ) + _j.impl_tag()
 
         # Speculative single-dispatch path: fuse probe+count+emit into ONE
         # program with a capacity-factor output (cap_l+cap_r covers every
@@ -1377,7 +1379,7 @@ class Table:
             key = (
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
                 bucket_cap, join_cap, respill,
-            )
+            ) + _j.impl_tag()
             cache = ctx.__dict__.setdefault("_jit_cache", {})
             step = cache.get(key)
             if step is None:
@@ -1443,10 +1445,51 @@ class Table:
         return _unify_dict_pair(self, other, self.column_names, other.column_names)
 
     def union(self, other: "Table") -> "Table":
-        """Distinct union (reference Union, table.cpp:531-603):
-        concat + dedup."""
+        """Distinct union (reference Union, table.cpp:531-603).
+
+        One program (setops.union_emit): the concat never materializes —
+        both tables' rows go through a single shared sort and the keepers
+        are gathered straight out of a lane-packed [left ++ right] matrix.
+        Same sorted-space design as subtract/intersect, but the output can
+        draw from BOTH tables so cap_out = cap_l + cap_r and the program is
+        its own cache entry."""
         a, b = self._setop_pair(other)
-        return _concat_tables([a, b]).unique()
+        if any(
+            ca.dtype != cb.dtype
+            for ca, cb in zip(a._columns.values(), b._columns.values())
+        ):
+            # mixed-dtype schemas need _concat2's per-column promotion of
+            # the RESULT dtype; keep the concat+unique path for that edge
+            return _concat_tables([a, b]).unique()
+        lflat = a._flat_cols()
+        rflat = b._flat_cols()
+        nc = len(lflat)
+        # exact static bound: every input row could survive the dedup
+        cap_out = a.shard_cap + b.shard_cap
+        key = ("setop_union", nc, cap_out)
+
+        def build_emit():
+            def kern(dp, rep):
+                (lk, rk, nl, nr) = dp
+                cap_l = lk[0][0].shape[0]
+                cap_r = rk[0][0].shape[0]
+                idx, total, cat = _s.union_emit(
+                    lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out
+                )
+                out, _ = _g_pack.pack_gather(cat, idx)
+                return out, _scalar(total)
+
+            return kern
+
+        with span("setop.union", rows=int(self.row_count)):
+            out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+                (lflat, rflat, a.counts_dev, b.counts_dev), ()
+            )
+            counts = self._out_counts(nout)  # the ONE host sync
+        res = a._rebuild_cols(
+            list(zip(a.column_names, a._columns.values())), out, counts, cap_out
+        )
+        return res._maybe_compact(counts)
 
     def subtract(self, other: "Table") -> "Table":
         """Distinct rows of self not in other (reference Subtract,
