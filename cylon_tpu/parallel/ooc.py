@@ -53,10 +53,24 @@ class SpillPartitionOp(Op):
 
     def process(self, chunk: Table, edge: int) -> None:
         self.max_device_cap = max(self.max_device_cap, chunk.shard_cap)
-        parts = chunk.hash_partition(self.keys, self.k)
-        for p, t in parts.items():
-            if t.row_count:
-                self.spill[p].append(t.to_pydict())
+        # ONE packing kernel + one fetch per column lane (Table.bucket_pack
+        # + to_pydict), then slice buckets out of the packed host copy — K
+        # filter kernels + K count syncs + K x C per-bucket fetches made
+        # device round-trips the dominant spill cost on a remote-attached
+        # TPU (16 chunks x 16 buckets: 30.5 s vs 241.7 s measured)
+        packed, bc = chunk.bucket_pack(self.keys, self.k)
+        host = packed.to_pydict()
+        names = list(host.keys())
+        shard_rows = packed.row_counts
+        shard_base = np.concatenate([[0], np.cumsum(shard_rows)])
+        for s in range(bc.shape[0]):
+            offs = shard_base[s] + np.concatenate([[0], np.cumsum(bc[s])])
+            for p in range(self.k):
+                lo, hi = int(offs[p]), int(offs[p + 1])
+                if hi > lo:
+                    self.spill[p].append(
+                        {n: host[n][lo:hi] for n in names}
+                    )
         return None
 
 

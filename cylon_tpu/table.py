@@ -1041,6 +1041,65 @@ class Table:
             out[p] = self.filter(pid == p)
         return out
 
+    def bucket_pack(
+        self, hash_columns: Sequence[Union[str, int]], num_partitions: int
+    ) -> Tuple["Table", np.ndarray]:
+        """Pack rows into contiguous hash-bucket order in ONE program.
+
+        Returns (packed table, bucket counts [shards, k]): rows of bucket p
+        occupy the half-open slice [offsets[p], offsets[p+1]) of each
+        shard's live prefix, offsets = cumsum of that shard's counts row.
+        The spill path of the out-of-core join (parallel/ooc.py) uses this
+        instead of :meth:`hash_partition`: one stable key sort by bucket id
+        (payload columns riding the sort) + one fetch per column lane (+
+        one for the counts) replaces K filter kernels, K count syncs, and
+        K x C per-bucket column fetches — through a remote-attached device
+        the round-trips WERE the spill cost (measured 7.9x on the 16-chunk
+        ooc bench). Same bucket assignment as every shuffle (vectorized
+        murmur3), so packs are consistent across chunks and across the two
+        inputs."""
+        names = self._resolve_cols(hash_columns)
+        kflat = tuple(self._key_hash_cols(names))
+        flat = self._flat_cols()
+        k = int(num_partitions)
+        key = ("bucket_pack", tuple(names), k, len(flat))
+
+        def build():
+            def kern(dp, rep):
+                (kc, cols, counts) = dp
+                n = counts[0]
+                cap = cols[0][0].shape[0]
+                # padding rows already map to bucket k (partition.py:32)
+                pid = _p.hash_partition_ids(kc, n, k).astype(jnp.int32)
+                bcounts = (
+                    jnp.zeros((k + 1,), jnp.int32).at[pid].add(1, mode="drop")
+                )[:k]
+                ride, payloads, heavy = _sort_mod.split_ride_cols(cols)
+                order, spays = _sort_mod.lexsort_rows_payload(
+                    [(pid, None)], n, cap, payloads
+                )
+                heavy_out = (
+                    _g_pack.pack_gather(heavy, order)[0] if heavy else []
+                )
+                out = _sort_mod.merge_ride_cols(cols, ride, spays, heavy_out)
+                return out, bcounts
+
+            return kern
+
+        with span("bucket_pack", rows=int(self.row_count)):
+            out, bcounts = get_kernel(self.ctx, key, build)(
+                (kflat, flat, self.counts_dev), ()
+            )
+            bump("host_sync")
+            bc = _fetch(bcounts).reshape(self.world_size, k).astype(np.int64)
+        tbl = self._rebuild_cols(
+            list(zip(self.column_names, self._columns.values())),
+            out,
+            self._row_counts,
+            self._shard_cap,
+        )
+        return tbl, bc
+
     # ------------------------------------------------------------------
     # join
     # ------------------------------------------------------------------
@@ -1450,46 +1509,10 @@ class Table:
         One program (setops.union_emit): the concat never materializes —
         both tables' rows go through a single shared sort and the keepers
         are gathered straight out of a lane-packed [left ++ right] matrix.
-        Same sorted-space design as subtract/intersect, but the output can
-        draw from BOTH tables so cap_out = cap_l + cap_r and the program is
-        its own cache entry."""
-        a, b = self._setop_pair(other)
-        if any(
-            ca.dtype != cb.dtype
-            for ca, cb in zip(a._columns.values(), b._columns.values())
-        ):
-            # mixed-dtype schemas need _concat2's per-column promotion of
-            # the RESULT dtype; keep the concat+unique path for that edge
-            return _concat_tables([a, b]).unique()
-        lflat = a._flat_cols()
-        rflat = b._flat_cols()
-        nc = len(lflat)
-        # exact static bound: every input row could survive the dedup
-        cap_out = a.shard_cap + b.shard_cap
-        key = ("setop_union", nc, cap_out)
-
-        def build_emit():
-            def kern(dp, rep):
-                (lk, rk, nl, nr) = dp
-                cap_l = lk[0][0].shape[0]
-                cap_r = rk[0][0].shape[0]
-                idx, total, cat = _s.union_emit(
-                    lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out
-                )
-                out, _ = _g_pack.pack_gather(cat, idx)
-                return out, _scalar(total)
-
-            return kern
-
-        with span("setop.union", rows=int(self.row_count)):
-            out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-                (lflat, rflat, a.counts_dev, b.counts_dev), ()
-            )
-            counts = self._out_counts(nout)  # the ONE host sync
-        res = a._rebuild_cols(
-            list(zip(a.column_names, a._columns.values())), out, counts, cap_out
-        )
-        return res._maybe_compact(counts)
+        Same sorted-space design (and code path) as subtract/intersect,
+        but the output can draw from BOTH tables so cap_out = cap_l +
+        cap_r and the program is its own cache entry."""
+        return self._two_table_setop(other, "union")
 
     def subtract(self, other: "Table") -> "Table":
         """Distinct rows of self not in other (reference Subtract,
@@ -1502,39 +1525,58 @@ class Table:
         return self._two_table_setop(other, "intersect")
 
     def _two_table_setop(self, other: "Table", op: str) -> "Table":
+        """Shared single-dispatch emit for union/subtract/intersect.
+
+        Single-dispatch: the output is a subset of the input rows, so
+        cap_out is a static exact upper bound (left cap for subtract/
+        intersect, cap_l + cap_r for union) — no count phase, no overflow
+        possible, ONE host sync (the join speculative design, but with
+        speculation that can never miss). A selective result is compacted
+        after the fact like the join's. Subtract and intersect share ONE
+        program: the op rides in as a replicated traced scalar
+        (setops.setop_emit), not a cache key; union's differing cap_out
+        and two-source gather make it its own program."""
         a, b = self._setop_pair(other)
+        is_union = op == "union"
+        if is_union and any(
+            ca.dtype != cb.dtype
+            for ca, cb in zip(a._columns.values(), b._columns.values())
+        ):
+            # mixed-dtype schemas need _concat2's per-column promotion of
+            # the RESULT dtype; keep the concat+unique path for that edge
+            return _concat_tables([a, b]).unique()
         lflat = a._flat_cols()
         rflat = b._flat_cols()
         nc = len(lflat)
 
-        # Single-dispatch: the output is a subset of the LEFT rows, so
-        # cap_out = a.shard_cap is a static exact upper bound — no count
-        # phase, no overflow possible, ONE host sync (the join speculative
-        # design, but with speculation that can never miss). A selective
-        # result is compacted after the fact like the join's.
-        cap_out = a.shard_cap
-        # subtract and intersect share ONE program: the op rides in as a
-        # replicated traced scalar (setops.setop_emit), not a cache key
-        key = ("setop2", nc, cap_out)  # cap_out is a closure constant
+        cap_out = a.shard_cap + b.shard_cap if is_union else a.shard_cap
+        key = ("setop_union" if is_union else "setop2", nc, cap_out)
 
         def build_emit():
             def kern(dp, rep):
                 (lk, rk, nl, nr) = dp
-                (want_in_r,) = rep
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
-                idx, total = _s.setop_emit(
-                    lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out, want_in_r
-                )
-                out, _ = _g_pack.pack_gather(list(lk), idx)
+                if is_union:
+                    idx, total, src = _s.union_emit(
+                        lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out
+                    )
+                else:
+                    (want_in_r,) = rep
+                    idx, total = _s.setop_emit(
+                        lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out,
+                        want_in_r,
+                    )
+                    src = list(lk)
+                out, _ = _g_pack.pack_gather(src, idx)
                 return out, _scalar(total)
 
             return kern
 
+        rep = () if is_union else (jnp.asarray(op == "intersect"),)
         with span(f"setop.{op}", rows=int(self.row_count)):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-                (lflat, rflat, a.counts_dev, b.counts_dev),
-                (jnp.asarray(op == "intersect"),),
+                (lflat, rflat, a.counts_dev, b.counts_dev), rep
             )
             counts = self._out_counts(nout)  # the ONE host sync
         res = a._rebuild_cols(
