@@ -59,25 +59,34 @@ def get_kernel(
     key: Tuple,
     builder: Callable[[], Callable],
     check_vma: bool = True,
+    use_shard_map: bool = True,
 ) -> Callable:
     """Fetch (or build+jit) the shard_map-wrapped kernel for this context.
 
     ``check_vma=False`` disables shard_map's varying-mesh-axes checker —
     needed by kernels embedding ``pallas_call`` (its output vma interplay
-    with unvarying iotas trips the checker)."""
+    with unvarying iotas trips the checker).
+
+    ``use_shard_map=False`` jits the kernel directly (caller guarantees a
+    1-device mesh, where shard_map is a no-op): compiled ``pallas_call``
+    under jit(shard_map) hits an unbounded-recursion jax bug on TPU.
+    Caching and kernel recording behave identically either way."""
     cache = ctx.__dict__.setdefault("_jit_cache", {})
     fn = cache.get(key)
     if fn is None:
         kernel = builder()
-        fn = jax.jit(
-            jax.shard_map(
-                kernel,
-                mesh=ctx.mesh,
-                in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
-                out_specs=PartitionSpec(ctx.axis_name),
-                check_vma=check_vma,
+        if use_shard_map:
+            fn = jax.jit(
+                jax.shard_map(
+                    kernel,
+                    mesh=ctx.mesh,
+                    in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
+                    out_specs=PartitionSpec(ctx.axis_name),
+                    check_vma=check_vma,
+                )
             )
-        )
+        else:
+            fn = jax.jit(kernel)
         cache[key] = fn
     if _KERNEL_RECORD is None:
         return fn

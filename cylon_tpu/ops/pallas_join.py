@@ -79,19 +79,26 @@ def _bucket_layout(
     return out_keys, out_idx, overflow
 
 
-def _probe_block(lk_ref, rk_ref, ridx_ref, out_ref):
-    """One bucket: [B] left keys vs [B] right keys -> matched right row id
-    per left slot (-1 = no match). Right keys are unique, so max over the
-    masked ids IS the unique match."""
-    lk = lk_ref[...]
-    rk = rk_ref[...]
-    ridx = ridx_ref[...]
-    eq = lk[:, None] == rk[None, :]  # [B, B] VMEM
-    live_r = ridx[None, :] >= 0
-    hit = eq & live_r
-    # matched id + 1 so "no match" reduces to 0 -> -1 after the shift
-    cand = jnp.where(hit, ridx[None, :] + 1, 0)
-    out_ref[...] = jnp.max(cand, axis=1) - 1
+def _probe_block(lk_ref, rk_ref, ridx_ref, out_ref, *, G: int):
+    """G buckets per program, one [B] x [B] broadcast-compare per bucket
+    (statically unrolled — Mosaic lowers 1-D -> 2-D broadcasts and 2-D
+    reductions natively; a fused [G, B, B] formulation hits 'unsupported
+    shape cast'). Right keys are unique, so max over the masked ids IS the
+    unique match; -1 = no match."""
+    one = jnp.int32(1)
+    zero = jnp.int32(0)
+    for g in range(G):
+        lk = lk_ref[g, :]
+        rk = rk_ref[g, :]
+        ridx = ridx_ref[g, :]
+        eq = lk[:, None] == rk[None, :]  # [B, B] VMEM
+        live_r = ridx[None, :] >= zero
+        # matched id + 1 so "no match" reduces to 0 -> -1 after the shift.
+        # Constants are EXPLICIT int32: weak-typed python ints under
+        # jax_enable_x64 send the pallas-ref promotion machinery into
+        # unbounded recursion at trace time (RecursionError)
+        cand = jnp.where(eq & live_r, ridx[None, :] + one, zero)
+        out_ref[g, :] = jnp.max(cand, axis=1) - one
 
 
 @functools.partial(jax.jit, static_argnames=("nb", "B", "interpret"))
@@ -105,23 +112,35 @@ def _pallas_probe(
 ) -> jax.Array:
     if pl is None:  # pragma: no cover
         raise RuntimeError("pallas unavailable")
-    grid = (nb,)
-    spec = pl.BlockSpec((B,), lambda b: (b,))
+    # 2-D [nb, B] layout: an (8, B) block satisfies Mosaic's (8, 128)
+    # divisibility for s32 (B < 128 still works: the block's last dim then
+    # EQUALS the array's). G=8 buckets per program amortizes grid overhead.
+    import numpy as np
+
+    G = max(1, min(nb, 8))
+    grid = (nb // G,)
+    # np.int32(0): a weak python 0 becomes i64 under jax_enable_x64 and
+    # Mosaic then fails to legalize the index-map's func.return
+    spec = pl.BlockSpec((G, B), lambda b: (b, np.int32(0)))
+    lk2 = lkeys_b.reshape(nb, B)
+    rk2 = rkeys_b.reshape(nb, B)
+    ri2 = ridx_b.reshape(nb, B)
     try:
         # under shard_map with vma checking, the output must declare how it
         # varies across mesh axes: same as the (per-shard) inputs
         vma = jax.typeof(lkeys_b).vma
-        out_shape = jax.ShapeDtypeStruct((nb * B,), jnp.int32, vma=vma)
+        out_shape = jax.ShapeDtypeStruct((nb, B), jnp.int32, vma=vma)
     except (AttributeError, TypeError):
-        out_shape = jax.ShapeDtypeStruct((nb * B,), jnp.int32)
-    return pl.pallas_call(
-        _probe_block,
+        out_shape = jax.ShapeDtypeStruct((nb, B), jnp.int32)
+    out = pl.pallas_call(
+        functools.partial(_probe_block, G=G),
         grid=grid,
         in_specs=[spec, spec, spec],
         out_specs=spec,
         out_shape=out_shape,
         interpret=interpret,
-    )(lkeys_b, rkeys_b, ridx_b)
+    )(lk2, rk2, ri2)
+    return out.reshape(nb * B)
 
 
 def pk_inner_join(

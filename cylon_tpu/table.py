@@ -1321,12 +1321,30 @@ class Table:
 
             return kern
 
-        with span("join.pallas_pk", rows=int(self.row_count)):
-            # check_vma=False: pallas_call output vma interplay with
-            # unvarying iotas trips shard_map's checker (jax limitation)
-            out, stats = get_kernel(self.ctx, key, build, check_vma=False)(
-                (lk, rk, lflat, rflat, left.counts_dev, right.counts_dev), ()
+        if self.ctx.world_size > 1 and not interp:
+            # compiled (non-interpret) pallas_call under jit(shard_map) hits
+            # an unbounded-recursion jax bug on TPU; on a multi-chip
+            # accelerator mesh the hint path cannot run, so take the exact
+            # sort join directly (same result, just no speculation)
+            return self.join(
+                other,
+                on=l_names if l_names == r_names else None,
+                left_on=l_names if l_names != r_names else None,
+                right_on=r_names if l_names != r_names else None,
+                how=how,
+                suffixes=suffixes,
             )
+        with span("join.pallas_pk", rows=int(self.row_count)):
+            args = (lk, rk, lflat, rflat, left.counts_dev, right.counts_dev)
+            # world==1: shard_map is a no-op AND its compiled-pallas
+            # recursion bug is avoided (use_shard_map=False). Multi-device
+            # reaches here only in interpret mode (CPU mesh), which traces
+            # clean; check_vma=False because pallas_call output vma
+            # interplay with unvarying iotas trips shard_map's checker
+            out, stats = get_kernel(
+                self.ctx, key, build, check_vma=False,
+                use_shard_map=self.ctx.world_size > 1,
+            )(args, ())
             bump("host_sync")
             stats = _fetch(stats).reshape(-1, 2)  # the ONE host sync
         if int(stats[:, 1].sum()) != 0:
