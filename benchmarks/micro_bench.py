@@ -146,6 +146,47 @@ def main():
         "join_speedup_scatter": round(js / jsc, 2),
     }), flush=True)
 
+    # -- segment-sum impl for join_sum_by_key_pushdown (the q3-fused core;
+    # its three scatter-adds are the suspected cause of the measured-vs-
+    # model gap: warm 0.51 s vs model 0.05 s at 8M input rows) --
+    def run_pushdown(impl):
+        os.environ["CYLON_TPU_SEGSUM_IMPL"] = impl
+        group_cap = 1 << (n - 1).bit_length()
+
+        # fresh jit per impl: the env is read at trace time
+        @jax.jit
+        def f(a, b, v):
+            s, ng, nj, ovg = _j.join_sum_by_key_pushdown(
+                [(a, None)], [(b, None)], (v, None),
+                jnp.int32(n), jnp.int32(n), group_cap,
+            )
+            return jnp.sum(s), ng, nj
+
+        t0 = time.perf_counter()
+        tot, ng, nj = jax.device_get(f(lk, rk, lv))
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            tot, ng, nj = jax.device_get(f(lk, rk, lv))
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "benchmark": f"pushdown_segsum_{impl}", "rows": 2 * n,
+            "platform": platform, "warm_s": round(best, 4),
+            "compile_s": round(compile_s, 2), "groups": int(ng),
+            "join_rows": int(nj), "sum": float(tot),
+        }), flush=True)
+        return best, (int(ng), int(nj))
+
+    ps, pcs = run_pushdown("scatter")
+    pss, pcss = run_pushdown("sorted")
+    assert pcs == pcss, (pcs, pcss)
+    os.environ.pop("CYLON_TPU_SEGSUM_IMPL", None)
+    print(json.dumps({
+        "verdict_segsum": "sorted" if pss < ps else "scatter",
+        "pushdown_speedup_sorted": round(ps / pss, 2),
+    }), flush=True)
+
 
 if __name__ == "__main__":
     main()

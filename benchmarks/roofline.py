@@ -6,10 +6,11 @@ Model
 -----
 TPU XLA ``sort`` is a bitonic sorting network: ``P(n) = k*(k+1)/2`` passes
 for ``k = ceil(log2 n)``, each pass streaming every operand lane once.
-Gathers/scatters pay per element (measured round 2: ~25-36 ms for a 4M f32
-random gather ≈ 10x a sequential pass), modeled as ``GATHER_PASS_EQ``
-sequential-pass equivalents per lane. Everything elementwise fuses into
-one read + one write pass (XLA fusion).
+Gathers/scatters pay PER ELEMENT (~4-9 ns each on v5e at the narrow row
+widths the packed codec uses — measured round 3 via the join stage
+profile), modeled as ``GATHER_PASS_EQ`` sequential-pass equivalents per
+operand byte. Everything elementwise fuses into one read + one write pass
+(XLA fusion).
 
 The op's **model time** is total modeled traffic / peak HBM bandwidth; the
 **%membw** column of BENCH_TPU.md is ``model_time / measured_time`` — the
@@ -39,9 +40,17 @@ import numpy as np
 
 # v5e (tpu v5 litepod) peak HBM bandwidth, GB/s. Override per device.
 HBM_GBPS_DEFAULT = 819.0
-# measured (round 2, scan-slope method): random 4M-row gather ~25-36 ms vs
-# ~2.4 ms for a sequential pass of the same bytes -> ~10 pass-equivalents
-GATHER_PASS_EQ = 10.0
+# Re-calibrated round 3 on the live chip (benchmarks/profile_join_pieces.py
+# stage deltas at 16M rows): the join's packed left gather measured 291 ms
+# for ~600 MB of in+out operand bytes -> 291ms * 819GB/s / 600MB ~= 400
+# pass-equivalents; the repeat scatter gives ~500 by the same arithmetic.
+# (Round 2's "~10x a sequential pass" compared against an eager-fence
+# "sequential pass" that was mostly dispatch latency — off by ~40x.)
+# Per-element engines on this chip cost ~4-9 ns/element regardless of row
+# width at narrow rows, so this UNDERSTATES wide-row gathers' efficiency;
+# treat gather/scatter-heavy model times as a calibrated cost model, not a
+# bandwidth bound — the byte-vs-element gap IS the Pallas-gather prize.
+GATHER_PASS_EQ = 400.0
 
 _SORT_PRIMS = {"sort"}
 _GATHER_PRIMS = {"gather", "dynamic_slice", "take"}

@@ -117,7 +117,12 @@ def _pallas_probe(
     # EQUALS the array's). G=8 buckets per program amortizes grid overhead.
     import numpy as np
 
-    G = max(1, min(nb, 8))
+    # G must DIVIDE nb or the trailing buckets would silently never be
+    # probed (wrong results with bad=0); default sizing gives power-of-2
+    # nb, but nb is a public parameter
+    G = 1
+    while G < 8 and nb % (G * 2) == 0:
+        G *= 2
     grid = (nb // G,)
     # np.int32(0): a weak python 0 becomes i64 under jax_enable_x64 and
     # Mosaic then fails to legalize the index-map's func.return

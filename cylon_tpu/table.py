@@ -1282,6 +1282,23 @@ class Table:
         spec_join, never a wrong answer."""
         if how != "inner":
             raise ValueError("algorithm='pallas_pk' supports how='inner' only")
+        if (
+            self.ctx.world_size > 1
+            and self.ctx.mesh.devices.flat[0].platform != "cpu"
+        ):
+            # compiled (non-interpret) pallas_call under jit(shard_map) hits
+            # an unbounded-recursion jax bug on TPU; on a multi-chip
+            # accelerator mesh the hint path cannot run, so take the exact
+            # sort join directly (same result, just no speculation) BEFORE
+            # paying dictionary unification / key promotion / flattening
+            return self.join(
+                other,
+                on=l_names if l_names == r_names else None,
+                left_on=l_names if l_names != r_names else None,
+                right_on=r_names if l_names != r_names else None,
+                how=how,
+                suffixes=suffixes,
+            )
         left, right = _unify_dict_pair(self, other, l_names, r_names)
         left, right = _promote_key_pair(left, right, l_names, r_names)
         lk = left._flat_cols(l_names)
@@ -1321,19 +1338,6 @@ class Table:
 
             return kern
 
-        if self.ctx.world_size > 1 and not interp:
-            # compiled (non-interpret) pallas_call under jit(shard_map) hits
-            # an unbounded-recursion jax bug on TPU; on a multi-chip
-            # accelerator mesh the hint path cannot run, so take the exact
-            # sort join directly (same result, just no speculation)
-            return self.join(
-                other,
-                on=l_names if l_names == r_names else None,
-                left_on=l_names if l_names != r_names else None,
-                right_on=r_names if l_names != r_names else None,
-                how=how,
-                suffixes=suffixes,
-            )
         with span("join.pallas_pk", rows=int(self.row_count)):
             args = (lk, rk, lflat, rflat, left.counts_dev, right.counts_dev)
             # world==1: shard_map is a no-op AND its compiled-pallas

@@ -157,3 +157,29 @@ def test_pk_join_partial_live_counts():
     assert int(total) == 2  # 5 and 7 match; padding 99s must not
     pairs = set(zip(np.asarray(l_idx)[:2].tolist(), np.asarray(r_idx)[:2].tolist()))
     assert pairs == {(0, 1), (2, 0)}
+
+
+def test_pk_join_nb_not_multiple_of_block_group(rng):
+    """Public nb values that are NOT multiples of the per-program bucket
+    group G must still probe every bucket (the grid is nb // G with G a
+    DIVISOR of nb; a truncating nb // 8 once silently skipped the trailing
+    buckets and emitted wrong rows with bad=0)."""
+    n = 5000
+    lk = jnp.asarray(rng.permutation(4 * n)[:n].astype(np.int32))
+    rk = jnp.asarray(np.arange(2 * n, dtype=np.int32))
+    lkn, rkn = np.asarray(lk), np.asarray(rk)
+    exp = int(np.isin(lkn, rkn).sum())
+    checked = []
+    for nb in (12, 6, 3, 16, 8, 2):
+        li, ri, tot, bad = pk_inner_join(
+            lk, rk, jnp.int32(n), jnp.int32(2 * n),
+            nb=nb, B=8192, interpret=True,
+        )
+        if int(bad):
+            continue  # overflow correctly flagged -> caller falls back
+        lv, rv = np.asarray(li), np.asarray(ri)
+        m = lv >= 0
+        assert int(tot) == exp == m.sum(), (nb, int(tot), exp)
+        assert (lkn[lv[m]] == rkn[rv[m]]).all(), nb
+        checked.append(nb)
+    assert len(checked) >= 4, checked
