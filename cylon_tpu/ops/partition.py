@@ -27,10 +27,21 @@ from .sort import KeyCol, wide_float, wide_int
 
 
 def hash_partition_ids(
-    key_cols: Sequence[KeyCol], n: jax.Array, num_partitions: int
+    key_cols: Sequence[KeyCol], n: jax.Array, num_partitions: int,
+    hash_shift: int = 0,
 ) -> jax.Array:
-    """Target partition per row (uint32 hash mod P); padding rows -> P."""
+    """Target partition per row (uint32 hash mod P); padding rows -> P.
+
+    ``hash_shift`` consumes DIFFERENT hash bits (h >> shift) so that two
+    nested partitionings of the same keys stay independent: the out-of-core
+    join buckets on the high bits (shift=16) precisely because each
+    bucket-pair join re-partitions on the low bits for its mesh shuffle —
+    with the same bits, every row of bucket b would land on shard
+    b mod world and the "distributed" bucket join would degenerate to one
+    device (observed: 16384-cap output shards from 512-cap inputs)."""
     h = hash_columns(key_cols)
+    if hash_shift:
+        h = h >> np.uint32(hash_shift)
     cap = h.shape[0]
     if num_partitions & (num_partitions - 1) == 0:
         pid = (h & np.uint32(num_partitions - 1)).astype(jnp.int32)

@@ -20,10 +20,12 @@ the problem instead of streaming bytes: a **Grace-style partitioned join**.
 - results leave the device through a chunked host sink, never concatenated
   on device.
 
-Device memory is bounded by max(chunk, 2 x bucket-pair + join
-intermediates), never by table size: with K buckets a table of N rows
-needs ~4N/K device rows at the join stage, so any table fits by raising
-K.
+Device memory is bounded by max(chunk, 2 x bucket-pair + 1 result table
++ join intermediates), never by table size: with K buckets a table of N
+rows needs ~4N/K input device rows (+ one bucket-join's output) at the
+join stage, so any table fits by raising K. Result tables do NOT
+accumulate: each bucket's result is drained to the host sink before the
+next join.
 """
 from __future__ import annotations
 
@@ -61,7 +63,10 @@ class SpillPartitionOp(Op):
         # filter kernels + K count syncs + K x C per-bucket fetches made
         # device round-trips the dominant spill cost on a remote-attached
         # TPU (16 chunks x 16 buckets: 30.5 s vs 241.7 s measured)
-        packed, bc = chunk.bucket_pack(self.keys, self.k)
+        # hash_shift=16: buckets use HIGH murmur bits so the bucket-pair
+        # join's own low-bit mesh shuffle still spreads each bucket across
+        # all shards (same bits would pin bucket b to shard b mod world)
+        packed, bc = chunk.bucket_pack(self.keys, self.k, hash_shift=16)
         host = packed.to_pydict()
         names = list(host.keys())
         shard_rows = packed.row_counts
@@ -113,32 +118,52 @@ class BucketJoinOp(Op):
         rt = Table.from_pydict(self.ctx, _host_concat(rparts))
         return lt, rt
 
+    def _drain_children(self) -> None:
+        """Run queued downstream quanta (the HostSink fetch) NOW, so result
+        tables leave the device per bucket instead of accumulating in the
+        child queue until finalize returns."""
+        for child in self.children:
+            while child.execute_one():
+                pass
+
     def on_finalize(self) -> Optional[Table]:
         k = self.left_spill.k
         # one-ahead prefetch: pair b+1's host->device uploads are dispatched
         # BEFORE pair b's join blocks on its count fetch, so the transfer
-        # rides under the sync instead of after it. Device residency bound
-        # becomes TWO bucket pairs (+ join intermediates) — still ~total/K,
-        # the out-of-core guarantee, just double-buffered.
+        # rides under the sync instead of after it. Device residency bound:
+        # TWO bucket pairs + ONE result table (+ join intermediates) —
+        # still ~total/K, the out-of-core guarantee, just double-buffered.
+        # Consumed refs are del'd before the next staging so no stale local
+        # pins a third pair.
         staged = self._stage_pair(0) if k else None
         for b in range(k):
             cur = staged
             staged = self._stage_pair(b + 1) if b + 1 < k else None
+            # previous bucket's emitted result rides down to the host sink
+            # while pair b+1's uploads are in flight
+            self._drain_children()
+            # spilled buckets are consumed; free the host arena as we go
+            self.left_spill.spill[b] = []
+            self.right_spill.spill[b] = []
             # observability: CONCURRENT device rows (current + prefetched
             # pair), not just the largest single table — this is the number
             # the out-of-core guarantee is stated against
             resident = sum(
                 t.shard_cap for pair in (cur, staged) if pair for t in pair
             )
-            self.max_device_cap = max(self.max_device_cap, resident)
-            # spilled buckets are consumed; free the host arena as we go
-            self.left_spill.spill[b] = []
-            self.right_spill.spill[b] = []
             if cur is None:
+                self.max_device_cap = max(self.max_device_cap, resident)
                 continue
             lt, rt = cur
+            del cur
             out = lt.distributed_join(rt, **self.join_kwargs)
+            del lt, rt
+            self.max_device_cap = max(
+                self.max_device_cap, resident + out.shard_cap
+            )
             self._emit(out)
+            del out
+        self._drain_children()
         return None
 
 

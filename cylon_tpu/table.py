@@ -1042,7 +1042,10 @@ class Table:
         return out
 
     def bucket_pack(
-        self, hash_columns: Sequence[Union[str, int]], num_partitions: int
+        self,
+        hash_columns: Sequence[Union[str, int]],
+        num_partitions: int,
+        hash_shift: int = 0,
     ) -> Tuple["Table", np.ndarray]:
         """Pack rows into contiguous hash-bucket order in ONE program.
 
@@ -1062,15 +1065,18 @@ class Table:
         kflat = tuple(self._key_hash_cols(names))
         flat = self._flat_cols()
         k = int(num_partitions)
-        key = ("bucket_pack", tuple(names), k, len(flat))
+        key = ("bucket_pack", tuple(names), k, len(flat), hash_shift)
 
         def build():
             def kern(dp, rep):
                 (kc, cols, counts) = dp
                 n = counts[0]
                 cap = cols[0][0].shape[0]
-                # padding rows already map to bucket k (partition.py:32)
-                pid = _p.hash_partition_ids(kc, n, k).astype(jnp.int32)
+                # padding rows already map to bucket k (partition.py); the
+                # shift keeps bucket bits independent of shuffle bits
+                pid = _p.hash_partition_ids(
+                    kc, n, k, hash_shift=hash_shift
+                ).astype(jnp.int32)
                 bcounts = (
                     jnp.zeros((k + 1,), jnp.int32).at[pid].add(1, mode="drop")
                 )[:k]
