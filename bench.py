@@ -184,12 +184,19 @@ def run_child_tpu(timeout_s: float) -> bool:
 
 
 def main():
-    # 16M rows/table: the per-dispatch + host-sync overhead (~120 ms via the
-    # remote tunnel) is ~45% of warm time at 4M rows; at 16M the kernel
-    # dominates and the measured rate approaches the device rate. Fits v5e
-    # HBM with ~6x headroom (sort intermediates included).
-    n = int(os.environ.get("BENCH_ROWS", 16_000_000))
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    # 8M rows/table (16M input rows/join): the measured sweet spot on v5
+    # lite with the jitted fence — r3 live bench.py captures: 28.8M rows/s
+    # = 10.19x at 8M/side (the "metric"-keyed line in BENCH_TPU_r03.jsonl,
+    # rows=8000000 PER SIDE) vs 28.3M = 10.0x at 16M/side
+    # (BENCH_TPU_attempt.json). Larger sizes lose a little to emit-gather
+    # growth, smaller ones to the 2 fetch round-trips. NOTE on "rows"
+    # semantics: bench.py JSON records rows PER SIDE; run_bench.py's
+    # "benchmark"-keyed lines record TOTAL input rows (2x per side). Fits
+    # v5e HBM with wide headroom (sort intermediates included). Best-of-5:
+    # the tunnel adds occasional multi-100ms latency spikes and the
+    # driver's capture is one-shot.
+    n = int(os.environ.get("BENCH_ROWS", 8_000_000))
+    reps = int(os.environ.get("BENCH_REPS", 5))
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 120))
     init_tries = int(os.environ.get("BENCH_INIT_TRIES", 5))
     child = os.environ.get("BENCH_CHILD", "0") == "1"
