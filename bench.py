@@ -96,40 +96,50 @@ def record_tpu_attempt(payload: dict) -> None:
     """Persist a timestamped copy of any successful TPU measurement so a
     mid-round number survives an end-of-round tunnel flake.
 
-    The file records the round's BEST capture (by vs_baseline): the
-    watchdog re-runs bench.py on every tunnel wake, and a wake on a
+    The top-level fields are the round's BEST capture (by vs_baseline):
+    the watchdog re-runs bench.py on every tunnel wake, and a wake on a
     degraded tunnel must not overwrite a healthy earlier capture. The
     keep-best guard only applies against a previous capture that is (a)
     from this round (younger than 12 h — the file is git-tracked, so a
     PREVIOUS round's number must never suppress fresh evidence) and (b)
     the same configuration ("rows" matches — a 4M-rows 10.8x must not
-    lock out the 8M default the docs cite). Every capture (best or not)
-    is still printed/logged by the caller, so the full history lives in
-    the round's logs and BENCH_TPU_r*.jsonl."""
+    lock out the 8M default the docs cite).
+
+    So the selection rule is statable precisely: top-level = max over
+    this round's watchdog wakes of (best-of-5 within the run); "latest"
+    = the most recent wake's capture verbatim; "captures_this_round" =
+    how many wakes contributed. Docs citing the headline must say
+    best-wake; "latest" shows typical-tunnel performance."""
     if payload.get("platform") == "cpu" or "error" in payload:
         return
     try:
         path = os.path.join(REPO_DIR, "BENCH_TPU_attempt.json")
+        now = int(time.time())
+        stamped = dict(payload, captured_unix=now)
+        best = stamped
+        n_captures = 1
         try:
             with open(path) as f:
                 prev = json.load(f)
-            fresh = time.time() - prev.get("captured_unix", 0) < 12 * 3600
+            fresh = now - prev.get("captured_unix", 0) < 12 * 3600
             same_cfg = prev.get("rows") == payload.get("rows")
-            if (
-                fresh
-                and same_cfg
-                and prev.get("vs_baseline", 0) > payload.get("vs_baseline", 0)
-            ):
-                return
+            if fresh and same_cfg:
+                n_captures = int(prev.get("captures_this_round", 1)) + 1
+                if prev.get("vs_baseline", 0) > payload.get("vs_baseline", 0):
+                    best = {
+                        k: v
+                        for k, v in prev.items()
+                        if k not in ("latest", "captures_this_round")
+                    }
         except Exception:
             # no/unreadable/foreign previous attempt (or non-dict JSON):
             # record the new capture — this guard must NEVER raise, or a
             # real TPU measurement would be replaced by the fail-soft
             # error line (record runs before emit)
             pass
-        stamped = dict(payload, captured_unix=int(time.time()))
+        out = dict(best, latest=stamped, captures_this_round=n_captures)
         with open(path, "w") as f:
-            json.dump(stamped, f)
+            json.dump(out, f)
             f.write("\n")
     except OSError:
         pass  # recording is best-effort; never break the bench line

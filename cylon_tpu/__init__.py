@@ -38,6 +38,7 @@ from .context import CylonContext  # noqa: E402
 from .io import (  # noqa: E402
     CSVReadOptions,
     CSVWriteOptions,
+    ParquetOptions,
     read_csv,
     read_parquet,
     write_csv,
@@ -81,6 +82,7 @@ __all__ = [
     "CPUConfig",
     "CSVReadOptions",
     "CSVWriteOptions",
+    "ParquetOptions",
     "CylonContext",
     "CylonEnv",
     "DataFrame",

@@ -110,6 +110,11 @@ class Column:
             is_null = np.isnat(values)
             valid = None if not is_null.any() else ~is_null
             return data, valid, DataType(Type.TIMESTAMP), None
+        if values.dtype.kind == "m":  # timedelta64 -> int64 ns DURATION
+            data = values.astype("timedelta64[ns]").astype(np.int64)
+            is_null = np.isnat(values)
+            valid = None if not is_null.any() else ~is_null
+            return data, valid, DataType(Type.DURATION), None
         if values.dtype.kind == "f":
             is_null = np.isnan(values)
             valid = None if not is_null.any() else ~is_null
@@ -145,6 +150,11 @@ class Column:
             out = data_np.astype("datetime64[ns]")
             if valid_np is not None:
                 out[~valid_np] = np.datetime64("NaT")
+            return out
+        if self.dtype.type == Type.DURATION:
+            out = data_np.astype("timedelta64[ns]")
+            if valid_np is not None:
+                out[~valid_np] = np.timedelta64("NaT")
             return out
         if valid_np is not None and not valid_np.all():
             if self.dtype.type == Type.BOOL:

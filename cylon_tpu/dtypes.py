@@ -36,11 +36,18 @@ class Type(enum.IntEnum):
     DOUBLE = 11
     STRING = 12
     BINARY = 13
+    FIXED_SIZE_BINARY = 14
     DATE32 = 16
     DATE64 = 17
     TIMESTAMP = 18
     TIME32 = 19
     TIME64 = 20
+    INTERVAL = 21
+    DECIMAL = 22
+    LIST = 23
+    EXTENSION = 24
+    FIXED_SIZE_LIST = 25
+    DURATION = 26
 
 
 class Layout(enum.IntEnum):
@@ -74,6 +81,28 @@ _TYPE_TO_NUMPY[Type.DATE64] = np.dtype(np.int64)
 _TYPE_TO_NUMPY[Type.TIMESTAMP] = np.dtype(np.int64)
 _TYPE_TO_NUMPY[Type.TIME32] = np.dtype(np.int32)
 _TYPE_TO_NUMPY[Type.TIME64] = np.dtype(np.int64)
+# DURATION is a plain int64 span (reference data_types.hpp:80-81), stored
+# like TIMESTAMP; the remaining enum tail has no TPU-resident physical
+# representation and is rejected with UnsupportedTypeError below.
+_TYPE_TO_NUMPY[Type.DURATION] = np.dtype(np.int64)
+
+# Logical types the reference enumerates (data_types.hpp:55-79) but whose
+# compute kernels it never implements either; we carry the enum for parity
+# and fail loudly instead of silently miscomputing.
+UNSUPPORTED_TYPES = frozenset(
+    {
+        Type.FIXED_SIZE_BINARY,
+        Type.INTERVAL,
+        Type.DECIMAL,
+        Type.LIST,
+        Type.EXTENSION,
+        Type.FIXED_SIZE_LIST,
+    }
+)
+
+
+class UnsupportedTypeError(TypeError):
+    """Raised for enum-tail types with no TPU physical representation."""
 
 
 class DataType:
@@ -108,6 +137,12 @@ class DataType:
 
     @property
     def physical_dtype(self) -> np.dtype:
+        if self.type in UNSUPPORTED_TYPES:
+            raise UnsupportedTypeError(
+                f"{self.type.name} has no TPU-resident physical representation"
+                " (the reference enumerates it in data_types.hpp but its"
+                " kernels do not support it either); cast to a supported type"
+            )
         return _TYPE_TO_NUMPY[self.type]
 
     @classmethod
@@ -117,6 +152,8 @@ class DataType:
             return cls(Type.STRING)
         if dt.kind == "M":  # datetime64
             return cls(Type.TIMESTAMP)
+        if dt.kind == "m":  # timedelta64
+            return cls(Type.DURATION)
         t = _NUMPY_TO_TYPE.get(dt)
         if t is None:
             raise TypeError(f"unsupported dtype {dt}")
@@ -209,3 +246,8 @@ def string() -> DataType:
 
 def timestamp() -> DataType:
     return DataType(Type.TIMESTAMP)
+
+
+def duration() -> DataType:
+    """int64 time span (reference data_types.hpp:80-81)."""
+    return DataType(Type.DURATION)

@@ -72,6 +72,9 @@ def get_kernel(
     under jit(shard_map) hits an unbounded-recursion jax bug on TPU.
     Caching and kernel recording behave identically either way."""
     cache = ctx.__dict__.setdefault("_jit_cache", {})
+    # wrapping flags are part of the identity: same logical key with a
+    # different shard_map/vma wrapping must not alias to the first program
+    key = key + (bool(use_shard_map), bool(check_vma))
     fn = cache.get(key)
     if fn is None:
         kernel = builder()

@@ -172,6 +172,36 @@ class DataFrame:
             out._table.index_name = prefix + self._table.index_name
         return out
 
+    def add_suffix(self, suffix: str) -> "DataFrame":
+        """Suffix every column name (reference frame.py:1007)."""
+        out = self.rename([n + suffix for n in self.columns])
+        if self._table.index_name is not None:
+            out._table.index_name = self._table.index_name + suffix
+        return out
+
+    @staticmethod
+    def concat(
+        objs: Sequence["DataFrame"],
+        axis: int = 0,
+        join: str = "outer",
+        env: Optional[CylonEnv] = None,
+        **_unsupported,
+    ) -> "DataFrame":
+        """Static alias of module-level concat (reference frame.py:1470,
+        where DataFrame.concat takes the object list as its first argument).
+        axis=1 aligns on the index via Table.concat's join path."""
+        objs = [o for o in objs if o is not None]
+        if axis == 0:
+            return concat(objs, axis=0, env=env)
+        if join not in ("inner", "left", "right", "outer", "fullouter", "full_outer"):
+            raise ValueError(f"unknown join {join!r}")
+        tables = [d._retarget(env) for d in objs]
+        out = Table.concat(
+            tables, axis=1, join=join,
+            distributed=env is not None and env.world_size > 1,
+        )
+        return DataFrame(_table=out)
+
     # device-placement surface (reference frame.py:82-98 — stubs there; here
     # columns already live on the mesh devices, and the host side is reached
     # via to_pandas/to_arrow)

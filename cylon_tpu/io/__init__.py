@@ -1,9 +1,10 @@
 from .csv import CSVReadOptions, CSVWriteOptions, read_csv, write_csv
-from .parquet import read_parquet, write_parquet
+from .parquet import ParquetOptions, read_parquet, write_parquet
 
 __all__ = [
     "CSVReadOptions",
     "CSVWriteOptions",
+    "ParquetOptions",
     "read_csv",
     "write_csv",
     "read_parquet",

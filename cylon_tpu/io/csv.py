@@ -87,12 +87,31 @@ class CSVReadOptions:
 
 
 class CSVWriteOptions:
+    """Builder-style write options (reference io/csv_write_config.hpp:34-47:
+    WithDelimiter + ColumnNames header override)."""
+
     def __init__(self):
         self._delimiter = ","
+        self._column_names: Optional[List[str]] = None
 
     def with_delimiter(self, d: str) -> "CSVWriteOptions":
         self._delimiter = d
         return self
+
+    def with_column_names(self, names: Sequence[str]) -> "CSVWriteOptions":
+        """Override the header row (reference CSVWriteOptions::ColumnNames)."""
+        self._column_names = [str(n) for n in names]
+        return self
+
+    def _header_names(self, table_names: List[str]) -> List[str]:
+        if self._column_names is None:
+            return table_names
+        if len(self._column_names) != len(table_names):
+            raise ValueError(
+                f"ColumnNames override has {len(self._column_names)} names, "
+                f"table has {len(table_names)} columns"
+            )
+        return self._column_names
 
 
 # native ColType -> logical DataType
@@ -104,6 +123,14 @@ _CT_TO_DTYPE = {
 }
 
 Encoded = Tuple[np.ndarray, Optional[np.ndarray], DataType, Optional[np.ndarray]]
+
+
+def _io_workers(n_paths: int) -> int:
+    """Bounded IO pool: per-path threads, capped so hundreds of per-rank
+    shard paths don't oversubscribe the host (each read also parses)."""
+    import os
+
+    return max(1, min(n_paths, 4 * (os.cpu_count() or 1), 32))
 
 
 def _read_one_native(path: str, options: CSVReadOptions) -> "OrderedDict[str, Encoded]":
@@ -180,7 +207,9 @@ def read_csv(
     options = options or CSVReadOptions()
     if native.available() and not options._needs_arrow():
         if isinstance(paths, (list, tuple)):
-            with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=_io_workers(len(paths))
+            ) as ex:
                 shards = list(ex.map(lambda p: _read_one_native(p, options), paths))
             _unify_shards(shards)
             if len(shards) == ctx.world_size:
@@ -204,7 +233,9 @@ def read_csv(
             return Table.from_encoded(ctx, merged)
         return Table.from_encoded(ctx, _read_one_native(paths, options))
     if isinstance(paths, (list, tuple)):
-        with concurrent.futures.ThreadPoolExecutor(max_workers=len(paths)) as ex:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=_io_workers(len(paths))
+        ) as ex:
             shards = list(ex.map(lambda p: _read_one(p, options), paths))
         if len(shards) == 1:
             return Table.from_pydict(ctx, shards[0])
@@ -270,7 +301,18 @@ def _write_csv_one(
             if _io_pool is not None:
                 _io_pool.reset()
             return _write_csv_native(table, path, options, shard)
-    _shard_pandas(table, shard).to_csv(path, index=False, sep=options._delimiter)
+    _pandas_write(table, path, options, shard)
+
+
+def _pandas_write(
+    table: Table, path: str, options: CSVWriteOptions, shard: Optional[int]
+) -> None:
+    _shard_pandas(table, shard).to_csv(
+        path,
+        index=False,
+        sep=options._delimiter,
+        header=options._header_names(table.column_names),
+    )
 
 
 def _shard_pandas(table: Table, shard: Optional[int]):
@@ -308,6 +350,8 @@ def _write_csv_native(
             cols.append((native.CT_INT64, _stage(data_np, np.int64), valid_np, None))
         else:
             # temporal / uint64 -> pandas fallback
-            _shard_pandas(table, shard).to_csv(path, index=False, sep=options._delimiter)
+            _pandas_write(table, path, options, shard)
             return
-    native.write_csv(path, names, cols, delimiter=options._delimiter)
+    native.write_csv(
+        path, options._header_names(names), cols, delimiter=options._delimiter
+    )

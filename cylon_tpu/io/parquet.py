@@ -9,8 +9,9 @@ reference's per-rank CSV reads, table.cpp:791-829 — no global gather).
 """
 from __future__ import annotations
 
+import concurrent.futures
 from collections import OrderedDict
-from typing import Optional, Sequence, Union
+from typing import Any, Dict, Optional, Sequence, Union
 
 import numpy as np
 
@@ -18,20 +19,63 @@ from ..context import CylonContext
 from ..table import Table, _encode_arrow_array, unify_encoded_shards
 
 
-def read_parquet(ctx: CylonContext, paths: Union[str, Sequence[str]]) -> Table:
+class ParquetOptions:
+    """Builder-style parquet options (reference io/parquet_config.hpp:24-48:
+    ChunkSize, ConcurrentFileReads, WriterProperties/ArrowWriterProperties).
+
+    The reference threads parquet::WriterProperties through; the analog here
+    is keyword passthrough to ``pyarrow.parquet.write_table`` (compression,
+    use_dictionary, ...), with ChunkSize mapping to ``row_group_size``."""
+
+    def __init__(self):
+        self._chunk_size: Optional[int] = None
+        self._concurrent_file_reads = True
+        self._writer_properties: Dict[str, Any] = {}
+
+    def chunk_size(self, n: int) -> "ParquetOptions":
+        """Rows per written row group (reference ParquetOptions::ChunkSize)."""
+        self._chunk_size = int(n)
+        return self
+
+    def concurrent_file_reads(self, flag: bool) -> "ParquetOptions":
+        """Thread-pool multi-file reads (reference ConcurrentFileReads;
+        the reference reads per-rank files concurrently, table.cpp:791-829)."""
+        self._concurrent_file_reads = bool(flag)
+        return self
+
+    def writer_properties(self, **kwargs) -> "ParquetOptions":
+        """pq.write_table keyword passthrough — compression='zstd',
+        use_dictionary=False, ... (reference WriterProperties)."""
+        self._writer_properties.update(kwargs)
+        return self
+
+
+def read_parquet(
+    ctx: CylonContext,
+    paths: Union[str, Sequence[str]],
+    options: Optional[ParquetOptions] = None,
+) -> Table:
     """Read parquet file(s); a list of world_size paths maps file i to
     shard i (per-rank ingest, O(one shard) host staging)."""
     import pyarrow.parquet as pq
 
+    options = options or ParquetOptions()
     if isinstance(paths, (list, tuple)):
-        shards = []
-        for p in paths:
+        def _read_one(p):
             at = pq.read_table(p)
-            shards.append(
-                OrderedDict(
-                    (n, _encode_arrow_array(at.column(n))) for n in at.column_names
-                )
+            return OrderedDict(
+                (n, _encode_arrow_array(at.column(n))) for n in at.column_names
             )
+
+        if options._concurrent_file_reads and len(paths) > 1:
+            from .csv import _io_workers
+
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=_io_workers(len(paths))
+            ) as ex:
+                shards = list(ex.map(_read_one, paths))
+        else:
+            shards = [_read_one(p) for p in paths]
         unify_encoded_shards(shards)
         if len(shards) == ctx.world_size:
             return Table.from_encoded_shards(ctx, shards)
@@ -54,15 +98,23 @@ def read_parquet(ctx: CylonContext, paths: Union[str, Sequence[str]]) -> Table:
     return Table.from_arrow(ctx, pq.read_table(paths))
 
 
-def write_parquet(table: Table, path: Union[str, Sequence[str]]) -> None:
+def write_parquet(
+    table: Table,
+    path: Union[str, Sequence[str]],
+    options: Optional[ParquetOptions] = None,
+) -> None:
     """Write parquet. A list of world_size paths writes shard i to path[i],
     fetching each shard's device buffers individually (no global gather)."""
     import pyarrow.parquet as pq
 
+    options = options or ParquetOptions()
+    kw = dict(options._writer_properties)
+    if options._chunk_size is not None:
+        kw["row_group_size"] = options._chunk_size
     if isinstance(path, (list, tuple)):
         if len(path) != table.world_size:
             raise ValueError(f"need {table.world_size} paths, got {len(path)}")
         for i, p in enumerate(path):
-            pq.write_table(table.to_arrow(shard=i), p)
+            pq.write_table(table.to_arrow(shard=i), p, **kw)
         return
-    pq.write_table(table.to_arrow(), path)
+    pq.write_table(table.to_arrow(), path, **kw)

@@ -178,6 +178,13 @@ def pk_inner_join(
         biggest = max(cap_l, r_key.shape[0])
         need = max(int(biggest // max(B // 2, 1)), 1)
         nb = 1 << (need - 1).bit_length()
+    else:
+        # public nb: round up to a power of two >= 8 so the probe's (G, B)
+        # block always satisfies Mosaic's second-minor divisibility (G
+        # reaches 8); more buckets only lowers occupancy, never correctness
+        from ..engine import round_cap
+
+        nb = round_cap(nb, minimum=8)
     pad = jnp.asarray(jnp.iinfo(l_key.dtype).min, l_key.dtype)
     lkb, lib, ov_l = _bucket_layout(l_key, nl, nb, B, pad)
     rkb, rib, ov_r = _bucket_layout(r_key, nr, nb, B, pad)

@@ -507,6 +507,8 @@ class Table:
                 )
             elif col.dtype.type == Type.TIMESTAMP:
                 arr = pa.array(data.astype("datetime64[ns]"), mask=mask)
+            elif col.dtype.type == Type.DURATION:
+                arr = pa.array(data.astype("timedelta64[ns]"), mask=mask)
             else:
                 arr = pa.array(data, mask=mask)
             arrays.append(arr)
@@ -609,6 +611,80 @@ class Table:
         drop = set(columns)
         cols = OrderedDict((n, c) for n, c in self._columns.items() if n not in drop)
         return self._replace(columns=cols)
+
+    def add_prefix(self, prefix: str) -> "Table":
+        """Prefix every column name (reference table.pyx:1943-1970).
+        A pure rename — no host/device movement; a set index follows its
+        renamed column."""
+        out = self.rename([prefix + n for n in self.column_names])
+        if self.index_name is not None:
+            out.index_name = prefix + self.index_name
+        return out
+
+    def add_suffix(self, suffix: str) -> "Table":
+        """Suffix every column name (reference table.pyx:1972-2000)."""
+        out = self.rename([n + suffix for n in self.column_names])
+        if self.index_name is not None:
+            out.index_name = self.index_name + suffix
+        return out
+
+    def to_string(self, row_limit: int = 10) -> str:
+        """Head/tail string render with a dotted elision line past
+        ``row_limit`` rows (reference table.pyx:1660-1690)."""
+        full = self.to_pandas().to_string()
+        if self.row_count <= row_limit:
+            return full
+        rows = full.split("\n")
+        # rows[0] is the header; keep limit/2 head and tail data rows
+        half = max(row_limit // 2, 1)
+        dot_line = "." * max(len(r) for r in rows[:1 + half])
+        kept = rows[: 1 + half] + [dot_line] + rows[-half:]
+        return "\n".join(kept) + "\n"
+
+    def show(self, row1: int = -1, row2: int = -1, col1: int = -1, col2: int = -1) -> None:
+        """Print the table, optionally a [row1:row2, col1:col2] window
+        (reference table.pyx:115-128 / C++ Table::Print)."""
+        if (row1, row2, col1, col2) == (-1, -1, -1, -1):
+            print(self.to_pandas().to_string())
+            return
+        df = self.to_pandas()
+        r1 = 0 if row1 == -1 else row1
+        r2 = len(df) if row2 == -1 else row2
+        c1 = 0 if col1 == -1 else col1
+        c2 = df.shape[1] if col2 == -1 else col2
+        print(df.iloc[r1:r2, c1:c2].to_string())
+
+    def dropna(self, axis: int = 0, how: str = "any", inplace: bool = False) -> "Table":
+        """Method form of compute.drop_na (reference table.pyx:2144-2216).
+
+        NOTE the reference's Table.dropna axis convention is inverted vs
+        pandas: axis=0 drops COLUMNS with nulls, axis=1 drops ROWS (see the
+        table.pyx docstring examples). compute.drop_na uses the pandas
+        convention, so the method flips the axis before delegating.
+        """
+        from . import compute as _compute
+
+        if axis not in (0, 1):
+            raise ValueError("axis must be 0 or 1")
+        out = _compute.drop_na(self, how=how, axis=1 - axis)
+        if inplace:
+            self._columns = out._columns
+            self._row_counts = out._row_counts
+            self._shard_cap = out._shard_cap
+            self._counts_dev = None
+            # direct mutation bypasses __init__'s dangling-index check and
+            # any cached loc index built on the pre-drop rows
+            if self.index_name not in self._columns:
+                self.index_name = None
+            self._built_index = None
+            return self
+        return out
+
+    def isin(self, values, skip_null: bool = True) -> "Table":
+        """Method form of compute.is_in (reference table.pyx:2218-2220)."""
+        from . import compute as _compute
+
+        return _compute.is_in(self, values, skip_null=skip_null)
 
     def add_column(self, name: str, col: Union[Column, np.ndarray, jax.Array]) -> "Table":
         if not isinstance(col, Column):
@@ -1437,10 +1513,17 @@ class Table:
         suffixes: Tuple[str, str] = ("_x", "_y"),
         capacity_factor: float = 2.0,
         max_retries: int = 3,
+        respill: int = 1,
         **_ignored,
     ) -> "Table":
         """shuffle->join as one XLA program (see distributed_join). One host
-        sync per attempt: the fetch of (out_counts, overflow)."""
+        sync per attempt: the fetch of (out_counts, overflow).
+
+        ``respill`` = extra in-program exchange rounds per shuffle: a bucket
+        hotter than bucket_cap drains over (1+respill) rounds with no host
+        sync; only a bucket past (1+respill)*bucket_cap triggers the
+        host-level doubled-capacity retry. Raise it for known-skewed keys to
+        trade collective rounds for recompiles."""
         from .parallel.pipeline import make_distributed_join_step
 
         ctx = self.ctx
@@ -1454,7 +1537,9 @@ class Table:
         lflat = left._flat_cols()
         rflat = right._flat_cols()
         cap_l, cap_r = left.shard_cap, right.shard_cap
-        respill = 1
+        respill = int(respill)
+        if respill < 0:
+            raise ValueError("respill must be >= 0")
         bucket_cap = round_cap(
             int(capacity_factor * max(cap_l, cap_r) / max(world, 1))
         )
@@ -2288,6 +2373,98 @@ class Table:
         t.index_name = None
         return t
 
+    @staticmethod
+    def concat(
+        tables: Sequence["Table"],
+        axis: int = 0,
+        join: str = "inner",
+        algorithm: str = "sort",
+        distributed: bool = False,
+    ) -> "Table":
+        """Reference Table.concat (table.pyx:2334-2400): axis=0 row-stacks
+        same-schema tables (the reference routes to Merge); axis=1 joins
+        successive tables on their index column. Functional — inputs are
+        never mutated (the reference mutates its inputs' indexes in place).
+
+        Tables with a RangeIndex (no index column) join on global row
+        number, matching pandas' align-on-index semantics for the default
+        index."""
+        tables = list(tables)
+        if not tables:
+            raise ValueError("need at least one table")
+        if any(not isinstance(t, Table) for t in tables):
+            raise ValueError("concat expects Tables")
+        if axis == 0:
+            return tables[0] if len(tables) == 1 else _concat_tables(tables)
+        if axis != 1:
+            raise ValueError(f"invalid axis {axis}, must be 0 or 1")
+
+        tmp_key = "__concat_index__"
+        tmp_rkey = "__concat_rkey__"
+        for t in tables:
+            if tmp_key in t.column_names or tmp_rkey in t.column_names:
+                raise ValueError(
+                    f"column names {tmp_key}/{tmp_rkey} are reserved by concat"
+                )
+
+        def keyed(t: "Table") -> Tuple["Table", str, bool]:
+            if t.index_name is not None:
+                return t, t.index_name, False
+            return t.add_column(tmp_key, t._global_rowid_column()), tmp_key, True
+
+        res, res_key, res_tmp = keyed(tables[0])
+        for i, other in enumerate(tables[1:], start=1):
+            o, o_key, _ = keyed(other)
+            # the right key rides under a RESERVED name so the drop below can
+            # never hit a user column that happens to collide with it
+            o = o.rename({o_key: tmp_rkey})
+            use_dist = distributed and res.world_size > 1
+            join_fn = res.distributed_join if use_dist else res.join
+            # per-iteration suffix: with 3+ tables sharing a column name, a
+            # fixed "_y" would collide on the second join and silently
+            # overwrite the middle table's column in the OrderedDict
+            res = join_fn(
+                o,
+                how=join,
+                left_on=[res_key],
+                right_on=[tmp_rkey],
+                suffixes=("", "_y" if i == 1 else f"_y{i}"),
+                algorithm="sort" if algorithm not in ("sort", "hash") else algorithm,
+            )
+            if join in ("right", "outer", "fullouter", "full_outer"):
+                # coalesce the index: right-only rows carry their values in
+                # the right key column (the join never merges key columns)
+                lcol = res._columns[res_key]
+                rcol = res._columns[tmp_rkey]
+                prefer_r = join == "right"
+                a, b = (rcol, lcol) if prefer_r else (lcol, rcol)
+                a_ok = a.valid if a.valid is not None else jnp.ones(
+                    a.data.shape, bool
+                )
+                data = jnp.where(a_ok, a.data, b.data)
+                valid = (
+                    None
+                    if a.valid is None or b.valid is None
+                    else (a.valid | b.valid)
+                )
+                cols = OrderedDict(res._columns)
+                # jnp.where may promote (int32 left index vs int64 right):
+                # derive the declared dtype from the promoted buffer, keeping
+                # the Column data-matches-physical-dtype invariant
+                out_dt = (
+                    lcol.dtype
+                    if lcol.dtype.is_dictionary
+                    else DataType.from_numpy_dtype(np.dtype(data.dtype))
+                )
+                cols[res_key] = Column(data, out_dt, valid, lcol.dictionary)
+                res = res._replace(columns=cols)
+            res = res.drop([tmp_rkey])
+        if res_tmp:
+            res = res.drop([res_key]) if res_key in res.column_names else res
+        elif res_key in res.column_names:
+            res = res.set_index(res_key)
+        return res
+
     @property
     def index(self):
         from .indexing import ColumnIndex, RangeIndex
@@ -2387,6 +2564,9 @@ def _encode_arrow_array(chunked):
     if pa.types.is_timestamp(t) or pa.types.is_date(t):
         data = np.asarray(arr.cast(pa.timestamp("ns")).fill_null(0)).astype(np.int64)
         return data, valid, DataType(Type.TIMESTAMP), None
+    if pa.types.is_duration(t):
+        data = np.asarray(arr.cast(pa.duration("ns")).fill_null(0)).astype(np.int64)
+        return data, valid, DataType(Type.DURATION), None
     if pa.types.is_boolean(t):
         data = np.asarray(arr.fill_null(False))
         return data, valid, DataType(Type.BOOL), None

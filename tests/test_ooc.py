@@ -68,6 +68,28 @@ def test_ooc_join_exceeds_device_budget(ctx8):
     )
 
 
+def test_ooc_device_cap_scales_with_buckets(ctx8):
+    """Pin the ~total/K residency bound directly (advisor round-3): doubling
+    num_buckets must shrink peak resident device rows, which a regression to
+    full-table residency on any stage could not satisfy."""
+    rng = np.random.default_rng(7)
+    n = 48_000
+    ldf = pd.DataFrame({"k": rng.integers(0, 10_000, n).astype(np.int32),
+                        "v": rng.normal(size=n).astype(np.float32)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 10_000, n).astype(np.int32),
+                        "w": rng.normal(size=n).astype(np.float32)})
+    caps = {}
+    for k in (8, 16):
+        job = OutOfCoreJoin(ctx8, on="k", how="inner", num_buckets=k)
+        sink = job.execute(_chunks(ldf, 4_000), _chunks(rdf, 4_000))
+        assert sink.rows == len(ldf.merge(rdf, on="k"))
+        caps[k] = job.max_device_cap
+    # power-of-2 cap rounding quantizes the residency, so require a real
+    # drop (not just <=): halving bucket size must at least halve one
+    # rounding step, i.e. strictly fewer peak rows
+    assert caps[16] < caps[8], caps
+
+
 def test_ooc_join_empty_bucket_sides(ctx8):
     """Keys chosen so some buckets are one-sided or empty: inner join must
     skip them without error."""

@@ -100,6 +100,66 @@ def check(got_df, want_df, what, params):
 MAX_N = 400
 
 
+def skew_round_once(seed) -> bool:
+    """Hard-mode adversarial-skew round (VERDICT r3 item 8): ONE key owns
+    ~50% of the rows on both sides, world in {4, 8}, and the fused join runs
+    with a deliberately undersized capacity_factor and respill in {0..3} so
+    hot buckets must drain over >=3 in-program rounds and/or host retries.
+    Exact pandas parity asserted on every how; the retry loop's bound
+    (max_retries) is asserted implicitly — an unconverged join raises."""
+    rng = np.random.default_rng(seed)
+    n_l = int(rng.integers(200, max(MAX_N, 201)))
+    n_r = int(rng.integers(200, max(MAX_N, 201)))
+    keyspace = int(rng.integers(4, 64))
+    world = int(rng.choice([4, 8]))
+    hot = np.int32(rng.integers(-keyspace, keyspace))
+    params = dict(seed=seed, profile="skew", n_l=n_l, n_r=n_r,
+                  keyspace=keyspace, world=world, hot=int(hot))
+    ctx = ctx_for(world)
+
+    def skewed(n, vname):
+        k = rng.integers(-keyspace, keyspace, n).astype(np.int32)
+        k[rng.random(n) < 0.5] = hot  # ~half the rows on one key
+        return pd.DataFrame({"k": k, vname: rng.normal(size=n).astype(np.float32)})
+
+    ldf = skewed(n_l, "v")
+    rdf = skewed(n_r, "w")
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    ok = True
+    capf = float(rng.choice([0.125, 0.25, 0.5]))
+    resp = int(rng.choice([0, 1, 2, 3]))
+    for how in ("inner", "left", "right", "outer"):
+        want = ldf.merge(rdf, on="k", how=how)
+        want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
+        if how in ("left", "outer"):
+            want.loc[want["w"].isna() & ~want["k_x"].isin(rdf["k"]), "k_y"] = None
+        if how in ("right", "outer"):
+            want.loc[want["v"].isna() & ~want["k_y"].isin(ldf["k"]), "k_x"] = None
+        got = lt.distributed_join(
+            rt, on="k", how=how, mode="fused",
+            capacity_factor=capf, respill=resp, max_retries=6,
+        ).to_pandas()
+        ok &= check(got, want, f"skewjoin/{how}/capf{capf}/resp{resp}", params)
+        # eager path under the same skew: multi-round _shuffle_impl drain
+        got = lt.distributed_join(rt, on="k", how=how).to_pandas()
+        ok &= check(got, want, f"skewjoin/{how}/eager", params)
+    # skewed groupby-sum cross-check (pre-combine must stay associative
+    # under a giant hot group)
+    got = lt.distributed_groupby("k", {"v": "sum"}).to_pandas()
+    want = ldf.groupby("k", as_index=False)["v"].sum().rename(
+        columns={"v": "v_sum"})
+    go = got.sort_values("k").reset_index(drop=True)
+    wo = want.sort_values("k").reset_index(drop=True)
+    if not (len(go) == len(wo)
+            and (go["k"].to_numpy() == wo["k"].to_numpy()).all()
+            and np.allclose(go["v_sum"].to_numpy(), wo["v_sum"].to_numpy(),
+                            rtol=1e-3, atol=1e-3)):
+        print(f"MISMATCH skew_groupby params={params}", flush=True)
+        ok = False
+    return ok
+
+
 def round_once(seed) -> bool:
     rng = np.random.default_rng(seed)
     n_l = int(rng.integers(1, MAX_N))
@@ -262,16 +322,20 @@ def main():
     ap.add_argument("--max-n", type=int, default=400,
                     help="upper bound on random table sizes (bigger stresses "
                          "respill/overflow/capacity-retry paths)")
+    ap.add_argument("--profile", choices=["default", "skew"], default="default",
+                    help="'skew': adversarial hot-key rounds (one key ~50%% "
+                         "of rows, world {4,8}, undersized fused capacities)")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
+    fn = skew_round_once if args.profile == "skew" else round_once
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
     rounds = 0
     while time.time() < t_end:
         try:
-            if not round_once(seed):
+            if not fn(seed):
                 failures += 1
         except Exception:
             print(f"EXCEPTION seed={seed}", flush=True)
