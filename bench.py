@@ -309,6 +309,10 @@ def main():
         "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
         "warm_s": round(best, 4),
         "compile_s": round(compile_s, 2),
+        # provenance: which emit/repeat impls produced this number (the
+        # watchdog's step-2b recapture runs under EMIT_IMPL=windowed, and
+        # keep-best must stay attributable)
+        "emit_impl": os.environ.get("CYLON_TPU_EMIT_IMPL", "gather"),
         **info,
     }
     record_tpu_attempt(payload)

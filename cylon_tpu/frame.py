@@ -193,6 +193,8 @@ class DataFrame:
         objs = [o for o in objs if o is not None]
         if axis == 0:
             return concat(objs, axis=0, env=env)
+        if axis != 1:
+            raise ValueError(f"invalid axis {axis}, must be 0 or 1")
         if join not in ("inner", "left", "right", "outer", "fullouter", "full_outer"):
             raise ValueError(f"unknown join {join!r}")
         tables = [d._retarget(env) for d in objs]

@@ -113,7 +113,8 @@ def _merged_counts(
 def impl_tag() -> tuple:
     """Env-selected kernel-impl choices, as a cache-key component.
 
-    ``CYLON_TPU_REPEAT_IMPL`` / ``CYLON_TPU_SEGSUM_IMPL`` are read at TRACE
+    ``CYLON_TPU_REPEAT_IMPL`` / ``CYLON_TPU_SEGSUM_IMPL`` /
+    ``CYLON_TPU_EMIT_IMPL`` / ``CYLON_TPU_EXPAND_GATHER`` are read at TRACE
     time, so any kernel cached by an env-independent key (ctx._jit_cache via
     engine.get_kernel) would silently keep the impl it was first compiled
     with after a mid-process env flip. Join-family cache keys append this
@@ -123,6 +124,8 @@ def impl_tag() -> tuple:
     return (
         os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter"),
         os.environ.get("CYLON_TPU_SEGSUM_IMPL", "scatter"),
+        os.environ.get("CYLON_TPU_EMIT_IMPL", "gather"),
+        os.environ.get("CYLON_TPU_EXPAND_GATHER", "take"),
     )
 
 
@@ -379,6 +382,7 @@ def emit_gather(
     l_cols: Sequence[KeyCol],
     r_cols: Sequence[KeyCol],
     nl, nr, how: int, cap_out: int,
+    emit_impl: str = "gather",
 ) -> Tuple[list, jax.Array]:
     """Fused emit + payload gather: produce the joined output columns with a
     minimal number of XLA gathers (the TPU bottleneck — see ops/gather.py).
@@ -412,8 +416,45 @@ def emit_gather(
         for (d, v), (_, rv) in zip(r_sorted_cols, r_cols)
     ]
     return _emit_inner_left(
-        lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, r_order.shape[0]
+        lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, r_order.shape[0],
+        emit_impl,
     )
+
+
+def emit_impl_for(world_size: int, platform: str) -> str:
+    """Resolve the emit implementation for a mesh: 'windowed' only when the
+    env opts in AND the Pallas expand can actually run there (interpret on
+    CPU meshes; compiled pallas_call under jit(shard_map) recurses on TPU,
+    so multi-chip TPU meshes keep the XLA gather — same constraint as
+    algorithm='pallas_pk')."""
+    import os
+
+    if os.environ.get("CYLON_TPU_EMIT_IMPL", "gather") != "windowed":
+        return "gather"
+    from .pallas_gather import expand_available
+
+    if not expand_available():
+        return "gather"
+    if world_size > 1 and platform != "cpu":
+        return "gather"
+    return "windowed"
+
+
+def emit_impl_kwargs(ctx) -> Tuple[str, dict]:
+    """(emit_impl, engine.get_kernel kwargs) for a context — ONE home for
+    the three-way invariant: a windowed emit embeds a pallas_call, whose
+    outputs trip shard_map's vma checker (check_vma=False) and which
+    recurses under jit(shard_map) when compiled on a 1-device TPU mesh
+    (use_shard_map=False there)."""
+    impl = emit_impl_for(
+        ctx.world_size, ctx.mesh.devices.flat[0].platform
+    )
+    if impl != "windowed":
+        return impl, {}
+    return impl, {
+        "check_vma": False,
+        "use_shard_map": ctx.world_size > 1,
+    }
 
 
 def _emit_inner_left(
@@ -421,10 +462,18 @@ def _emit_inner_left(
     l_cols: Sequence[KeyCol],
     r_sorted_cols: Sequence[KeyCol],
     nl, how: int, cap_out: int, cap_r: int,
+    emit_impl: str = "gather",
 ) -> Tuple[list, jax.Array]:
     """INNER/LEFT emit against an ALREADY key-sorted right payload: the
     ``jnp.repeat`` for li, one packed left-row gather (payload + base/cnt
-    lanes), one packed right-row gather at the run positions."""
+    lanes), one packed right-row gather at the run positions.
+
+    ``emit_impl='windowed'`` (via :func:`emit_impl_for`) swaps the left
+    gather for the Pallas streamed expand (ops/pallas_gather)."""
+    if emit_impl == "windowed":
+        return _emit_inner_left_windowed(
+            lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, cap_r
+        )
     from .gather import pack_gather
 
     cap_l = lo.shape[0]
@@ -450,6 +499,90 @@ def _emit_inner_left(
     return list(out_l) + list(out_r), total_l
 
 
+def _emit_inner_left_windowed(
+    lo, cnt,
+    l_cols: Sequence[KeyCol],
+    r_sorted_cols: Sequence[KeyCol],
+    nl, how: int, cap_out: int, cap_r: int,
+) -> Tuple[list, jax.Array]:
+    """INNER/LEFT emit with the left gather replaced by the Pallas windowed
+    expand (docs/GATHER_DESIGN.md; VERDICT r3 item 1).
+
+    The left per-element gather becomes: ONE row scatter compacting emitting
+    rows to the front (sorted destinations — for LEFT joins this is the
+    identity on live rows), then a streamed expand whose emit indices are
+    ``repeat(arange(m), counts)`` — non-decreasing, step <= 1 — so each
+    128-output group reads one 128-wide VMEM window (ops/pallas_gather).
+    Bookkeeping lanes (lo, cnt, original row id, output offset) ride the
+    same scatter/expand, reconstructing the right-side run positions without
+    any second repeat. The right gather is unchanged (its positions are not
+    monotone in original-left emit order)."""
+    import os
+
+    from .gather import pack_cols, pack_gather, unpack_cols
+    from .pallas_gather import expand_rows
+
+    impl = os.environ.get("CYLON_TPU_EXPAND_GATHER", "take")
+    interpret = jax.default_backend() != "tpu"
+    cap_l = lo.shape[0]
+    idx_l = jnp.arange(cap_l, dtype=jnp.int32)
+    live_l = idx_l < nl
+    if how == LEFT:
+        cnt_adj = jnp.where(live_l & (cnt == 0), 1, cnt)
+    else:
+        cnt_adj = cnt
+    emitting = live_l & (cnt_adj > 0)
+    em32 = emitting.astype(jnp.int32)
+    slot = jnp.cumsum(em32) - em32  # dense compaction slot (order-preserving)
+    dest = jnp.where(emitting, slot, cap_l)
+
+    plan, lanes, passthrough = pack_cols(l_cols)
+    n_payload = len(lanes)
+    lanes = list(lanes) + [lo, cnt, cnt_adj.astype(jnp.int32), idx_l]
+    packed = jnp.stack(lanes, axis=1)  # [cap_l, LA]
+    LA = packed.shape[1]
+    packed_c = jnp.zeros((cap_l, LA), jnp.int32).at[dest].set(
+        packed.astype(jnp.int32), mode="drop"
+    )
+
+    cnt_adj_c = packed_c[:, n_payload + 2]
+    ends_c = jnp.cumsum(cnt_adj_c)
+    total = ends_c[-1].astype(jnp.int32)
+    offs_c = (ends_c - cnt_adj_c).astype(jnp.int32)
+    li_c = _repeat_ss(ends_c, cap_out)  # raw non-decreasing (no -1 masking)
+
+    srcT = jnp.concatenate(
+        [packed_c.T, offs_c[None, :]], axis=0
+    )  # [LA+1, cap_l]
+    outT = expand_rows(srcT, li_c, impl=impl, interpret=interpret)
+    g_lanes = [outT[j] for j in range(LA + 1)]
+    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
+    in_out = out_pos < total
+    lo_g = g_lanes[n_payload]
+    cnt_g = g_lanes[n_payload + 1]
+    orig_g = g_lanes[n_payload + 3]
+    offs_g = g_lanes[LA]
+
+    def make_valid(lane):
+        return in_out if lane is None else (in_out & lane.astype(jnp.bool_))
+
+    out_l, _ = unpack_cols(
+        plan,
+        g_lanes[:n_payload],
+        # f64 columns have no int32 lane route: gather them by the expanded
+        # original row id (their validity lane rode the expand)
+        lambda ci: passthrough[ci][jnp.clip(orig_g, 0, cap_l - 1)],
+        make_valid,
+    )
+
+    has_match = in_out & (cnt_g > 0)
+    rpos = jnp.where(
+        has_match, jnp.clip(lo_g - offs_g + out_pos, 0, cap_r - 1), -1
+    )
+    out_r, _ = pack_gather(r_sorted_cols, rpos)
+    return list(out_l) + list(out_r), total
+
+
 def spec_join(
     l_key_cols: Sequence[KeyCol],
     r_key_cols: Sequence[KeyCol],
@@ -459,6 +592,7 @@ def spec_join(
     nr: jax.Array,
     how: int,
     cap_out: int,
+    emit_impl: str = "gather",
 ) -> Tuple[list, jax.Array, jax.Array]:
     """Single-dispatch speculative join: probe + count + emit + gather in one
     program with the minimal pass count.
@@ -508,12 +642,13 @@ def spec_join(
             heavy_sorted = []
         r_sorted = merge_ride_cols(r_cols, ride, spays, heavy_sorted)
         out_cols, n_out = _emit_inner_left(
-            lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r
+            lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r, emit_impl
         )
     else:
         r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
         out_cols, n_out = emit_gather(
-            lo, cnt, r_order, r_cnt, l_cols, r_cols, nl, nr, how, cap_out
+            lo, cnt, r_order, r_cnt, l_cols, r_cols, nl, nr, how, cap_out,
+            emit_impl,
         )
     return out_cols, total, shadow
 

@@ -1258,13 +1258,16 @@ class Table:
                     round_cap(max(cap_l, cap_r)), hints.get(key, 0)
                 )
 
+            emit_impl, emit_kw = _j.emit_impl_kwargs(self.ctx)
+
             def build_spec():
                 def kern(dp, rep):
                     (lk, rk, lcols, rcols, nl, nr) = dp
                     (dummy,) = rep
                     co = dummy.shape[0]
                     out, total, shadow = _j.spec_join(
-                        lk, rk, lcols, rcols, nl[0], nr[0], howi, co
+                        lk, rk, lcols, rcols, nl[0], nr[0], howi, co,
+                        emit_impl,
                     )
                     # pack count + f32 overflow shadow into one [2] i32 lane
                     # so the host needs a single fetch
@@ -1277,7 +1280,7 @@ class Table:
 
             with span("join.speculative", rows=int(self.row_count)):
                 out, stats = get_kernel(
-                    self.ctx, key + ("spec",), build_spec
+                    self.ctx, key + ("spec",), build_spec, **emit_kw
                 )(
                     (lflat_k, rflat_k, lflat, rflat, left.counts_dev, right.counts_dev),
                     (jnp.zeros((spec_cap,), jnp.int8),),
@@ -1321,6 +1324,8 @@ class Table:
         cap_out = round_cap(int(cnts.max()))
 
         # phase 2: emit + gather, reusing the probe state (no re-sort)
+        emit_impl, emit_kw = _j.emit_impl_kwargs(self.ctx)
+
         def build_emit():
             def kern(dp, rep):
                 (lo, cnt, r_order, r_cnt, lcols, rcols, nl, nr) = dp
@@ -1328,13 +1333,15 @@ class Table:
                 co = dummy.shape[0]
                 out, n_out = _j.emit_gather(
                     lo, cnt, r_order, r_cnt, lcols, rcols,
-                    nl[0], nr[0], howi, co,
+                    nl[0], nr[0], howi, co, emit_impl,
                 )
                 return out, _scalar(n_out)
 
             return kern
 
-        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
+        out, nout = get_kernel(
+            self.ctx, key + ("emit",), build_emit, **emit_kw
+        )(
             (lo, cnt, r_order, r_cnt, lflat, rflat, left.counts_dev, right.counts_dev),
             (jnp.zeros((cap_out,), jnp.int8),),
         )

@@ -1,0 +1,125 @@
+"""Windowed Pallas expand (ops/pallas_gather) semantics on the CPU mesh
+(interpret mode), plus end-to-end join equivalence of the windowed emit
+path vs the XLA-gather emit path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.ops import join as J
+from cylon_tpu.ops.pallas_gather import expand_available, expand_rows
+
+pytestmark = pytest.mark.skipif(
+    not expand_available(), reason="pallas unavailable"
+)
+
+
+@pytest.mark.parametrize("impl", ["take", "onehot"])
+@pytest.mark.parametrize(
+    "m,hot,T",
+    [(700, 0, 512), (700, 3, 512), (3, 0, 512), (9000, 2, 2048)],
+)
+def test_expand_rows_oracle(rng, impl, m, hot, T):
+    # expand contract: every count >= 1 (zero-count rows are compacted away
+    # by the caller — a zero would create a step > 1 and a window miss)
+    cnt = rng.integers(1, 4, m)
+    if hot:
+        cnt[rng.integers(0, m, hot)] = 700  # skewed runs (step 0: safe)
+    li = np.repeat(np.arange(m), cnt).astype(np.int32)
+    if len(li) == 0:
+        li = np.zeros(1, np.int32)
+    L = 5
+    src = rng.integers(-(2**31), 2**31, (L, m), dtype=np.int64).astype(np.int32)
+    got = np.asarray(
+        expand_rows(jnp.asarray(src), jnp.asarray(li), T=T, impl=impl,
+                    interpret=True)
+    )
+    want = src[:, np.clip(li, 0, m - 1)]
+    assert (got == want).all()
+
+
+def _emit_pair(rng, how, n_l, n_r, keyspace, with_valid=False, with_f64=False):
+    """Run both emit impls on one random probe state; return their outputs."""
+    cap_l = max(1 << (n_l - 1).bit_length(), 8)
+    cap_r = max(1 << (n_r - 1).bit_length(), 8)
+    lk = np.zeros(cap_l, np.int32)
+    rk = np.zeros(cap_r, np.int32)
+    lk[:n_l] = rng.integers(0, keyspace, n_l)
+    rk[:n_r] = rng.integers(0, keyspace, n_r)
+    lv = np.zeros(cap_l, np.float32)
+    lv[:n_l] = rng.normal(size=n_l)
+    rv = np.zeros(cap_r, np.float32)
+    rv[:n_r] = rng.normal(size=n_r)
+    nl = jnp.int32(n_l)
+    nr = jnp.int32(n_r)
+    l_key_cols = [(jnp.asarray(lk), None)]
+    r_key_cols = [(jnp.asarray(rk), None)]
+    l_cols = [(jnp.asarray(lk), None), (jnp.asarray(lv), None)]
+    if with_valid:
+        lval = np.ones(cap_l, bool)
+        lval[: n_l // 2] = rng.random(n_l // 2) > 0.3
+        l_cols[1] = (l_cols[1][0], jnp.asarray(lval))
+    if with_f64:
+        l_cols.append((jnp.asarray(lv.astype(np.float64) * 3), None))
+    r_cols = [(jnp.asarray(rk), None), (jnp.asarray(rv), None)]
+
+    howi = J.join_type_id(how)
+    lo, cnt, r_order, r_cnt = J.probe_arrays(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, howi
+    )
+    total = int(J.count_from_probe(cnt, r_cnt, nl, nr, howi))
+    cap_out = max(1 << (max(total, 1) - 1).bit_length(), 8)
+    from cylon_tpu.ops.gather import pack_gather
+
+    r_sorted, _ = pack_gather(r_cols, r_order)
+    r_sorted = [
+        (d, None) for (d, v) in r_sorted
+    ]  # r_cols mask-free: keep mask-free
+    outs = {}
+    for fn in (J._emit_inner_left, J._emit_inner_left_windowed):
+        cols, n_out = fn(
+            lo, cnt, l_cols, r_sorted, nl, howi, cap_out, cap_r
+        )
+        outs[fn.__name__] = (
+            [(np.asarray(d), None if v is None else np.asarray(v)) for d, v in cols],
+            int(n_out),
+        )
+    return outs, total
+
+
+def _rows(cols, n):
+    """Set-comparable row tuples (validity-aware)."""
+    out = []
+    for i in range(n):
+        row = []
+        for d, v in cols:
+            ok = True if v is None else bool(v[i])
+            row.append(None if not ok else d[i].item())
+        out.append(tuple(row))
+    return sorted(out, key=repr)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+@pytest.mark.parametrize("n_l,n_r,keyspace", [(300, 200, 40), (64, 64, 5), (5, 300, 3)])
+def test_windowed_emit_matches_gather_emit(rng, how, n_l, n_r, keyspace):
+    outs, total = _emit_pair(rng, how, n_l, n_r, keyspace)
+    (a_cols, a_n), (b_cols, b_n) = outs.values()
+    assert a_n == b_n == total
+    assert _rows(a_cols, a_n) == _rows(b_cols, b_n)
+
+
+def test_windowed_emit_validity_and_f64(rng):
+    outs, total = _emit_pair(
+        rng, "left", 200, 150, 30, with_valid=True, with_f64=True
+    )
+    (a_cols, a_n), (b_cols, b_n) = outs.values()
+    assert a_n == b_n == total
+    assert _rows(a_cols, a_n) == _rows(b_cols, b_n)
+
+
+def test_windowed_emit_empty_left(rng):
+    outs, total = _emit_pair(rng, "inner", 0, 50, 5)
+    (a_cols, a_n), (b_cols, b_n) = outs.values()
+    assert a_n == b_n == total == 0

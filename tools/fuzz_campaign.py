@@ -100,6 +100,19 @@ def check(got_df, want_df, what, params):
 MAX_N = 400
 
 
+def expected_join(ldf, rdf, how):
+    """pandas oracle for our join output schema: both key columns kept
+    (k_x/k_y), with the unmatched side's key nulled on outer rows — ONE
+    definition shared by every fuzz profile so the oracles cannot drift."""
+    want = ldf.merge(rdf, on="k", how=how)
+    want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
+    if how in ("left", "outer"):
+        want.loc[want["w"].isna() & ~want["k_x"].isin(rdf["k"]), "k_y"] = None
+    if how in ("right", "outer"):
+        want.loc[want["v"].isna() & ~want["k_y"].isin(ldf["k"]), "k_x"] = None
+    return want
+
+
 def skew_round_once(seed) -> bool:
     """Hard-mode adversarial-skew round (VERDICT r3 item 8): ONE key owns
     ~50% of the rows on both sides, world in {4, 8}, and the fused join runs
@@ -130,12 +143,7 @@ def skew_round_once(seed) -> bool:
     capf = float(rng.choice([0.125, 0.25, 0.5]))
     resp = int(rng.choice([0, 1, 2, 3]))
     for how in ("inner", "left", "right", "outer"):
-        want = ldf.merge(rdf, on="k", how=how)
-        want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
-        if how in ("left", "outer"):
-            want.loc[want["w"].isna() & ~want["k_x"].isin(rdf["k"]), "k_y"] = None
-        if how in ("right", "outer"):
-            want.loc[want["v"].isna() & ~want["k_y"].isin(ldf["k"]), "k_x"] = None
+        want = expected_join(ldf, rdf, how)
         got = lt.distributed_join(
             rt, on="k", how=how, mode="fused",
             capacity_factor=capf, respill=resp, max_retries=6,
@@ -179,12 +187,7 @@ def round_once(seed) -> bool:
 
     # joins: pandas matches None/NaN keys like values in merge object cols
     for how in ("inner", "left", "right", "outer"):
-        want = ldf.merge(rdf, on="k", how=how)
-        want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
-        if how in ("left", "outer"):
-            want.loc[want["w"].isna() & ~want["k_x"].isin(rdf["k"]), "k_y"] = None
-        if how in ("right", "outer"):
-            want.loc[want["v"].isna() & ~want["k_y"].isin(ldf["k"]), "k_x"] = None
+        want = expected_join(ldf, rdf, how)
         for mode in ("eager", "fused"):
             got = lt.distributed_join(rt, on="k", how=how, mode=mode).to_pandas()
             ok &= check(got, want, f"join/{how}/{mode}", params)

@@ -1,14 +1,21 @@
 #!/bin/bash
 # TPU tunnel watchdog: probe every PERIOD seconds; when the tunnel answers,
-# capture the full TPU evidence chain in priority order:
-#   1. bench.py            -> BENCH_TPU_attempt.json (the round-3 must-have)
-#   2. run_bench.py        -> BENCH_TPU.md regenerated on current kernels
-#                             (+ roofline pct_membw), JSON lines kept too
-#   3. pallas_bench.py     -> sort-based vs pallas head-to-head row
-# Exits after step 1 succeeds at least once AND steps 2-3 have been tried.
+# capture the full round-4 TPU evidence chain in priority order:
+#   1. bench.py             -> BENCH_TPU_attempt.json (the driver must-have)
+#   2. gather_ab.py         -> emit-impl decision (windowed pallas vs XLA
+#                              gather) at 16M rows — VERDICT r4 item 1
+#   2b. bench.py (windowed) -> if the windowed emit wins, recapture the
+#                              headline under CYLON_TPU_EMIT_IMPL=windowed
+#                              (best-capture logic keeps the faster one)
+#   3. run_bench.py cold+warm -> BENCH_TPU.md regenerated on current
+#                              kernels + roofline pct_membw (VERDICT item 2)
+#   4. pallas_bench.py      -> sort-based vs pallas head-to-head row
+#   5. micro_bench.py       -> repeat/segsum impl rows
+# Exits after step 1 succeeds at least once AND steps 2-5 have been tried.
 # Single TPU client at a time: this loop is the only prober while it runs.
 PERIOD=${PERIOD:-600}
 LOG=/root/repo/.tpu_watchdog.log
+JSONL=BENCH_TPU_r04.jsonl
 cd /root/repo
 while true; do
   echo "$(date -u +%FT%TZ) probe" >> "$LOG"
@@ -17,27 +24,42 @@ while true; do
     BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 timeout 1200 python bench.py >> "$LOG" 2>&1
     if [ -f BENCH_TPU_attempt.json ]; then
       echo "$(date -u +%FT%TZ) captured BENCH_TPU_attempt.json" >> "$LOG"
-      echo "$(date -u +%FT%TZ) step 2: run_bench suite (cold compile)" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 2: gather A/B (emit impl decision)" >> "$LOG"
+      GAB_OUT=$(mktemp)
+      BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+        timeout 3600 python benchmarks/gather_ab.py --rows 16000000 \
+        > "$GAB_OUT" 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) gather_ab rc=$?" >> "$LOG"
+      cat "$GAB_OUT" >> "$JSONL"
+      # verdict scoped to THIS run's output: the jsonl appends across
+      # watchdog invocations, so grepping its tail could act on a stale
+      # verdict from a previous run
+      if grep -q '"verdict": "windowed"' "$GAB_OUT"; then
+        echo "$(date -u +%FT%TZ) step 2b: windowed emit wins - headline recapture" >> "$LOG"
+        CYLON_TPU_EMIT_IMPL=windowed BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+          timeout 1200 python bench.py >> "$LOG" 2>&1
+      fi
+      echo "$(date -u +%FT%TZ) step 3: run_bench suite (cold compile)" >> "$LOG"
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_HBM_GBPS=819 \
         timeout 5400 python benchmarks/run_bench.py --rows 4000000 --reps 3 \
         --compile-gate 0 \
-        > BENCH_TPU_r03.jsonl 2>> "$LOG"
+        >> "$JSONL" 2>> "$LOG"
       echo "$(date -u +%FT%TZ) run_bench cold rc=$?" >> "$LOG"
-      echo "$(date -u +%FT%TZ) step 2b: run_bench again (cache-warm compile -> BENCH_TPU.md)" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 3b: run_bench again (cache-warm compile -> BENCH_TPU.md)" >> "$LOG"
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_HBM_GBPS=819 \
         timeout 5400 python benchmarks/run_bench.py --rows 4000000 --reps 3 \
         --compile-gate 30 --out BENCH_TPU.md \
-        >> BENCH_TPU_r03.jsonl 2>> "$LOG"
+        >> "$JSONL" 2>> "$LOG"
       echo "$(date -u +%FT%TZ) run_bench warm rc=$? (gate: <30s with cache)" >> "$LOG"
-      echo "$(date -u +%FT%TZ) step 3: pallas head-to-head" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 4: pallas head-to-head" >> "$LOG"
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
         timeout 2400 python benchmarks/pallas_bench.py --rows 4000000 \
-        >> BENCH_TPU_r03.jsonl 2>> "$LOG"
+        >> "$JSONL" 2>> "$LOG"
       echo "$(date -u +%FT%TZ) pallas rc=$?" >> "$LOG"
-      echo "$(date -u +%FT%TZ) step 4: repeat-impl micro bench" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 5: repeat-impl micro bench" >> "$LOG"
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
         timeout 2400 python benchmarks/micro_bench.py --rows 16000000 \
-        >> BENCH_TPU_r03.jsonl 2>> "$LOG"
+        >> "$JSONL" 2>> "$LOG"
       echo "$(date -u +%FT%TZ) micro rc=$? - watchdog done" >> "$LOG"
       exit 0
     fi
