@@ -55,7 +55,6 @@ def main():
     import jax.numpy as jnp
 
     from cylon_tpu.ops import join as _j
-    from cylon_tpu.ops.gather import pack_gather
     from cylon_tpu.ops.pallas_gather import expand_rows
 
     platform = jax.devices()[0].platform

@@ -62,9 +62,13 @@ from bench import fence as _sync  # noqa: E402
 def _roofline_recorded(extra: dict, hbm: float, measured_s: float, op) -> None:
     """%membw for an EAGER op chain: record every kernel dispatch during one
     warm call (engine.record_kernels) and sum the traced models — the model
-    covers exactly the programs the op executed."""
-    if hbm <= 0:
-        return
+    covers exactly the programs the op executed.
+
+    Collective-volume accounting (collectives / collective_mb) is attached
+    even with hbm<=0: the traced byte counts are platform-independent, and
+    per-world collective volume is the quantity that predicts real ICI
+    scaling from a virtual-CPU-mesh run. Only the bandwidth-relative
+    numbers (model_s, pct_membw) need the real chip's hbm."""
     try:
         from benchmarks.roofline import Report, analyze, model_seconds, pct_membw
         from cylon_tpu import engine
@@ -88,8 +92,11 @@ def _roofline_recorded(extra: dict, hbm: float, measured_s: float, op) -> None:
             total.elementwise_bytes += rep.elementwise_bytes
             total.collective_bytes += rep.collective_bytes
             total.collective_count += rep.collective_count
-        extra["model_s"] = round(model_seconds(total, hbm), 4)
-        extra["pct_membw"] = round(100 * pct_membw(total, measured_s, hbm), 1)
+        if hbm > 0:
+            extra["model_s"] = round(model_seconds(total, hbm), 4)
+            extra["pct_membw"] = round(
+                100 * pct_membw(total, measured_s, hbm), 1
+            )
         extra["kernels"] = len(kernels)
         # bytes-over-ICI accounting (per op): the collective volume the
         # op ships across the mesh + how many collectives it issues
@@ -344,17 +351,25 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         for i in range(0, ooc_n, chunk_rows):
             yield {"k": k[i : i + chunk_rows], vname: v[i : i + chunk_rows]}
 
+    runs = []  # (wall_s, cost_split) per call: split must match the best rep
+
     def ooc():
+        t0 = time.perf_counter()
         job = OutOfCoreJoin(ctx, on="k", how="inner", num_buckets=16)
         sink = job.execute(chunks(lk, lv, "v"), chunks(rk, rv, "w"))
+        runs.append((time.perf_counter() - t0, job.cost_split))
         return sink.rows
 
     s, c = _bench(ooc, max(1, reps - 1))
     # gate_exempt: first-call time here is a full host-bound streaming run
     # (16 spills + 16 joins), not XLA compile tax — the compile gate would
-    # misfire on runtime
+    # misfire on runtime. cost_split: per-phase walls of the BEST rep (the
+    # run warm_s describes) — the transfer phases (spill_fetch/drain_fetch)
+    # are what a remote tunnel inflates; their share is the tunnel-free
+    # projection evidence.
+    best_split = min(runs[1:] or runs, key=lambda t: t[0])[1]
     record("ooc_join_16chunks", s, c, 2 * ooc_n, world,
-           {"chunk_rows": chunk_rows, "gate_exempt": True})
+           {"chunk_rows": chunk_rows, "gate_exempt": True, **best_split})
 
     # ---- scaling sweep: strong scaling of the distributed join -------------
     if scaling and world > 1:
@@ -390,13 +405,23 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
 def to_markdown(results, header: str) -> str:
     lines = [header, "",
-             "| benchmark | world | rows | warm s | compile s | rows/s | vs_baseline | %membw |",
-             "|---|---|---|---|---|---|---|---|"]
+             "| benchmark | world | rows | warm s | compile s | rows/s | vs_baseline | %membw | colls | coll MB | coll B/row |",
+             "|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in results:
+        # collective volume per world size: the quantity that predicts real
+        # ICI scaling (VERDICT r3 weak point 6 — virtual-CPU-mesh wall time
+        # does not)
+        cmb = r.get("collective_mb", "")
+        cbr = (
+            round(1e6 * r["collective_mb"] / max(r["rows"], 1), 1)
+            if isinstance(cmb, (int, float))
+            else ""
+        )
         lines.append(
             f"| {r['benchmark']} | {r['world']} | {r['rows']:,} | {r['warm_s']} "
             f"| {r['compile_s']} | {r['rows_per_sec']:,} | {r.get('vs_baseline', '')} "
-            f"| {r.get('pct_membw', '')} |"
+            f"| {r.get('pct_membw', '')} | {r.get('collectives', '')} "
+            f"| {cmb} | {cbr} |"
         )
     return "\n".join(lines) + "\n"
 

@@ -179,7 +179,19 @@ def unify_dictionaries(a: Column, b: Column) -> tuple[np.ndarray, np.ndarray, np
     Needed before any cross-table comparison/hash of string columns: each
     table encodes its strings against its own dictionary; the union keeps the
     sorted invariant so code order remains value order.
+
+    Both dictionaries are sorted and unique (the Column invariant), so the
+    native two-pointer merge (native/runtime.cpp ct_dict_union_u32) computes
+    union + both remaps in O(Da+Db) — at high-cardinality string-join scale
+    np.union1d's concat + full host sort is the measured bottleneck this
+    avoids. Falls back to numpy when the native lib is unavailable or the
+    dictionaries aren't plain 'U' arrays.
     """
+    from . import native
+
+    got = native.dict_union(np.asarray(a.dictionary), np.asarray(b.dictionary))
+    if got is not None:
+        return got
     union = np.union1d(a.dictionary, b.dictionary)
     map_a = np.searchsorted(union, a.dictionary).astype(np.int32)
     map_b = np.searchsorted(union, b.dictionary).astype(np.int32)

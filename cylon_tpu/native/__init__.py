@@ -185,6 +185,13 @@ def _bind(lib):
         c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_uint32,
         c.POINTER(c.c_uint32),
     ]
+    lib.ct_dict_union_u32.restype = c.c_int64
+    lib.ct_dict_union_u32.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32,
+        c.c_void_p, c.c_int64, c.c_int32,
+        c.c_void_p, c.c_int32,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+    ]
     return lib
 
 
@@ -461,3 +468,30 @@ def write_csv(
     )
     if rc != 0:
         raise IOError(f"native csv write failed (rc={rc})")
+
+
+def dict_union(a: np.ndarray, b: np.ndarray):
+    """Merge-union of two SORTED unique numpy unicode arrays via the native
+    two-pointer merge (runtime.cpp ct_dict_union_u32): O(Da+Db) vs
+    np.union1d's concat + full sort. Returns (union, map_a, map_b) or None
+    when the native lib is unavailable / dtypes aren't plain 'U'."""
+    lib = get_lib()
+    if lib is None or a.dtype.kind != "U" or b.dtype.kind != "U":
+        return None
+    da, db = len(a), len(b)
+    wa = max(a.dtype.itemsize // 4, 1)
+    wb = max(b.dtype.itemsize // 4, 1)
+    wu = max(wa, wb)
+    a_c = np.ascontiguousarray(a)
+    b_c = np.ascontiguousarray(b)
+    out = np.zeros(max(da + db, 1), dtype=f"<U{wu}")
+    map_a = np.empty(max(da, 1), np.int32)
+    map_b = np.empty(max(db, 1), np.int32)
+    n = lib.ct_dict_union_u32(
+        a_c.ctypes.data_as(ctypes.c_void_p), da, wa,
+        b_c.ctypes.data_as(ctypes.c_void_p), db, wb,
+        out.ctypes.data_as(ctypes.c_void_p), wu,
+        map_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        map_b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out[:n], map_a[:da], map_b[:db]

@@ -168,3 +168,68 @@ void ct_murmur3_batch(const char* bytes, const int64_t* offsets, int64_t n,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Sorted-dictionary union (cylon_tpu/column.unify_dictionaries native path).
+//
+// Numpy 'U' (UCS4 fixed-width) arrays compare like python strings: code
+// points in order, shorter string first on a shared prefix; trailing NUL
+// chars are padding. The two inputs are each sorted and duplicate-free (the
+// Column dictionary invariant), so the union is ONE two-pointer merge —
+// O(Da + Db) character compares vs np.union1d's concat + full sort. At the
+// 10B-row north star a high-cardinality string join's dictionary union is
+// the host-side bottleneck this replaces (reference analog: the string-key
+// hash partition path, arrow/arrow_partition_kernels.cpp:243-305, which
+// never needs a union because Arrow carries raw strings — our codes are
+// order-preserving, which IS the point of the sorted dictionary).
+// ---------------------------------------------------------------------------
+extern "C" {
+
+static inline int ct_ucs4_cmp(const uint32_t* x, int32_t wx,
+                              const uint32_t* y, int32_t wy) {
+  int32_t w = wx < wy ? wx : wy;
+  for (int32_t i = 0; i < w; ++i) {
+    if (x[i] != y[i]) return x[i] < y[i] ? -1 : 1;
+  }
+  for (int32_t i = w; i < wx; ++i)
+    if (x[i]) return 1;  // x longer: y is a strict prefix -> y < x
+  for (int32_t i = w; i < wy; ++i)
+    if (y[i]) return -1;
+  return 0;
+}
+
+// Merge-union two sorted unique UCS4 arrays. out_union must hold
+// (da + db) * wu uint32 (zero-filled by the callee per element); wu >=
+// max(wa, wb). map_a[i] / map_b[j] receive each input entry's index in the
+// union. Returns the union size.
+int64_t ct_dict_union_u32(const uint32_t* a, int64_t da, int32_t wa,
+                          const uint32_t* b, int64_t db, int32_t wb,
+                          uint32_t* out_union, int32_t wu,
+                          int32_t* map_a, int32_t* map_b) {
+  int64_t ia = 0, ib = 0, u = 0;
+  while (ia < da || ib < db) {
+    int c;
+    if (ia >= da) c = 1;
+    else if (ib >= db) c = -1;
+    else c = ct_ucs4_cmp(a + ia * wa, wa, b + ib * wb, wb);
+    uint32_t* dst = out_union + u * wu;
+    if (c <= 0) {
+      const uint32_t* src = a + ia * wa;
+      int32_t i = 0;
+      for (; i < wa; ++i) dst[i] = src[i];
+      for (; i < wu; ++i) dst[i] = 0;
+      map_a[ia++] = (int32_t)u;
+      if (c == 0) map_b[ib++] = (int32_t)u;
+    } else {
+      const uint32_t* src = b + ib * wb;
+      int32_t i = 0;
+      for (; i < wb; ++i) dst[i] = src[i];
+      for (; i < wu; ++i) dst[i] = 0;
+      map_b[ib++] = (int32_t)u;
+    }
+    ++u;
+  }
+  return u;
+}
+
+}  // extern "C"

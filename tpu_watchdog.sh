@@ -60,7 +60,12 @@ while true; do
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
         timeout 2400 python benchmarks/micro_bench.py --rows 16000000 \
         >> "$JSONL" 2>> "$LOG"
-      echo "$(date -u +%FT%TZ) micro rc=$? - watchdog done" >> "$LOG"
+      echo "$(date -u +%FT%TZ) micro rc=$?" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 6: string-key join (high cardinality)" >> "$LOG"
+      BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+        timeout 2400 python benchmarks/string_join_bench.py --rows 16000000 \
+        >> "$JSONL" 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) string rc=$? - watchdog done" >> "$LOG"
       exit 0
     fi
     echo "$(date -u +%FT%TZ) bench.py failed; will retry next cycle" >> "$LOG"
