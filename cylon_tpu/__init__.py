@@ -24,6 +24,17 @@ _platform = os.environ.get("CYLON_TPU_PLATFORM")
 if _platform:
     jax.config.update("jax_platforms", _platform)
 
+# Optional cold-compile/exec-speed tradeoff (XLA:TPU scheduling effort;
+# benchmarks/compile_profile.py measures the tradeoff at the headline
+# shape). CYLON_TPU_COMPILE_EFFORT=-1.0 compiles fastest; unset keeps
+# XLA's default. The reference pays its optimization once at native build
+# time — this is the knob for users who'd rather pay less per first-touch
+# shape.
+_effort = os.environ.get("CYLON_TPU_COMPILE_EFFORT")
+if _effort:
+    jax.config.update("jax_exec_time_optimization_effort", float(_effort))
+    jax.config.update("jax_memory_fitting_effort", float(_effort))
+
 from . import dtypes  # noqa: E402
 from .column import Column  # noqa: E402
 from .config import (  # noqa: E402

@@ -475,8 +475,15 @@ def dict_union(a: np.ndarray, b: np.ndarray):
     two-pointer merge (runtime.cpp ct_dict_union_u32): O(Da+Db) vs
     np.union1d's concat + full sort. Returns (union, map_a, map_b) or None
     when the native lib is unavailable / dtypes aren't plain 'U'."""
-    lib = get_lib()
-    if lib is None or a.dtype.kind != "U" or b.dtype.kind != "U":
+    if a.dtype.kind != "U" or b.dtype.kind != "U":
+        return None
+    # small unions: never trigger a first-use g++ build on the join hot
+    # path (the murmur3_strings convention); big unions amortize the
+    # one-time build against np.union1d's O(n log n) host sort
+    lib = (
+        get_lib_if_loaded() if len(a) + len(b) < 100_000 else get_lib()
+    )
+    if lib is None:
         return None
     da, db = len(a), len(b)
     wa = max(a.dtype.itemsize // 4, 1)
@@ -494,4 +501,9 @@ def dict_union(a: np.ndarray, b: np.ndarray):
         map_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         map_b.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
-    return out[:n], map_a[:da], map_b[:db]
+    union = out[:n]
+    if n < 0.9 * (da + db):
+        # a view would pin the full (da+db)-slot buffer for the lifetime of
+        # the unified dictionary; copy when the slack is material
+        union = union.copy()
+    return union, map_a[:da], map_b[:db]

@@ -178,7 +178,6 @@ class BucketJoinOp(Op):
         # TWO result tables (+ join intermediates) — still ~total/K, the
         # out-of-core guarantee, just double-buffered on both sides.
         drain_slots = threading.Semaphore(2)
-        futures: List[concurrent.futures.Future] = []
         fut_caps: List[Tuple[concurrent.futures.Future, int]] = []
         ex = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ooc_drain"
@@ -224,11 +223,9 @@ class BucketJoinOp(Op):
                 drain_slots.acquire()  # bound undrained device results
                 self._emit(out)
                 del out
-                fut = ex.submit(drain_task)
-                futures.append(fut)
-                fut_caps.append((fut, cap_out))
+                fut_caps.append((ex.submit(drain_task), cap_out))
         finally:
-            for f in futures:
+            for f, _cap in fut_caps:
                 f.result()  # propagate drain-thread exceptions
             ex.shutdown(wait=True)
         self._drain_one()  # final sweep (anything emitted but unqueued)

@@ -39,6 +39,11 @@ while true; do
         CYLON_TPU_EMIT_IMPL=windowed BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
           timeout 1200 python bench.py >> "$LOG" 2>&1
       fi
+      echo "$(date -u +%FT%TZ) step 2c: cold-compile profile (8M headline shape)" >> "$LOG"
+      BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+        timeout 3600 python benchmarks/compile_profile.py --rows 8000000 \
+        >> "$JSONL" 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) compile_profile rc=$?" >> "$LOG"
       echo "$(date -u +%FT%TZ) step 3: run_bench suite (cold compile)" >> "$LOG"
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_HBM_GBPS=819 \
         timeout 5400 python benchmarks/run_bench.py --rows 4000000 --reps 3 \
