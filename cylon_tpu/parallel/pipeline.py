@@ -40,6 +40,46 @@ class ShardTable(NamedTuple):
     n: jax.Array  # scalar int32
 
 
+def _shuffle_rounds(
+    st: ShardTable,
+    cnt: jax.Array,
+    dest_fn,
+    world: int,
+    bucket_cap: int,
+    axis_name: str,
+    respill: int,
+) -> Tuple[ShardTable, jax.Array]:
+    """The shared respill-round loop: ``dest_fn(r) -> (dest, leftover)``
+    supplies each round's send slots (plain hash shuffle or one hash
+    slice of a SlicePlan); everything else — count exchange, packed
+    column exchange, mask accumulation, compaction, overflow psum — is
+    identical machinery and lives ONCE here."""
+    rounds = 1 + respill
+    parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
+    masks = []
+    total = jnp.int32(0)
+    leftover = jnp.int32(0)
+    for r in range(rounds):
+        dest, leftover = dest_fn(r)
+        recv_counts = _sh.exchange_counts(
+            _sh.round_counts(cnt, bucket_cap, r), axis_name
+        )
+        got = _sh.exchange_columns(st.cols, dest, world, bucket_cap, axis_name)
+        for ci, dv in enumerate(got):
+            parts[ci].append(dv)
+        mask_r, total_r = _sh.received_row_mask(recv_counts, world, bucket_cap)
+        masks.append(mask_r)
+        total = total + total_r
+    cols_cat = []
+    for ci, (_, valid) in enumerate(st.cols):
+        d = jnp.concatenate([p[0] for p in parts[ci]])
+        v = None if valid is None else jnp.concatenate([p[1] for p in parts[ci]])
+        cols_cat.append((d, v))
+    out_cols = _sh.compact_received(cols_cat, jnp.concatenate(masks))
+    overflow = jax.lax.psum(leftover, axis_name)
+    return ShardTable(tuple(out_cols), total), overflow
+
+
 def shuffle_shard(
     st: ShardTable,
     key_idx: Sequence[int],
@@ -61,30 +101,42 @@ def shuffle_shard(
     keys = [st.cols[i] for i in key_idx]
     pid = _p.hash_partition_ids(keys, st.n, world)
     cnt = _sh.bucket_counts(pid, world)
-    rounds = 1 + respill
-    parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
-    masks = []
-    total = jnp.int32(0)
-    leftover = jnp.int32(0)
-    for r in range(rounds):
-        dest, leftover = _sh.build_send_slots_round(pid, cnt, world, bucket_cap, r)
-        recv_counts = _sh.exchange_counts(
-            _sh.round_counts(cnt, bucket_cap, r), axis_name
-        )
-        got = _sh.exchange_columns(st.cols, dest, world, bucket_cap, axis_name)
-        for ci, dv in enumerate(got):
-            parts[ci].append(dv)
-        mask_r, total_r = _sh.received_row_mask(recv_counts, world, bucket_cap)
-        masks.append(mask_r)
-        total = total + total_r
-    cols_cat = []
-    for ci, (_, valid) in enumerate(st.cols):
-        d = jnp.concatenate([p[0] for p in parts[ci]])
-        v = None if valid is None else jnp.concatenate([p[1] for p in parts[ci]])
-        cols_cat.append((d, v))
-    out_cols = _sh.compact_received(cols_cat, jnp.concatenate(masks))
-    overflow = jax.lax.psum(leftover, axis_name)
-    return ShardTable(tuple(out_cols), total), overflow
+    return _shuffle_rounds(
+        st, cnt,
+        lambda r: _sh.build_send_slots_round(pid, cnt, world, bucket_cap, r),
+        world, bucket_cap, axis_name, respill,
+    )
+
+
+# slice bits live at hash_shift=24 (bits 24..31): shard pid uses the low
+# bits, the out-of-core bucket split uses bits 16..23 (bucket_pack
+# hash_shift=16, up to 256 buckets) — reusing shift 16 here would make
+# every ooc bucket land in ONE slice (bucket b fixes those bits), turning
+# K-1 slice rounds into empty work and the live one into guaranteed
+# capacity overflow. 8 bits also caps num_slices at 256.
+SLICE_HASH_SHIFT = 24
+MAX_SLICES = 256
+
+
+def sliced_shuffle_shard(
+    st: ShardTable,
+    plan: "_sh.SlicePlan",
+    slice_idx,
+    world: int,
+    bucket_cap: int,
+    axis_name: str,
+    respill: int = 1,
+) -> Tuple[ShardTable, jax.Array]:
+    """One hash-slice's shuffle, driven by the precomputed
+    :class:`shuffle.SlicePlan` (one combined sort serves every slice —
+    this adds only elementwise slot math + the exchanges). ``slice_idx``
+    may be a traced scalar: one compiled body serves all K slices."""
+    cnt = _sh.slice_counts(plan, slice_idx)
+    return _shuffle_rounds(
+        st, cnt,
+        lambda r: _sh.slice_round_dest(plan, slice_idx, bucket_cap, r),
+        world, bucket_cap, axis_name, respill,
+    )
 
 
 def join_shard(
@@ -127,37 +179,133 @@ def make_distributed_join_step(
     bucket_cap: int,
     join_cap: int,
     respill: int = 1,
+    num_slices: int = 1,
 ):
     """Build the jittable distributed-join step over the mesh.
 
     Signature of the returned fn (global, row-sharded arrays):
       (l_cols, l_counts[P], r_cols, r_counts[P]) ->
-      (out_cols [P*join_cap], out_counts [P], overflow [2P])
+      (out_cols [P*num_slices*join_cap], out_counts [P], overflow [2P])
     where overflow carries TWO lanes per shard — reshape(-1, 2) gives
     [:, 0] = rows the shuffle could not send (bucket_cap exceeded after all
-    respill rounds) and [:, 1] = join rows past join_cap (exact shortfall,
-    so a retry can size join_cap in one step).
+    respill rounds) and [:, 1] = join rows past the PER-SLICE join_cap
+    (exact shortfall, so a retry can size join_cap in one step).
+
+    ``num_slices = K > 1`` runs the join as K hash-slice rounds (PARITY.md
+    north-star lever 1): round k shuffles + joins only slice k's rows, so
+    every probe sort works on ~n/K elements — passes drop from log^2(n)
+    to log^2(n/K) while total shuffle volume is unchanged. The K slice
+    outputs are compacted to one live prefix with a single extra
+    sort+gather over the output. Requires world > 1 (the slice filter
+    rides the shuffle's send-slot builder).
 
     This is the whole reference DistributedJoin call stack (SURVEY.md §3.2)
     as ONE compiled XLA program: hash -> scatter -> all_to_all -> sort-join
     -> gather, with collectives over the mesh axis.
     """
     world = mesh.shape[axis_name]
+    if num_slices > 1 and world <= 1:
+        raise ValueError(
+            "num_slices > 1 requires a multi-device mesh (slice selection "
+            "rides the shuffle)"
+        )
+    if num_slices > MAX_SLICES:
+        raise ValueError(
+            f"num_slices is capped at {MAX_SLICES} (8 slice hash bits; "
+            "see SLICE_HASH_SHIFT)"
+        )
 
     def step(dp, rep):
         (l_cols, l_counts, r_cols, r_counts) = dp
-        lt = ShardTable(tuple(l_cols), l_counts[0])
-        rt = ShardTable(tuple(r_cols), r_counts[0])
-        if world > 1:
-            lt, ovl = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name, respill)
-            rt, ovr = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name, respill)
-        else:
-            ovl = ovr = jnp.int32(0)
-        jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
-        # overflow lanes: [shuffle rows unsent, join rows past join_cap] —
-        # the join lane is EXACT so a retry can size join_cap in one step
-        overflow = jnp.stack([ovl + ovr, ovj])
-        return list(jt.cols), jt.n.reshape(1), overflow
+        lt0 = ShardTable(tuple(l_cols), l_counts[0])
+        rt0 = ShardTable(tuple(r_cols), r_counts[0])
+        if world == 1:
+            jt, ovj = join_shard(lt0, rt0, l_key_idx, r_key_idx, how, join_cap)
+            overflow = jnp.stack([jnp.int32(0), ovj])
+            return list(jt.cols), jt.n.reshape(1), overflow
+        if num_slices == 1:
+            lt, ovl = shuffle_shard(
+                lt0, l_key_idx, world, bucket_cap, axis_name, respill
+            )
+            rt, ovr = shuffle_shard(
+                rt0, r_key_idx, world, bucket_cap, axis_name, respill
+            )
+            jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
+            overflow = jnp.stack([ovl + ovr, ovj])
+            return list(jt.cols), jt.n.reshape(1), overflow
+        # sliced: ONE combined (slice, pid) sort per side serves all K
+        # slice rounds (shuffle.SlicePlan), and ONE lax.scan body serves
+        # all K slices — program size and compile time stay O(1) in K
+        # (an unrolled loop would emit K copies of the shuffle + sort-join
+        # and 2K(1+respill) collectives in a single program)
+        plans = []
+        for st_, key_idx in ((lt0, l_key_idx), (rt0, r_key_idx)):
+            keys = [st_.cols[i] for i in key_idx]
+            pid = _p.hash_partition_ids(keys, st_.n, world)
+            sid = _p.hash_partition_ids(
+                keys, st_.n, num_slices, hash_shift=SLICE_HASH_SHIFT
+            )
+            plans.append(_sh.build_slice_plan(pid, sid, world, num_slices))
+        plan_l, plan_r = plans
+
+        valid_flags: list = []  # per-column validity presence (trace-time)
+
+        def slice_body(carry, s):
+            ov_sh, ov_j = carry
+            lt, ovl = sliced_shuffle_shard(
+                lt0, plan_l, s, world, bucket_cap, axis_name, respill
+            )
+            rt, ovr = sliced_shuffle_shard(
+                rt0, plan_r, s, world, bucket_cap, axis_name, respill
+            )
+            jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
+            # validity presence is a STATIC per-column property (identical
+            # across slices); scan traces this body once, so record it here
+            # and stack data always, validity lanes only where present
+            if not valid_flags:
+                valid_flags.extend(v is not None for _d, v in jt.cols)
+            ys = (
+                tuple(d for d, _v in jt.cols),
+                tuple(v for _d, v in jt.cols if v is not None),
+                jt.n,
+            )
+            return (ov_sh + ovl + ovr, jnp.maximum(ov_j, ovj)), ys
+
+        # the carry must match the body outputs' varying-manual-axes type
+        # under shard_map: mark the unvarying zero initializers as varying
+        # over the mesh axis
+        def _vary(x):
+            try:
+                return jax.lax.pcast(x, (axis_name,), to="varying")
+            except (AttributeError, TypeError):
+                return jax.lax.pvary(x, (axis_name,))
+
+        (ov_shuffle, ov_join), (ds, vs, ns) = jax.lax.scan(
+            slice_body,
+            (_vary(jnp.int32(0)), _vary(jnp.int32(0))),
+            jnp.arange(num_slices, dtype=jnp.int32),
+        )
+        # reassemble the [K, join_cap]-stacked outputs into flat columns and
+        # compact the K live prefixes into ONE (a segment mask + one stable
+        # sort + one packed gather — the only output-sized cost of slicing)
+        total = jnp.sum(ns).astype(jnp.int32)
+        seg_pos = jnp.tile(jnp.arange(join_cap, dtype=jnp.int32), num_slices)
+        seg_n = jnp.repeat(ns, join_cap)
+        mask = seg_pos < seg_n
+        cols_cat = []
+        vi = 0
+        for ci in range(len(ds)):
+            d = ds[ci].reshape(num_slices * join_cap)
+            if valid_flags[ci]:
+                v = vs[vi].reshape(num_slices * join_cap)
+                vi += 1
+            else:
+                v = None
+            cols_cat.append((d, v))
+        assert vi == len(vs)
+        out_cols = _sh.compact_received(cols_cat, mask)
+        overflow = jnp.stack([ov_shuffle, ov_join])
+        return list(out_cols), total.reshape(1), overflow
 
     return jax.jit(
         jax.shard_map(

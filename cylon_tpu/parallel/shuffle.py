@@ -16,7 +16,7 @@ Runs inside ``shard_map``; every function here is per-shard code.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +92,78 @@ def build_send_slots(
     """Round 0 of :func:`build_send_slots_round`: (dest, overflow) where
     overflow counts rows that did not fit their bucket."""
     return build_send_slots_round(pid, counts, num_partitions, bucket_cap, 0)
+
+
+class SlicePlan(NamedTuple):
+    """Precomputed state for hash-SLICED shuffles (PARITY.md north-star
+    lever 1): ONE stable sort by the combined (slice, pid) id serves every
+    slice round — per-slice send slots are derived with elementwise
+    arithmetic only, so K slices cost K exchanges but still just one
+    slot-building sort per table (a per-slice argsort would multiply the
+    shuffle's sort work by K and eat the probe-depth saving slicing
+    exists to buy)."""
+
+    order: jax.Array   # [cap] stable argsort of comb
+    scomb: jax.Array   # [cap] comb[order]
+    bounds: jax.Array  # [K*(world+1)+1] per-(slice,pid) starts (sorted space)
+    world: int
+    num_slices: int
+
+
+def build_slice_plan(
+    pid: jax.Array, sid: jax.Array, world: int, num_slices: int
+) -> SlicePlan:
+    """pid: [cap] target shard (padding = world); sid: [cap] hash slice
+    (padding = num_slices). comb = sid*(world+1)+pid sorts padding last."""
+    comb = (sid * jnp.int32(world + 1) + pid).astype(jnp.int32)
+    order = jnp.argsort(comb, stable=True).astype(jnp.int32)
+    scomb = comb[order]
+    qs = jnp.arange(num_slices * (world + 1) + 1, dtype=jnp.int32)
+    bounds = jnp.searchsorted(scomb, qs).astype(jnp.int32)
+    return SlicePlan(order, scomb, bounds, world, num_slices)
+
+
+def slice_counts(plan: SlicePlan, slice_idx) -> jax.Array:
+    """Per-target-pid counts [world] of slice ``slice_idx`` (traced ok)."""
+    world = plan.world
+    base = jnp.asarray(slice_idx, jnp.int32) * jnp.int32(world + 1)
+    starts = jax.lax.dynamic_slice(plan.bounds, (base,), (world,))
+    return jax.lax.dynamic_slice(plan.bounds, (base + 1,), (world,)) - starts
+
+
+def slice_round_dest(
+    plan: SlicePlan, slice_idx, bucket_cap: int, round_idx
+) -> Tuple[jax.Array, jax.Array]:
+    """(dest [cap], leftover) for one slice+round — the
+    :func:`build_send_slots_round` formula evaluated inside slice
+    ``slice_idx``'s contiguous span of the plan's sorted space. Rows of
+    other slices (and padding) get the dropped destination. Both
+    ``slice_idx`` and ``round_idx`` may be traced scalars, so ONE compiled
+    program serves every (slice, round)."""
+    world = plan.world
+    cap = plan.order.shape[0]
+    s = jnp.asarray(slice_idx, jnp.int32)
+    base = s * jnp.int32(world + 1)
+    starts = jax.lax.dynamic_slice(plan.bounds, (base,), (world,))
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    lo_s = starts[0]
+    hi_s = jax.lax.dynamic_slice(plan.bounds, (base + jnp.int32(world),), (1,))[0]
+    in_slice = (idx >= lo_s) & (idx < hi_s)
+    spid = jnp.clip(plan.scomb - base, 0, world - 1)
+    pos = idx - starts[spid]
+    r = jnp.asarray(round_idx, jnp.int32)
+    slot = pos - r * bucket_cap
+    ok = in_slice & (slot >= 0) & (slot < bucket_cap)
+    dest_sorted = jnp.where(
+        ok, spid * bucket_cap + slot, world * bucket_cap
+    )
+    dest = jnp.full((cap,), world * bucket_cap, jnp.int32).at[
+        plan.order
+    ].set(dest_sorted)
+    leftover = jnp.sum(
+        in_slice & (pos >= (r + 1) * bucket_cap)
+    ).astype(jnp.int32)
+    return dest, leftover
 
 
 def round_counts(counts: jax.Array, bucket_cap: int, round_idx) -> jax.Array:

@@ -1521,6 +1521,7 @@ class Table:
         capacity_factor: float = 2.0,
         max_retries: int = 3,
         respill: int = 1,
+        num_slices: int = 1,
         **_ignored,
     ) -> "Table":
         """shuffle->join as one XLA program (see distributed_join). One host
@@ -1530,7 +1531,12 @@ class Table:
         hotter than bucket_cap drains over (1+respill) rounds with no host
         sync; only a bucket past (1+respill)*bucket_cap triggers the
         host-level doubled-capacity retry. Raise it for known-skewed keys to
-        trade collective rounds for recompiles."""
+        trade collective rounds for recompiles.
+
+        ``num_slices`` = K > 1 runs K hash-slice rounds so each probe sort
+        sees ~n/K rows (log^2(n/K) passes — PARITY.md north-star lever 1).
+        Worth it when per-shard rows are large enough that sort depth
+        dominates; ignored on 1-device meshes (no shuffle to ride)."""
         from .parallel.pipeline import make_distributed_join_step
 
         ctx = self.ctx
@@ -1547,8 +1553,16 @@ class Table:
         respill = int(respill)
         if respill < 0:
             raise ValueError("respill must be >= 0")
+        num_slices = int(num_slices)
+        if num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+        if world <= 1:
+            num_slices = 1  # no shuffle for the slice filter to ride
         bucket_cap = round_cap(
-            int(capacity_factor * max(cap_l, cap_r) / max(world, 1))
+            int(
+                capacity_factor * max(cap_l, cap_r)
+                / max(world * num_slices, 1)
+            )
         )
         if world > 1:
             join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
@@ -1557,14 +1571,14 @@ class Table:
         for attempt in range(max_retries):
             key = (
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
-                bucket_cap, join_cap, respill,
+                bucket_cap, join_cap, respill, num_slices,
             ) + _j.impl_tag()
             cache = ctx.__dict__.setdefault("_jit_cache", {})
             step = cache.get(key)
             if step is None:
                 step = make_distributed_join_step(
                     ctx.mesh, ctx.axis_name, lk_idx, rk_idx, howi,
-                    bucket_cap, join_cap, respill,
+                    bucket_cap, join_cap, respill, num_slices,
                 )
                 cache[key] = step
             with span("join.fused", rows=int(self.row_count)):
@@ -1590,12 +1604,17 @@ class Table:
                 src_cols = list(left._columns.values()) + list(
                     right._columns.values()
                 )
-                return self._rebuild_cols(
-                    list(zip(out_names, src_cols)), out, nout_h, join_cap,
+                res = self._rebuild_cols(
+                    list(zip(out_names, src_cols)), out, nout_h,
+                    num_slices * join_cap,
                 )
+                # sliced runs allocate K*join_cap but fill ~the same rows a
+                # 1-slice run would: drop dead padding before returning
+                return res._maybe_compact(nout_h) if num_slices > 1 else res
             if ov_join >= 2**31 - 1:
-                # the pipeline's saturated wrap sentinel (pipeline.py:113-115):
-                # a shard's join count overflowed int32. Resizing to
+                # the pipeline's saturated wrap sentinel (the int32-wrap
+                # guard in pipeline.join_shard): a shard's join count
+                # overflowed int32. Resizing to
                 # join_cap + 2^31 would overflow the int32 iotas/allocation
                 # downstream, so diagnose cleanly instead of recompiling.
                 raise RuntimeError(

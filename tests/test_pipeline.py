@@ -380,6 +380,41 @@ def test_fused_join_skew_retries(ctx8, rng):
     assert np.isclose(fused["x"].sum(), exp["x"].sum())
 
 
+@pytest.mark.parametrize("num_slices", [2, 4])
+@pytest.mark.parametrize("how", ["inner", "left", "outer"])
+def test_fused_join_sliced_matches_eager(ctx8, rng, how, num_slices):
+    """K hash-slice rounds (PARITY.md north-star lever 1) must be exactly
+    the 1-slice result — slicing changes sort depth, never semantics."""
+    n = 700
+    a = pd.DataFrame({"k": rng.integers(0, 60, n).astype(np.int64),
+                      "x": rng.normal(size=n)})
+    b = pd.DataFrame({"k": rng.integers(0, 60, n // 2).astype(np.int64),
+                      "y": rng.normal(size=n // 2)})
+    ta, tb = ct.Table.from_pandas(ctx8, a), ct.Table.from_pandas(ctx8, b)
+    sliced = ta.distributed_join(
+        tb, on="k", how=how, mode="fused", num_slices=num_slices
+    ).to_pandas()
+    eager = ta.distributed_join(tb, on="k", how=how).to_pandas()
+    assert len(sliced) == len(eager) == len(a.merge(b, on="k", how=how))
+    pd.testing.assert_frame_equal(_msort(sliced), _msort(eager), check_dtype=False)
+
+
+def test_fused_join_sliced_skew_retries(ctx8, rng):
+    """Hot key + slices: the retry machinery must converge with slices on
+    (the hot key lands in ONE slice, concentrating its round)."""
+    n = 512
+    a = pd.DataFrame({"k": np.zeros(n, np.int64), "x": rng.normal(size=n)})
+    b = pd.DataFrame({"k": rng.integers(0, 4, 64).astype(np.int64),
+                      "y": rng.normal(size=64)})
+    ta, tb = ct.Table.from_pandas(ctx8, a), ct.Table.from_pandas(ctx8, b)
+    fused = ta.distributed_join(
+        tb, on="k", how="inner", mode="fused", num_slices=4, max_retries=6
+    ).to_pandas()
+    exp = a.merge(b, on="k")
+    assert len(fused) == len(exp)
+    assert np.isclose(fused["x"].sum(), exp["x"].sum())
+
+
 def test_fused_join_string_keys(world_ctx, rng):
     a = pd.DataFrame({"s": rng.choice(["aa", "bb", "cc", "dd"], 200),
                       "x": rng.normal(size=200)})

@@ -142,13 +142,18 @@ def skew_round_once(seed) -> bool:
     ok = True
     capf = float(rng.choice([0.125, 0.25, 0.5]))
     resp = int(rng.choice([0, 1, 2, 3]))
+    k_sl = int(rng.choice([1, 2, 4]))
     for how in ("inner", "left", "right", "outer"):
         want = expected_join(ldf, rdf, how)
         got = lt.distributed_join(
             rt, on="k", how=how, mode="fused",
             capacity_factor=capf, respill=resp, max_retries=6,
+            num_slices=k_sl,
         ).to_pandas()
-        ok &= check(got, want, f"skewjoin/{how}/capf{capf}/resp{resp}", params)
+        ok &= check(
+            got, want,
+            f"skewjoin/{how}/capf{capf}/resp{resp}/sl{k_sl}", params,
+        )
         # eager path under the same skew: multi-round _shuffle_impl drain
         got = lt.distributed_join(rt, on="k", how=how).to_pandas()
         ok &= check(got, want, f"skewjoin/{how}/eager", params)
