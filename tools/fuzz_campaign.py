@@ -220,6 +220,19 @@ def round_once(seed) -> bool:
     want = lt_pk.distributed_join(rt_pk, on="k", how="inner").to_pandas()
     ok &= check(got, want, "join/pallas_pk", params)
 
+    # windowed Pallas emit (interpret mode on the CPU mesh): every 5th
+    # round re-runs one join under CYLON_TPU_EMIT_IMPL=windowed — the
+    # env is read at trace time and impl_tag() keys the cache, so this
+    # compiles the windowed program fresh and full-content-compares it
+    if seed % 5 == 0:
+        os.environ["CYLON_TPU_EMIT_IMPL"] = "windowed"
+        try:
+            got = lt.distributed_join(rt, on="k", how="left").to_pandas()
+        finally:
+            os.environ.pop("CYLON_TPU_EMIT_IMPL", None)
+        ok &= check(got, expected_join(ldf, rdf, "left"),
+                    "join/windowed_emit", params)
+
     # set ops over the key column only
     lk, rk = lt.project(["k"]), rt.project(["k"])
     lkd = ldf[["k"]].drop_duplicates()
