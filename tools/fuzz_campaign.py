@@ -225,11 +225,16 @@ def round_once(seed) -> bool:
     # env is read at trace time and impl_tag() keys the cache, so this
     # compiles the windowed program fresh and full-content-compares it
     if seed % 5 == 0:
+        prev_emit = os.environ.get("CYLON_TPU_EMIT_IMPL")
         os.environ["CYLON_TPU_EMIT_IMPL"] = "windowed"
         try:
             got = lt.distributed_join(rt, on="k", how="left").to_pandas()
         finally:
-            os.environ.pop("CYLON_TPU_EMIT_IMPL", None)
+            # restore (not pop): an operator-level override must survive
+            if prev_emit is None:
+                os.environ.pop("CYLON_TPU_EMIT_IMPL", None)
+            else:
+                os.environ["CYLON_TPU_EMIT_IMPL"] = prev_emit
         ok &= check(got, expected_join(ldf, rdf, "left"),
                     "join/windowed_emit", params)
 
