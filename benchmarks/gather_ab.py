@@ -137,6 +137,12 @@ def main():
         ("emit_xla_gather_sorted", xla_gather_sorted, (ends, src_rows)),
         ("emit_windowed_take", expand_impl("take"), (cnt_dev, src_rows)),
         ("emit_windowed_onehot", expand_impl("onehot"), (cnt_dev, src_rows)),
+        ("emit_windowed_take_db", expand_impl("take_db"), (cnt_dev, src_rows)),
+        (
+            "emit_windowed_onehot_db",
+            expand_impl("onehot_db"),
+            (cnt_dev, src_rows),
+        ),
     ]:
         try:
             best, compile_s, chk = timed(fn, *args2)
@@ -194,16 +200,18 @@ def main():
         return best, int(tot)
 
     jg = run_join("gather", "gather")
-    os.environ["CYLON_TPU_EXPAND_GATHER"] = "take"
-    jw = run_join("windowed", "windowed_take")
-    os.environ["CYLON_TPU_EXPAND_GATHER"] = "onehot"
-    jo = run_join("windowed", "windowed_onehot")
+    variants = []
+    for gi in ("take", "onehot", "take_db", "onehot_db"):
+        os.environ["CYLON_TPU_EXPAND_GATHER"] = gi
+        variants.append(run_join("windowed", f"windowed_{gi}"))
     os.environ.pop("CYLON_TPU_EXPAND_GATHER", None)
-    for other in (jw, jo):
+    for other in variants:
         if jg and other:
             assert jg[1] == other[1], (jg, other)
 
-    best_w = min([x for x in (jw, jo) if x], default=None, key=lambda t: t[0])
+    best_w = min(
+        [x for x in variants if x], default=None, key=lambda t: t[0]
+    )
     if jg and best_w:
         print(json.dumps({
             "verdict": "windowed" if best_w[0] < jg[0] else "gather",

@@ -63,46 +63,127 @@ def _expand_kernel(
     impl: str,
 ):
     t = pl.program_id(0)
-    gi0 = t * np.int32(G)
-    start = gstarts_ref[gi0]
     # clamp so the DMA window stays inside the source; all index math below
     # re-clamps, so degenerate inputs (empty table: li == -1) stay in-bounds
     # and only produce garbage in rows the caller already knows are dead
-    start_c = jnp.clip(start, np.int32(0), np.int32(cap - win))
+    start_c = _tile_start(gstarts_ref, t, G, win, cap)
     copy = pltpu.make_async_copy(
         src_ref.at[:, pl.ds(start_c, win)], scratch_ref, sem
     )
     copy.start()
     copy.wait()
+    _compute_tile(
+        gstarts_ref, li_ref, out_ref, scratch_ref, t, start_c,
+        G=G, win=win, impl=impl,
+    )
+
+
+def _tile_start(gstarts_ref, t, G: int, win: int, cap: int):
+    start = gstarts_ref[t * np.int32(G)]
+    return jnp.clip(start, np.int32(0), np.int32(cap - win))
+
+
+def _group_gather(window, idx, impl: str):
+    """One 128-output gather from a [L, 128] VMEM window; local idx < 128."""
+    if impl == "take":
+        return jnp.take(window, idx, axis=1, indices_are_sorted=True)
+    # exact one-hot MXU gather: onehot[s, d] = (idx[d] == s); int32
+    # split into 16-bit halves keeps every matmul operand < 2^24,
+    # so the f32 products/sums are exact
+    iota = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
+    onehot = (iota == idx[None, :]).astype(jnp.float32)
+    hi = jax.lax.shift_right_logical(window, np.int32(16))
+    lo = window & np.int32(0xFFFF)
+    hi_g = jax.lax.dot(
+        hi.astype(jnp.float32), onehot, preferred_element_type=jnp.float32
+    )
+    lo_g = jax.lax.dot(
+        lo.astype(jnp.float32), onehot, preferred_element_type=jnp.float32
+    )
+    return (
+        jax.lax.shift_left(hi_g.astype(jnp.int32), np.int32(16))
+        | lo_g.astype(jnp.int32)
+    )
+
+
+def _compute_tile(
+    gstarts_ref, li_ref, out_ref, buf_ref, t, start_c, *,
+    G: int, win: int, impl: str,
+):
+    gi0 = t * np.int32(G)
     for g in range(G):  # static unroll: G is small (T/128)
         gs = gstarts_ref[gi0 + np.int32(g)]
         off = jnp.clip(gs - start_c, np.int32(0), np.int32(win - GROUP))
-        window = scratch_ref[:, pl.ds(off, GROUP)]  # [L, 128]
-        idx = li_ref[g, :] - start_c - off          # [128] local indices
+        window = buf_ref[:, pl.ds(off, GROUP)]  # [L, 128]
+        idx = li_ref[g, :] - start_c - off      # [128] local indices
         idx = jnp.clip(idx, np.int32(0), np.int32(GROUP - 1))
-        if impl == "take":
-            vals = jnp.take(window, idx, axis=1, indices_are_sorted=True)
-        else:
-            # exact one-hot MXU gather: onehot[s, d] = (idx[d] == s); int32
-            # split into 16-bit halves keeps every matmul operand < 2^24,
-            # so the f32 products/sums are exact
-            iota = jax.lax.broadcasted_iota(jnp.int32, (GROUP, GROUP), 0)
-            onehot = (iota == idx[None, :]).astype(jnp.float32)
-            hi = jax.lax.shift_right_logical(window, np.int32(16))
-            lo = window & np.int32(0xFFFF)
-            hi_g = jax.lax.dot(
-                hi.astype(jnp.float32), onehot,
-                preferred_element_type=jnp.float32,
-            )
-            lo_g = jax.lax.dot(
-                lo.astype(jnp.float32), onehot,
-                preferred_element_type=jnp.float32,
-            )
-            vals = (
-                jax.lax.shift_left(hi_g.astype(jnp.int32), np.int32(16))
-                | lo_g.astype(jnp.int32)
-            )
-        out_ref[:, g * GROUP : (g + 1) * GROUP] = vals
+        out_ref[:, g * GROUP : (g + 1) * GROUP] = _group_gather(
+            window, idx, impl
+        )
+
+
+def _expand_kernel_db(
+    gstarts_ref,
+    src_ref,
+    li_ref,
+    out_ref,
+    buf0_ref,
+    buf1_ref,
+    sem0,
+    sem1,
+    *,
+    G: int,
+    win: int,
+    cap: int,
+    impl: str,
+    n_tiles: int,
+):
+    """Double-buffered variant: tile t+1's window DMA is started BEFORE
+    tile t's compute, so transfer rides under the gather work. Two static
+    buffers selected by tile parity (a traced buffer index would need a
+    dynamic ref slice, which Mosaic dislikes); the compute body is shared
+    source (`_compute_tile`) instantiated per branch."""
+    t = pl.program_id(0)
+    even = (t % np.int32(2)) == np.int32(0)
+
+    def copy_for(tile, buf_ref, sem):
+        start_c = _tile_start(gstarts_ref, tile, G, win, cap)
+        return pltpu.make_async_copy(
+            src_ref.at[:, pl.ds(start_c, win)], buf_ref, sem
+        )
+
+    @pl.when(t == np.int32(0))
+    def _():
+        copy_for(np.int32(0), buf0_ref, sem0).start()
+
+    nxt = t + np.int32(1)
+    has_next = nxt < np.int32(n_tiles)
+
+    @pl.when(has_next & even)
+    def _():
+        copy_for(nxt, buf1_ref, sem1).start()
+
+    @pl.when(has_next & ~even)
+    def _():
+        copy_for(nxt, buf0_ref, sem0).start()
+
+    start_c = _tile_start(gstarts_ref, t, G, win, cap)
+
+    @pl.when(even)
+    def _():
+        copy_for(t, buf0_ref, sem0).wait()
+        _compute_tile(
+            gstarts_ref, li_ref, out_ref, buf0_ref, t, start_c,
+            G=G, win=win, impl=impl,
+        )
+
+    @pl.when(~even)
+    def _():
+        copy_for(t, buf1_ref, sem1).wait()
+        _compute_tile(
+            gstarts_ref, li_ref, out_ref, buf1_ref, t, start_c,
+            G=G, win=win, impl=impl,
+        )
 
 
 @functools.partial(
@@ -147,6 +228,33 @@ def expand_rows(
     li2d = li.reshape(n_tot // GROUP, GROUP)
     gstarts = li[:: GROUP]
 
+    if impl not in ("take", "onehot", "take_db", "onehot_db"):
+        # impl comes straight from an env var: a typo must not silently
+        # run a different kernel than the user believes they selected
+        raise ValueError(f"unknown expand impl {impl!r}")
+    db = impl.endswith("_db")
+    gather_impl = impl[:-3] if db else impl
+    if db:
+        # double-buffered: two window buffers + two DMA semaphores; tile
+        # t+1's copy rides under tile t's gather compute
+        scratch = [
+            pltpu.VMEM((L, win), jnp.int32),
+            pltpu.VMEM((L, win), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ]
+        kern = functools.partial(
+            _expand_kernel_db, G=G, win=win, cap=cap, impl=gather_impl,
+            n_tiles=n_tiles,
+        )
+    else:
+        scratch = [
+            pltpu.VMEM((L, win), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ]
+        kern = functools.partial(
+            _expand_kernel, G=G, win=win, cap=cap, impl=gather_impl
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
@@ -155,10 +263,7 @@ def expand_rows(
             pl.BlockSpec((G, GROUP), lambda t, g_ref: (t, np.int32(0))),
         ],
         out_specs=pl.BlockSpec((L, T), lambda t, g_ref: (np.int32(0), t)),
-        scratch_shapes=[
-            pltpu.VMEM((L, win), jnp.int32),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=scratch,
     )
     try:
         # under shard_map with vma checking the output must declare how it
@@ -168,9 +273,7 @@ def expand_rows(
     except (AttributeError, TypeError):
         out_shape = jax.ShapeDtypeStruct((L, n_tot), jnp.int32)
     out = pl.pallas_call(
-        functools.partial(
-            _expand_kernel, G=G, win=win, cap=cap, impl=impl
-        ),
+        kern,
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,

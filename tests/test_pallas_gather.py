@@ -16,7 +16,7 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-@pytest.mark.parametrize("impl", ["take", "onehot"])
+@pytest.mark.parametrize("impl", ["take", "onehot", "take_db", "onehot_db"])
 @pytest.mark.parametrize(
     "m,hot,T",
     [(700, 0, 512), (700, 3, 512), (3, 0, 512), (9000, 2, 2048)],
