@@ -283,6 +283,14 @@ class OutOfCoreJoin:
         self.ctx = ctx
         self.lp = SpillPartitionOp("spill_l", keys, num_buckets)
         self.rp = SpillPartitionOp("spill_r", keys, num_buckets)
+        # bucket joins stay EAGER by default: the fused path's speculative
+        # join_cap is a worst-case-receive capacity (~2*(1+respill)*input
+        # rows), which would inflate device residency ~8x past the
+        # out-of-core ~total/K guarantee. mode='fused' remains a caller
+        # override (ONE host sync per bucket pair instead of ~5) for
+        # deployments where sync latency outweighs the residency bound —
+        # the published cost_split (join_s vs *_fetch_s) is the evidence
+        # to decide with.
         self.join = BucketJoinOp(
             "bucket_join", ctx, self.lp, self.rp,
             on=on, how=how, **join_kwargs,

@@ -90,6 +90,21 @@ def test_ooc_device_cap_scales_with_buckets(ctx8):
     assert caps[16] < caps[8], caps
 
 
+def test_ooc_join_fused_override(ctx8):
+    """mode='fused' bucket joins (1 sync/bucket) stay correct — the
+    residency bound is deliberately NOT asserted here (the fused join's
+    speculative capacity trades the ~total/K guarantee for fewer syncs)."""
+    rng = np.random.default_rng(5)
+    n = 20_000
+    ldf = pd.DataFrame({"k": rng.integers(0, 4_000, n).astype(np.int32),
+                        "v": rng.normal(size=n).astype(np.float32)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 4_000, n).astype(np.int32),
+                        "w": rng.normal(size=n).astype(np.float32)})
+    job = OutOfCoreJoin(ctx8, on="k", how="inner", num_buckets=8, mode="fused")
+    sink = job.execute(_chunks(ldf, 4_000), _chunks(rdf, 4_000))
+    assert sink.rows == len(ldf.merge(rdf, on="k"))
+
+
 def test_ooc_join_empty_bucket_sides(ctx8):
     """Keys chosen so some buckets are one-sided or empty: inner join must
     skip them without error."""
