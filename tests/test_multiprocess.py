@@ -79,6 +79,12 @@ _WORKER = textwrap.dedent(
     j = ta.distributed_join(tb, on="k", how="inner")
     assert j.row_count == len(exp), (j.row_count, len(exp))
 
+    # fused + hash-sliced rounds across REAL process boundaries (the
+    # lax.scan body's collectives run over Gloo here)
+    jf = ta.distributed_join(tb, on="k", how="inner", mode="fused",
+                             num_slices=2)
+    assert jf.row_count == len(exp), (jf.row_count, len(exp))
+
     s = float(ta.sum("v"))
     assert np.isclose(s, gv.sum()), (s, gv.sum())
 
