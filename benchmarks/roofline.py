@@ -99,6 +99,22 @@ class Report:
 def _walk(jaxpr, rep: Report) -> None:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
+        if prim == "pallas_call":
+            # a hand-scheduled kernel: price it as STREAMED bytes (one read
+            # of inputs + one write of outputs — the windowed expand's DMA
+            # windows overlap-read ~3% extra, noise at this precision) and
+            # do NOT recurse into the kernel body: its jnp.take runs on
+            # VMEM-resident vregs, and pricing it at the HBM per-element
+            # gather rate (GATHER_PASS_EQ) would overstate traffic ~400x —
+            # beating that rate is the kernel's entire purpose
+            w = sum(
+                _nbytes(x.aval) for x in eqn.invars if hasattr(x, "aval")
+            ) + sum(
+                _nbytes(x.aval) for x in eqn.outvars if hasattr(x, "aval")
+            )
+            rep.elementwise_bytes += w
+            rep.by_prim[prim] = rep.by_prim.get(prim, 0.0) + w
+            continue
         # recurse into nested jaxprs (pjit/closed_call/scan/while/cond/
         # shard_map). A param may hold a raw Jaxpr (has .eqns) or a
         # ClosedJaxpr (has .jaxpr) — shard_map uses the former.

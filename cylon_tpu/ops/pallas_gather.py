@@ -226,7 +226,10 @@ def expand_rows(
     G = T // GROUP
     n_tiles = n_tot // T
     li2d = li.reshape(n_tot // GROUP, GROUP)
-    gstarts = li[:: GROUP]
+    # column 0 of the reshape, NOT li[::GROUP]: the strided slice lowers to
+    # a gather (which the roofline model prices at per-element rates and
+    # XLA executes as one), the column slice to a plain slice
+    gstarts = li2d[:, 0]
 
     if impl not in ("take", "onehot", "take_db", "onehot_db"):
         # impl comes straight from an env var: a typo must not silently
