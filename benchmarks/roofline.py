@@ -101,12 +101,17 @@ def _walk(jaxpr, rep: Report) -> None:
         prim = eqn.primitive.name
         if prim == "pallas_call":
             # a hand-scheduled kernel: price it as STREAMED bytes (one read
-            # of inputs + one write of outputs — the windowed expand's DMA
-            # windows overlap-read ~3% extra, noise at this precision) and
-            # do NOT recurse into the kernel body: its jnp.take runs on
-            # VMEM-resident vregs, and pricing it at the HBM per-element
-            # gather rate (GATHER_PASS_EQ) would overstate traffic ~400x —
-            # beating that rate is the kernel's entire purpose
+            # of inputs + one write of outputs) and do NOT recurse into the
+            # kernel body: its jnp.take runs on VMEM-resident vregs, and
+            # pricing it at the HBM per-element gather rate
+            # (GATHER_PASS_EQ) would overstate traffic ~400x — beating that
+            # rate is the kernel's entire purpose. Known bias: the windowed
+            # expand actually DMAs ~1.03 * L * n_out bytes of window READS
+            # (output-proportional), while this prices reads at L * cap —
+            # in heavy-repeat regimes (n_out >> cap) actual read traffic
+            # exceeds the model by up to ~2x, so a low measured %membw on
+            # expand-heavy ops partly reflects window re-reads, not only
+            # dispatch overhead.
             w = sum(
                 _nbytes(x.aval) for x in eqn.invars if hasattr(x, "aval")
             ) + sum(
@@ -133,6 +138,15 @@ def _walk(jaxpr, rep: Report) -> None:
                     sub = _sub(vi)
                     if sub is not None:
                         _walk(sub, rep)
+        if prim in (
+            "pjit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+            "shard_map", "cond", "scan", "while", "remat", "checkpoint",
+        ):
+            # container primitives: their bodies were just recursed into;
+            # adding the container's own in/out bytes would double-count
+            # every jit/shard_map boundary. (Loop bodies are still counted
+            # ONCE — a known undercount for multi-iteration scans.)
+            continue
         in_bytes = sum(_nbytes(x.aval) for x in eqn.invars if hasattr(x, "aval"))
         out_bytes = sum(_nbytes(x.aval) for x in eqn.outvars if hasattr(x, "aval"))
         if prim in _SORT_PRIMS:

@@ -111,3 +111,45 @@ def test_model_seconds_scales_with_bandwidth():
     assert model_seconds(rep, 100.0) == pytest.approx(
         2 * model_seconds(rep, 200.0)
     )
+
+
+def test_pallas_call_priced_streamed_not_recursed():
+    """The model must price a pallas_call as one read + one write of its
+    operands and must NOT walk the kernel body (whose in-VMEM jnp.take
+    would otherwise be priced at the HBM per-element gather rate,
+    overstating kernel traffic ~400x)."""
+    from cylon_tpu.ops.pallas_gather import expand_available, expand_rows
+
+    if not expand_available():
+        pytest.skip("pallas unavailable")
+    import jax.numpy as jnp
+
+    m = 4000
+    src = jnp.asarray(np.arange(4 * m, dtype=np.int32).reshape(4, m))
+    li = jnp.asarray(np.repeat(np.arange(m), 2).astype(np.int32))
+    rep = analyze(
+        lambda s, l: expand_rows(s, l, impl="take", interpret=False), src, li
+    )
+    assert rep.gather_bytes == 0, rep.by_prim
+    assert "pallas_call" in rep.by_prim
+    # streamed pricing: same order as operand+output bytes, nowhere near
+    # the ~400x per-element-gather figure
+    raw = (4 * m + len(li) + 4 * len(li)) * 4
+    assert rep.by_prim["pallas_call"] < 3 * raw
+
+
+def test_container_prims_not_double_counted():
+    """pjit/shard_map containers recurse but must not add their own in/out
+    bytes on top of their bodies'."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    x = jnp.zeros((1024,), jnp.float32)
+    rep = analyze(f, x)
+    # one multiply: ~in+out = 8KB; a double-counted pjit boundary would
+    # add another ~8KB on top
+    assert rep.elementwise_bytes <= 3 * 8192, rep.elementwise_bytes
