@@ -313,3 +313,37 @@ def drop_na(table: Table, how: str = "any", axis: int = 0) -> Table:
                 drop.append(name)
         return table.drop(drop) if drop else table
     raise ValueError("axis must be 0 or 1")
+
+
+def compare_array_like_values(values, value_set, skip_null: bool = True):
+    """Membership of each element of ``values`` in ``value_set`` (reference
+    compute.pyx:compare_array_like_values — a SetLookup is_in over arrays).
+
+    Accepts array-likes (numpy/list/jax); returns a bool numpy array. The
+    vectorized sorted-probe design mirrors :func:`is_in` (no per-element
+    Python): sort the (deduplicated) value set once, searchsorted every
+    input element. ``skip_null``=True maps NaN/None inputs to False.
+    """
+    vals = np.asarray(values)
+    if vals.dtype == object or vals.dtype.kind in ("U", "S"):
+        def canon(v):
+            return v.decode(errors="replace") if isinstance(v, bytes) else str(v)
+
+        vs = np.asarray(
+            sorted(canon(v) for v in value_set if v is not None), dtype=object
+        )
+        probe = np.asarray([canon(v) for v in vals.tolist()], dtype=object)
+        out = np.isin(probe, vs)
+        if skip_null:
+            out &= np.array([v is not None for v in vals.tolist()])
+        return out
+    # _probe_targets (the is_in helper) skips None and drops set values the
+    # column dtype cannot represent exactly (1.5 must not truncate-match 1)
+    vs = _probe_targets(list(value_set), np.dtype(vals.dtype))
+    if len(vs) == 0:
+        return np.zeros(vals.shape, bool)
+    pos = np.clip(np.searchsorted(vs, vals), 0, len(vs) - 1)
+    out = vs[pos] == vals
+    if skip_null and vals.dtype.kind == "f":
+        out &= ~np.isnan(vals)
+    return np.asarray(out)

@@ -2499,6 +2499,51 @@ class Table:
             return RangeIndex(self.row_count)
         return ColumnIndex(self.index_name)
 
+    def get_index(self):
+        """Alias of :attr:`index` (reference table.pyx:2252 GetIndex)."""
+        return self.index
+
+    @property
+    def context(self) -> CylonContext:
+        """The mesh context (reference table.pyx ``context`` property)."""
+        return self.ctx
+
+    def isna(self) -> "Table":
+        """Alias of :meth:`isnull` (reference table.pyx isna)."""
+        return self.isnull()
+
+    def notna(self) -> "Table":
+        """Alias of :meth:`notnull` (reference table.pyx notna)."""
+        return self.notnull()
+
+    @staticmethod
+    def merge(tables: Sequence["Table"]) -> "Table":
+        """Row-stack same-schema tables (reference Table.merge,
+        table.pyx:2300-2330 / C++ Merge, table.cpp:267-289). Alias of
+        :meth:`Table.concat` axis=0 — one source of truth for the
+        single-table/validation handling."""
+        return Table.concat(tables, axis=0)
+
+    def to_csv(self, path, csv_write_options=None) -> None:
+        """Write CSV (reference table.pyx to_csv; per-rank when given a
+        list of world_size paths)."""
+        from .io.csv import write_csv
+
+        write_csv(self, path, csv_write_options)
+
+    def clear(self) -> None:
+        """Drop this table's column references (reference Table.Clear,
+        table.pyx:2290). Device buffers free once no other table shares
+        them — XLA buffers are refcounted, so there is no manual
+        retain/release cycle to manage (the reference's
+        retain_memory/is_retain have no analog: memory ownership is
+        always the runtime's)."""
+        self._columns = OrderedDict()
+        self._row_counts = np.zeros_like(self._row_counts)
+        self._counts_dev = None
+        self.index_name = None
+        self._built_index = None  # the loc cache pins host copies otherwise
+
     def build_index(self, kind: str = "hash"):
         """Build (once) and cache a value->positions lookup over the index
         column; subsequent ``loc`` calls reuse it (reference IndexUtil::Build

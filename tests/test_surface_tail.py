@@ -209,6 +209,44 @@ def test_csv_write_column_names(tmp_path, local_ctx):
         ct.write_csv(t, p, ct.CSVWriteOptions().with_column_names(["only_one"]))
 
 
+def test_table_alias_methods(local_ctx):
+    """Round-4 second surface pass: get_index/context/isna/notna/merge/
+    to_csv/clear (reference table.pyx method diff)."""
+    t = ct.Table.from_pydict(
+        local_ctx, {"a": np.array([1.0, np.nan]), "b": np.array([1, 2])}
+    )
+    assert t.context is t.ctx
+    assert t.get_index() is not None
+    assert t.isna().to_pandas()["a"].tolist() == [False, True]
+    assert t.notna().to_pandas()["a"].tolist() == [True, False]
+    m = ct.Table.merge([t.drop(["a"]), t.drop(["a"])])
+    assert m.row_count == 4
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        p = f"{tmp}/t.csv"
+        t.to_csv(p)
+        back = pd.read_csv(p)
+        assert list(back.columns) == ["a", "b"] and len(back) == 2
+    t.clear()
+    assert t.column_count == 0 and t.row_count == 0
+
+
+def test_compute_compare_array_like_values():
+    from cylon_tpu import compute
+
+    got = compute.compare_array_like_values(
+        np.array([1.0, 2.0, np.nan]), [2.0, 3.0]
+    )
+    assert got.tolist() == [False, True, False]
+    got = compute.compare_array_like_values(
+        np.array(["x", "y"], dtype=object), ["y", "z"]
+    )
+    assert got.tolist() == [False, True]
+    got = compute.compare_array_like_values(np.array([1, 2]), [])
+    assert got.tolist() == [False, False]
+
+
 def test_fused_join_respill_param(ctx8, rng):
     ldf = pd.DataFrame({"k": rng.integers(0, 50, 400).astype(np.int32),
                         "v": rng.normal(size=400).astype(np.float32)})

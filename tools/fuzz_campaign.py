@@ -370,14 +370,19 @@ def main():
         rounds += 1
         if rounds % 5 == 0:
             print(f"# {rounds} rounds, {failures} failures", flush=True)
-        if rounds % 10 == 0:
-            # every round compiles fresh program shapes; unbounded jit
-            # caches OOM'd LLVM after ~15 rounds — drop them periodically
+        # every round compiles fresh program shapes; unbounded jit caches
+        # OOM'd LLVM after ~15 rounds (and the skew profile — 4 hows x
+        # capacity/respill/slice variants with retries — after ~55: the
+        # r4 campaign died of 'LLVM compilation error: Cannot allocate
+        # memory' + SIGSEGV). Clear aggressively; compile time is not
+        # what a fuzz campaign optimizes for.
+        if rounds % (3 if args.profile == "skew" else 10) == 0:
             import jax
 
             jax.clear_caches()
             for c in CTXS.values():
                 c.__dict__.get("_jit_cache", {}).clear()
+                c.__dict__.get("_spec_cap_hints", {}).clear()
         seed += 1
     print(f"DONE rounds={rounds} failures={failures}", flush=True)
     sys.exit(1 if failures else 0)
