@@ -1,0 +1,69 @@
+"""Scale levers for very large joins: hash-slice rounds + skew knobs.
+
+The fused distributed join's ``num_slices=K`` runs K hash-slice rounds so
+each probe sort works on ~n/K rows (log^2(n/K) bitonic passes instead of
+log^2(n)) at unchanged shuffle volume — the lever PARITY.md's north-star
+projection quantifies for the 2x10B-row v4-32 target. ``respill`` absorbs
+hot-key skew inside the program (extra exchange rounds) before the
+host-level capacity retry has to recompile.
+
+Run locally on a virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    CYLON_TPU_PLATFORM=cpu python examples/scale_join.py
+
+On a TPU host just run it plain — the mesh is whatever jax.devices() gives
+(num_slices needs world > 1; on a 1-device mesh it degrades to a plain
+fused join).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+
+
+NUM_SLICES = 4
+
+
+def main():
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig())
+    rng = np.random.default_rng(0)
+    n = 200_000
+    orders = pd.DataFrame({
+        "cust": rng.integers(0, n // 4, n).astype(np.int32),
+        "price": rng.gamma(2.0, 50.0, n).astype(np.float32),
+    })
+    # a skewed dimension: one hot customer owns 20% of the rows
+    orders.loc[rng.random(n) < 0.2, "cust"] = 7
+    custs = pd.DataFrame({
+        "cust": np.arange(n // 4, dtype=np.int32),
+        "region": rng.integers(0, 50, n // 4).astype(np.int32),
+    })
+
+    t_orders = ct.Table.from_pandas(ctx, orders)
+    t_custs = ct.Table.from_pandas(ctx, custs)
+
+    joined = t_orders.distributed_join(
+        t_custs,
+        on="cust",
+        mode="fused",      # one XLA program, ONE host sync per attempt
+        num_slices=NUM_SLICES,  # K hash-slice rounds: probe sorts see ~n/K rows
+        respill=2,         # hot-key buckets drain over 3 in-program rounds
+    )
+    expect = orders.merge(custs, on="cust")
+    assert joined.row_count == len(expect), (joined.row_count, len(expect))
+
+    by_region = joined.distributed_groupby("region", {"price": "sum"})
+    print(
+        f"joined {joined.row_count:,} rows over {ctx.world_size} shards in "
+        f"{NUM_SLICES} slice rounds; {by_region.row_count} regions aggregated"
+    )
+
+
+if __name__ == "__main__":
+    main()
