@@ -92,6 +92,21 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _resolved_emit_impl(ctx) -> str:
+    """The emit impl the measured join ACTUALLY used (env request resolved
+    against the mesh — see ops.join.emit_impl_for)."""
+    try:
+        from cylon_tpu.ops.join import emit_impl_for
+
+        return emit_impl_for(
+            ctx.world_size, ctx.mesh.devices.flat[0].platform
+        )
+    except Exception:
+        import os
+
+        return os.environ.get("CYLON_TPU_EMIT_IMPL", "gather")
+
+
 def record_tpu_attempt(payload: dict) -> None:
     """Persist a timestamped copy of any successful TPU measurement so a
     mid-round number survives an end-of-round tunnel flake.
@@ -118,18 +133,29 @@ def record_tpu_attempt(payload: dict) -> None:
         stamped = dict(payload, captured_unix=now)
         best = stamped
         n_captures = 1
+        round_started = now
         try:
             with open(path) as f:
                 prev = json.load(f)
-            fresh = now - prev.get("captured_unix", 0) < 12 * 3600
+            # freshness anchors to the ROUND's first capture, not the best
+            # capture's own timestamp: a >12h round must not silently drop
+            # its best and restart the count mid-round
+            prev_round = int(
+                prev.get("round_started_unix", prev.get("captured_unix", 0))
+            )
+            fresh = now - prev_round < 12 * 3600
             same_cfg = prev.get("rows") == payload.get("rows")
             if fresh and same_cfg:
+                round_started = prev_round
                 n_captures = int(prev.get("captures_this_round", 1)) + 1
                 if prev.get("vs_baseline", 0) > payload.get("vs_baseline", 0):
                     best = {
                         k: v
                         for k, v in prev.items()
-                        if k not in ("latest", "captures_this_round")
+                        if k not in (
+                            "latest", "captures_this_round",
+                            "round_started_unix",
+                        )
                     }
         except Exception:
             # no/unreadable/foreign previous attempt (or non-dict JSON):
@@ -137,7 +163,12 @@ def record_tpu_attempt(payload: dict) -> None:
             # real TPU measurement would be replaced by the fail-soft
             # error line (record runs before emit)
             pass
-        out = dict(best, latest=stamped, captures_this_round=n_captures)
+        out = dict(
+            best,
+            latest=stamped,
+            captures_this_round=n_captures,
+            round_started_unix=round_started,
+        )
         with open(path, "w") as f:
             json.dump(out, f)
             f.write("\n")
@@ -309,10 +340,12 @@ def main():
         "vs_baseline": round(rate / BASELINE_ROWS_PER_SEC, 3),
         "warm_s": round(best, 4),
         "compile_s": round(compile_s, 2),
-        # provenance: which emit/repeat impls produced this number (the
-        # watchdog's step-2b recapture runs under EMIT_IMPL=windowed, and
-        # keep-best must stay attributable)
-        "emit_impl": os.environ.get("CYLON_TPU_EMIT_IMPL", "gather"),
+        # provenance: the RESOLVED emit impl (not the raw env — on meshes
+        # where the windowed request falls back to gather, recording
+        # 'windowed' would mislabel the measured kernel), plus the expand
+        # variant when windowed actually ran
+        "emit_impl": _resolved_emit_impl(ctx),
+        "expand_gather": os.environ.get("CYLON_TPU_EXPAND_GATHER", "take"),
         **info,
     }
     record_tpu_attempt(payload)

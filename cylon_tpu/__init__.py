@@ -32,8 +32,15 @@ if _platform:
 # shape.
 _effort = os.environ.get("CYLON_TPU_COMPILE_EFFORT")
 if _effort:
-    jax.config.update("jax_exec_time_optimization_effort", float(_effort))
-    jax.config.update("jax_memory_fitting_effort", float(_effort))
+    try:
+        _effort_f = float(_effort)
+    except ValueError:
+        raise ValueError(
+            f"CYLON_TPU_COMPILE_EFFORT={_effort!r} is not a float "
+            "(expected e.g. -1.0 for fastest compile, 0.0 for default)"
+        ) from None
+    jax.config.update("jax_exec_time_optimization_effort", _effort_f)
+    jax.config.update("jax_memory_fitting_effort", _effort_f)
 
 from . import dtypes  # noqa: E402
 from .column import Column  # noqa: E402

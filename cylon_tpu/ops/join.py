@@ -422,11 +422,14 @@ def emit_gather(
 
 
 def emit_impl_for(world_size: int, platform: str) -> str:
-    """Resolve the emit implementation for a mesh: 'windowed' only when the
-    env opts in AND the Pallas expand can actually run there (interpret on
-    CPU meshes; compiled pallas_call under jit(shard_map) recurses on TPU,
-    so multi-chip TPU meshes keep the XLA gather — same constraint as
-    algorithm='pallas_pk')."""
+    """Resolve the emit implementation for a mesh: windowed only when the
+    env opts in AND the Pallas expand can actually run there. CPU meshes
+    get 'windowed_interp' (interpret-mode pallas — the MESH platform
+    decides, not jax.default_backend(): on a TPU host driving a CPU-device
+    mesh the two disagree and a compiled Mosaic kernel would crash);
+    1-device TPU meshes get compiled 'windowed'; multi-chip TPU keeps the
+    XLA gather (compiled pallas under jit(shard_map) recurses — same
+    constraint as algorithm='pallas_pk')."""
     import os
 
     if os.environ.get("CYLON_TPU_EMIT_IMPL", "gather") != "windowed":
@@ -435,7 +438,9 @@ def emit_impl_for(world_size: int, platform: str) -> str:
 
     if not expand_available():
         return "gather"
-    if world_size > 1 and platform != "cpu":
+    if platform == "cpu":
+        return "windowed_interp"
+    if world_size > 1:
         return "gather"
     return "windowed"
 
@@ -449,7 +454,7 @@ def emit_impl_kwargs(ctx) -> Tuple[str, dict]:
     impl = emit_impl_for(
         ctx.world_size, ctx.mesh.devices.flat[0].platform
     )
-    if impl != "windowed":
+    if not impl.startswith("windowed"):
         return impl, {}
     return impl, {
         "check_vma": False,
@@ -468,12 +473,25 @@ def _emit_inner_left(
     ``jnp.repeat`` for li, one packed left-row gather (payload + base/cnt
     lanes), one packed right-row gather at the run positions.
 
-    ``emit_impl='windowed'`` (via :func:`emit_impl_for`) swaps the left
-    gather for the Pallas streamed expand (ops/pallas_gather)."""
-    if emit_impl == "windowed":
-        return _emit_inner_left_windowed(
-            lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, cap_r
+    ``emit_impl='windowed'``/``'windowed_interp'`` (via
+    :func:`emit_impl_for`) swaps the left gather for the Pallas streamed
+    expand (ops/pallas_gather), unless the table is wide enough that the
+    expand's VMEM footprint (~L * 3 windows * 4 B at T=4096) would
+    overflow — wide tables keep the XLA gather."""
+    if emit_impl.startswith("windowed"):
+        # VMEM gate: lanes = data lanes (2 for 64-bit) + validity lanes +
+        # 5 bookkeeping; scratch+out ≈ lanes * (2*4224 + 4096) * 4 B.
+        # 200 lanes ≈ 10 MB — comfortably under the ~16 MB VMEM budget.
+        est_lanes = 5 + sum(
+            (2 if np.dtype(d.dtype).itemsize == 8 else 1)
+            + (1 if v is not None else 0)
+            for d, v in l_cols
         )
+        if est_lanes <= 200:
+            return _emit_inner_left_windowed(
+                lo, cnt, l_cols, r_sorted_cols, nl, how, cap_out, cap_r,
+                interpret=emit_impl == "windowed_interp",
+            )
     from .gather import pack_gather
 
     cap_l = lo.shape[0]
@@ -504,6 +522,7 @@ def _emit_inner_left_windowed(
     l_cols: Sequence[KeyCol],
     r_sorted_cols: Sequence[KeyCol],
     nl, how: int, cap_out: int, cap_r: int,
+    interpret: bool = False,
 ) -> Tuple[list, jax.Array]:
     """INNER/LEFT emit with the left gather replaced by the Pallas windowed
     expand (docs/GATHER_DESIGN.md; VERDICT r3 item 1).
@@ -523,7 +542,6 @@ def _emit_inner_left_windowed(
     from .pallas_gather import expand_rows
 
     impl = os.environ.get("CYLON_TPU_EXPAND_GATHER", "take")
-    interpret = jax.default_backend() != "tpu"
     cap_l = lo.shape[0]
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     live_l = idx_l < nl

@@ -225,9 +225,17 @@ class BucketJoinOp(Op):
                 del out
                 fut_caps.append((ex.submit(drain_task), cap_out))
         finally:
+            # collect EVERY future before shutdown: raising on the first
+            # failure would skip the rest and leak the drainer thread
+            drain_errs = []
             for f, _cap in fut_caps:
-                f.result()  # propagate drain-thread exceptions
+                try:
+                    f.result()
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    drain_errs.append(e)
             ex.shutdown(wait=True)
+            if drain_errs:
+                raise drain_errs[0]
         self._drain_one()  # final sweep (anything emitted but unqueued)
         return None
 

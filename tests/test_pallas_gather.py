@@ -78,11 +78,11 @@ def _emit_pair(rng, how, n_l, n_r, keyspace, with_valid=False, with_f64=False):
         (d, None) for (d, v) in r_sorted
     ]  # r_cols mask-free: keep mask-free
     outs = {}
-    for fn in (J._emit_inner_left, J._emit_inner_left_windowed):
-        cols, n_out = fn(
-            lo, cnt, l_cols, r_sorted, nl, howi, cap_out, cap_r
+    for impl in ("gather", "windowed_interp"):
+        cols, n_out = J._emit_inner_left(
+            lo, cnt, l_cols, r_sorted, nl, howi, cap_out, cap_r, impl
         )
-        outs[fn.__name__] = (
+        outs[impl] = (
             [(np.asarray(d), None if v is None else np.asarray(v)) for d, v in cols],
             int(n_out),
         )

@@ -35,8 +35,28 @@ while true; do
       # watchdog invocations, so grepping its tail could act on a stale
       # verdict from a previous run
       if grep -q '"verdict": "windowed"' "$GAB_OUT"; then
-        echo "$(date -u +%FT%TZ) step 2b: windowed emit wins - headline recapture" >> "$LOG"
-        CYLON_TPU_EMIT_IMPL=windowed BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+        # pin the SPECIFIC expand variant that won the full-join A/B (the
+        # verdict can be carried by take_db/onehot_db while plain take
+        # errored — recapturing with the default would measure, or crash
+        # on, a different kernel than the verdict's)
+        GAB_VARIANT=$(python - "$GAB_OUT" <<'PYEOF'
+import json, sys
+best, name = None, "take"
+for line in open(sys.argv[1]):
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    b = r.get("benchmark", "")
+    if b.startswith("spec_join_windowed_") and "warm_s" in r:
+        if best is None or r["warm_s"] < best:
+            best, name = r["warm_s"], b.split("spec_join_windowed_", 1)[1]
+print(name)
+PYEOF
+)
+        echo "$(date -u +%FT%TZ) step 2b: windowed($GAB_VARIANT) wins - headline recapture" >> "$LOG"
+        CYLON_TPU_EMIT_IMPL=windowed CYLON_TPU_EXPAND_GATHER="$GAB_VARIANT" \
+          BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
           timeout 1200 python bench.py >> "$LOG" 2>&1
       fi
       echo "$(date -u +%FT%TZ) step 2c: cold-compile profile (8M headline shape)" >> "$LOG"
