@@ -119,6 +119,37 @@ def test_windowed_emit_validity_and_f64(rng):
     assert _rows(a_cols, a_n) == _rows(b_cols, b_n)
 
 
+def test_windowed_emit_wide_table_gate(rng, monkeypatch):
+    """Tables wide enough to overflow the expand's VMEM must silently take
+    the XLA gather path (the windowed kernel must not even be invoked)."""
+    import cylon_tpu.ops.pallas_gather as pg
+
+    def boom(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("expand_rows called despite the VMEM gate")
+
+    monkeypatch.setattr(pg, "expand_rows", boom)
+    n, cap = 40, 64
+    lk = np.zeros(cap, np.int32)
+    lk[:n] = rng.integers(0, 10, n)
+    rk = lk.copy()
+    # 110 int64 columns -> 220 data lanes + bookkeeping > the 200-lane gate
+    l_cols = [(jnp.asarray(lk), None)] + [
+        (jnp.asarray(np.arange(cap, dtype=np.int64)), None) for _ in range(110)
+    ]
+    lo, cnt, r_order, r_cnt = J.probe_arrays(
+        [(jnp.asarray(lk), None)], [(jnp.asarray(rk), None)],
+        jnp.int32(n), jnp.int32(n), cap, cap, J.INNER,
+    )
+    from cylon_tpu.ops.gather import pack_gather
+
+    r_sorted, _ = pack_gather([(jnp.asarray(rk), None)], r_order)
+    cols, n_out = J._emit_inner_left(
+        lo, cnt, l_cols, [(r_sorted[0][0], None)],
+        jnp.int32(n), J.INNER, 256, cap, "windowed_interp",
+    )
+    assert int(n_out) > 0  # produced via the gather path, kernel untouched
+
+
 def test_windowed_emit_empty_left(rng):
     outs, total = _emit_pair(rng, "inner", 0, 50, 5)
     (a_cols, a_n), (b_cols, b_n) = outs.values()
