@@ -94,6 +94,37 @@ def main():
 
     stages["full"] = jax.jit(full)
 
+    # the r4 windowed emit, staged the same way: compact-scatter + expand
+    # replacing the left gather, then the full windowed join
+    platform = jax.devices()[0].platform
+    w_impl = "windowed" if platform == "tpu" else "windowed_interp"
+
+    def _lw(a, b, v, w):
+        # the windowed emit computes its own compacted repeat internally,
+        # so this stage is probe + (compact scatter + expand + right gather)
+        lo, cnt, r_order, _rc = probe_only(a, b)
+        from cylon_tpu.ops.gather import pack_gather
+
+        r_sorted, _ = pack_gather([(b, None), (w, None)], r_order)
+        r_sorted = [(d, None) for d, _v in r_sorted]
+        out_cols, n_out = _j._emit_inner_left(
+            lo, cnt, [(a, None), (v, None)],
+            r_sorted, jnp.int32(n), _j.INNER, cap, n, w_impl,
+        )
+        return chk(*[d for d, _ in out_cols]) + n_out.astype(jnp.float32)
+
+    stages["probe+windowed_emit"] = jax.jit(_lw)
+
+    def full_windowed(a, b, v, w):
+        out, total, shadow = _j.spec_join(
+            [(a, None)], [(b, None)],
+            [(a, None), (v, None)], [(b, None), (w, None)],
+            jnp.int32(n), jnp.int32(n), _j.INNER, cap, w_impl,
+        )
+        return chk(*[d for d, _ in out]) + total.astype(jnp.float32)
+
+    stages["full_windowed"] = jax.jit(full_windowed)
+
     for name, fn in stages.items():
         t0 = time.perf_counter()
         float(fn(lk, rk, lv, rv))

@@ -90,7 +90,12 @@ PYEOF
       BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
         timeout 2400 python benchmarks/string_join_bench.py --rows 16000000 \
         >> "$JSONL" 2>> "$LOG"
-      echo "$(date -u +%FT%TZ) string rc=$? - watchdog done" >> "$LOG"
+      echo "$(date -u +%FT%TZ) string rc=$?" >> "$LOG"
+      echo "$(date -u +%FT%TZ) step 7: join stage profile (incl. windowed emit)" >> "$LOG"
+      BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_ROWS=16000000 \
+        timeout 2400 python benchmarks/profile_join_pieces.py \
+        >> "$JSONL" 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) stage profile rc=$? - watchdog done" >> "$LOG"
       exit 0
     fi
     echo "$(date -u +%FT%TZ) bench.py failed; will retry next cycle" >> "$LOG"
