@@ -1,0 +1,79 @@
+"""bench.record_tpu_attempt keep-best semantics — this file IS the round's
+headline evidence, so its selection rule gets a regression net: best-of-round
+at top level, latest verbatim, counts, and the round anchor that keeps a
+>12h round from dropping its best mid-round."""
+import json
+import os
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def attempt_env(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
+    clock = {"now": 1_000_000}
+    monkeypatch.setattr(bench.time, "time", lambda: clock["now"])
+    path = tmp_path / "BENCH_TPU_attempt.json"
+
+    def capture(vs, rows=8_000_000, at=None, **extra):
+        if at is not None:
+            clock["now"] = at
+        bench.record_tpu_attempt(
+            {"platform": "tpu", "vs_baseline": vs, "rows": rows, **extra}
+        )
+        return json.loads(path.read_text())
+
+    return capture, clock
+
+
+def test_keep_best_and_latest(attempt_env):
+    capture, clock = attempt_env
+    out = capture(10.0)
+    assert out["vs_baseline"] == 10.0 and out["captures_this_round"] == 1
+    clock["now"] += 3600
+    out = capture(8.0)  # degraded wake: best stays, latest updates
+    assert out["vs_baseline"] == 10.0
+    assert out["latest"]["vs_baseline"] == 8.0
+    assert out["captures_this_round"] == 2
+    clock["now"] += 3600
+    out = capture(11.5)  # better wake wins
+    assert out["vs_baseline"] == 11.5 and out["captures_this_round"] == 3
+
+
+def test_round_anchor_not_best_timestamp(attempt_env):
+    """A >12h round must keep comparing within the round until the ANCHOR
+    ages out — previously freshness tracked the best capture's own
+    timestamp, so an 11h-later degraded wake could overwrite the best."""
+    capture, clock = attempt_env
+    t0 = clock["now"]
+    out = capture(10.0)
+    assert out["round_started_unix"] == t0
+    out = capture(9.0, at=t0 + 11 * 3600)  # within the round: best kept
+    assert out["vs_baseline"] == 10.0 and out["captures_this_round"] == 2
+    out = capture(7.0, at=t0 + 13 * 3600)  # anchor aged out: NEW round
+    assert out["vs_baseline"] == 7.0
+    assert out["captures_this_round"] == 1
+    assert out["round_started_unix"] == t0 + 13 * 3600
+
+
+def test_config_change_resets(attempt_env):
+    capture, clock = attempt_env
+    capture(10.0, rows=8_000_000)
+    out = capture(6.0, rows=4_000_000)  # different config: no suppression
+    assert out["vs_baseline"] == 6.0 and out["rows"] == 4_000_000
+
+
+def test_cpu_and_error_lines_never_recorded(attempt_env, tmp_path):
+    capture, clock = attempt_env
+    bench.record_tpu_attempt({"platform": "cpu", "vs_baseline": 99.0})
+    bench.record_tpu_attempt({"platform": "tpu", "error": "x", "vs_baseline": 99.0})
+    assert not (tmp_path / "BENCH_TPU_attempt.json").exists()
+
+
+def test_corrupt_previous_file_still_records(attempt_env, tmp_path):
+    capture, clock = attempt_env
+    (tmp_path / "BENCH_TPU_attempt.json").write_text("{not json")
+    out = capture(9.0)
+    assert out["vs_baseline"] == 9.0 and out["captures_this_round"] == 1
