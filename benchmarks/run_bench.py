@@ -471,15 +471,22 @@ def main():
     if args.out:
         hdr = (f"# BENCH — cylon_tpu op suite (platform={d0.platform}, "
                f"mesh={len(devices)}, rows={args.rows:,})")
-        # preserve any hand-written trailing "Notes:" narrative across
-        # regeneration (the table is generated; the notes are not)
+        # preserve the hand-written trailing narrative across regeneration
+        # (the table is generated; the narrative is not). The narrative
+        # starts at the first recognized marker — the r4 collective-volume
+        # section or the classic "Notes" paragraph.
         notes = ""
         if os.path.exists(args.out):
             with open(args.out) as f:
                 prev = f.read()
-            i = prev.find("\nNotes")
-            if i >= 0:
-                notes = prev[i:]
+            starts = [
+                i for i in (
+                    prev.find("\n**Collective-volume"),
+                    prev.find("\nNotes"),
+                ) if i >= 0
+            ]
+            if starts:
+                notes = prev[min(starts):]
         with open(args.out, "w") as f:
             f.write(to_markdown(results, hdr) + notes)
 
