@@ -344,6 +344,14 @@ class OutOfCoreJoin:
         )
 
     @property
+    def join_phase_device_cap(self) -> int:
+        """Peak residency of the bucket-join phase alone — the ~total/K
+        quantity num_buckets controls (the spill phase's chunk-sized
+        residency is bucket-count-independent and can dominate the global
+        max for small inputs)."""
+        return self.join.max_device_cap
+
+    @property
     def cost_split(self) -> Dict[str, float]:
         """Per-phase wall seconds — the tunnel-free projection evidence
         (VERDICT r3 item 4). spill_fetch/drain_fetch are pure host<->device

@@ -74,16 +74,23 @@ def test_ooc_device_cap_scales_with_buckets(ctx8):
     full-table residency on any stage could not satisfy."""
     rng = np.random.default_rng(7)
     n = 48_000
-    ldf = pd.DataFrame({"k": rng.integers(0, 10_000, n).astype(np.int32),
+    # near-unique keys: the bucket-join OUTPUT stays input-scale, so the
+    # ~total/K INPUT residency num_buckets controls is what the max sees
+    # (with ~5 matches/key the output tables dominate the join-phase peak
+    # and round to the same pow2 cap at adjacent bucket counts)
+    ldf = pd.DataFrame({"k": rng.integers(0, 4 * n, n).astype(np.int32),
                         "v": rng.normal(size=n).astype(np.float32)})
-    rdf = pd.DataFrame({"k": rng.integers(0, 10_000, n).astype(np.int32),
+    rdf = pd.DataFrame({"k": rng.integers(0, 4 * n, n).astype(np.int32),
                         "w": rng.normal(size=n).astype(np.float32)})
     caps = {}
     for k in (8, 16):
         job = OutOfCoreJoin(ctx8, on="k", how="inner", num_buckets=k)
         sink = job.execute(_chunks(ldf, 4_000), _chunks(rdf, 4_000))
         assert sink.rows == len(ldf.merge(rdf, on="k"))
-        caps[k] = job.max_device_cap
+        # the JOIN phase is what num_buckets bounds (~total/K); the spill
+        # phase's chunk-sized residency is bucket-count-independent and
+        # can dominate the global max at test sizes
+        caps[k] = job.join_phase_device_cap
     # power-of-2 cap rounding quantizes the residency, so require a real
     # drop (not just <=): halving bucket size must at least halve one
     # rounding step, i.e. strictly fewer peak rows
