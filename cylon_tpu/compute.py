@@ -319,24 +319,84 @@ def compare_array_like_values(values, value_set, skip_null: bool = True):
     """Membership of each element of ``values`` in ``value_set`` (reference
     compute.pyx:compare_array_like_values — a SetLookup is_in over arrays).
 
-    Accepts array-likes (numpy/list/jax); returns a bool numpy array. The
-    vectorized sorted-probe design mirrors :func:`is_in` (no per-element
-    Python): sort the (deduplicated) value set once, searchsorted every
-    input element. ``skip_null``=True maps NaN/None inputs to False.
+    Accepts array-likes (numpy/list/jax); returns a bool numpy array.
+    Typed-dtype inputs stay vectorized (sorted probe / np.isin); the
+    object-dtype branch is per-element by nature but compares TYPED, like
+    the reference's SetLookup — text matches text (str/bytes unified),
+    numbers match numbers, other objects by their own equality; int 1 must
+    NOT match the string '1'. ``skip_null``=True maps NaN/None to False.
     """
     vals = np.asarray(values)
-    if vals.dtype == object or vals.dtype.kind in ("U", "S"):
-        def canon(v):
-            return v.decode(errors="replace") if isinstance(v, bytes) else str(v)
-
-        vs = np.asarray(
-            sorted(canon(v) for v in value_set if v is not None), dtype=object
+    if vals.dtype.kind in ("U", "S"):
+        # pure-text input: every element is text and None is impossible, so
+        # typed canon degenerates to text-vs-text — keep np.isin vectorized
+        text = [
+            v.decode(errors="replace") if isinstance(v, bytes) else v
+            for v in value_set
+            if isinstance(v, (str, bytes))
+        ]
+        probe = (
+            np.char.decode(vals, encoding="utf-8", errors="replace")
+            if vals.dtype.kind == "S" else vals
         )
-        probe = np.asarray([canon(v) for v in vals.tolist()], dtype=object)
-        out = np.isin(probe, vs)
-        if skip_null:
-            out &= np.array([v is not None for v in vals.tolist()])
-        return out
+        return np.isin(probe, np.asarray(text, dtype="U"))
+    if vals.dtype == object:
+        def canon(v):
+            if isinstance(v, bytes):
+                return ("t", v.decode(errors="replace"))
+            if isinstance(v, str):
+                return ("t", v)
+            if isinstance(v, (bool, int, float, np.bool_, np.integer,
+                              np.floating)):
+                return ("n", v)
+            return ("o", v)
+
+        def safe_eq(x, y):
+            try:
+                return bool(x == y)
+            except (TypeError, ValueError):
+                return False
+
+        def is_nan(v):
+            return isinstance(v, (float, np.floating)) and v != v
+
+        vset = list(value_set)
+        # NaN never matches (object identity would otherwise make the SAME
+        # float-nan object compare equal through the tuple — the typed-dtype
+        # branch and the docstring both say NaN is never a member)
+        svals = [canon(v) for v in vset if v is not None and not is_nan(v)]
+        sset, slinear = set(), []
+        for c in svals:
+            try:
+                sset.add(c)
+            except TypeError:  # unhashable member: linear-scan side list
+                slinear.append(c)
+
+        def contains(c):
+            try:
+                if c in sset:
+                    return True
+            except TypeError:
+                # unhashable probed element: fall through to linear scan
+                # (whole-set scan — it could equal a hashable member too)
+                return any(
+                    s[0] == c[0] and safe_eq(s[1], c[1]) for s in svals
+                )
+            # elementwise-safe linear membership over the unhashable
+            # members: ndarray values make tuple == raise/ambiguate,
+            # which must read as no-match
+            return any(
+                s[0] == c[0] and safe_eq(s[1], c[1]) for s in slinear
+            )
+
+        null_hit = not skip_null and any(v is None for v in vset)
+        return np.array(
+            [null_hit if v is None
+             else False if is_nan(v)
+             else contains(canon(v))
+             for v in vals.tolist()],
+            bool,
+        )
     # _probe_targets (the is_in helper) skips None and drops set values the
     # column dtype cannot represent exactly (1.5 must not truncate-match 1)
     vs = _probe_targets(list(value_set), np.dtype(vals.dtype))

@@ -12,6 +12,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import sys
 import tempfile
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -474,8 +475,16 @@ def dict_union(a: np.ndarray, b: np.ndarray):
     """Merge-union of two SORTED unique numpy unicode arrays via the native
     two-pointer merge (runtime.cpp ct_dict_union_u32): O(Da+Db) vs
     np.union1d's concat + full sort. Returns (union, map_a, map_b) or None
-    when the native lib is unavailable / dtypes aren't plain 'U'."""
+    when the native lib is unavailable / dtypes aren't plain native-order
+    'U' (the C merge compares raw UCS4 words, so a byteswapped '>U' array
+    would be ordered by its swapped bytes — fall back to numpy instead)."""
     if a.dtype.kind != "U" or b.dtype.kind != "U":
+        return None
+    if any(
+        d.byteorder not in ("=", "|")
+        and d.byteorder != ("<" if sys.byteorder == "little" else ">")
+        for d in (a.dtype, b.dtype)
+    ):
         return None
     # small unions: never trigger a first-use g++ build on the join hot
     # path (the murmur3_strings convention); big unions amortize the

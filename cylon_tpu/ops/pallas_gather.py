@@ -205,7 +205,12 @@ def expand_rows(
     rows create jumps > 1 that silently overflow a group's 128-wide window
     (wrong values, no error): COMPACT them away first, as
     ops/join._emit_inner_left_windowed does. Values outside [0, cap) are
-    tolerated (clamped; callers mask those output positions).
+    tolerated (clamped; callers mask those output positions). One tolerated
+    exception to step<=1: a jump PAST THE LAST LIVE output position (the
+    padded tail jumping from the final live index to cap, as
+    CYLON_TPU_REPEAT_IMPL=sort's _repeat_ss emits) — every output at or
+    beyond such a jump lands outside its window and is garbage, which is
+    fine exactly because callers must mask all positions >= total anyway.
     Returns [L, n_out] int32.
     """
     if pl is None:  # pragma: no cover

@@ -629,17 +629,15 @@ class Table:
         return out
 
     def to_string(self, row_limit: int = 10) -> str:
-        """Head/tail string render with a dotted elision line past
-        ``row_limit`` rows (reference table.pyx:1660-1690)."""
-        full = self.to_pandas().to_string()
+        """Head/tail string render with an elision row past ``row_limit``
+        rows (reference table.pyx:1660-1690). Elision is delegated to
+        pandas' ``max_rows`` renderer rather than slicing rendered text
+        lines: wide frames wrap into multiple column blocks, and a line
+        slice would cut mid-block and drop later blocks entirely."""
+        df = self.to_pandas()
         if self.row_count <= row_limit:
-            return full
-        rows = full.split("\n")
-        # rows[0] is the header; keep limit/2 head and tail data rows
-        half = max(row_limit // 2, 1)
-        dot_line = "." * max(len(r) for r in rows[:1 + half])
-        kept = rows[: 1 + half] + [dot_line] + rows[-half:]
-        return "\n".join(kept) + "\n"
+            return df.to_string()
+        return df.to_string(max_rows=max(2 * (row_limit // 2), 2)) + "\n"
 
     def show(self, row1: int = -1, row2: int = -1, col1: int = -1, col2: int = -1) -> None:
         """Print the table, optionally a [row1:row2, col1:col2] window

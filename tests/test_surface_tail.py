@@ -263,3 +263,56 @@ def test_fused_join_respill_param(ctx8, rng):
         assert got.row_count == want
     with pytest.raises(ValueError):
         lt.distributed_join(rt, on="k", mode="fused", respill=-1)
+
+def test_to_string_wide_frame_keeps_all_column_blocks(local_ctx):
+    # r4 advisor: pandas wraps wide frames into multiple column blocks; the
+    # elided render must keep every block (line slicing used to cut them)
+    cols = {f"column_{i:02d}": np.arange(40) * i for i in range(30)}
+    t = ct.Table.from_pydict(local_ctx, cols)
+    s = t.to_string(row_limit=4)
+    for name in cols:
+        assert name in s, name
+    assert "..." in s
+
+
+def test_compare_array_like_typed_membership():
+    # r4 advisor: typed SetLookup semantics — int 1 must not match '1'
+    from cylon_tpu.compute import compare_array_like_values
+
+    vals = np.array([1, "1", "x", None], dtype=object)
+    got = compare_array_like_values(vals, ["1", "x"])
+    assert got.tolist() == [False, True, True, False]
+    got = compare_array_like_values(vals, [1])
+    assert got.tolist() == [True, False, False, False]
+    # bytes unify with str; null matching only when skip_null=False
+    got = compare_array_like_values(
+        np.array(["a", None], dtype=object), [b"a", None], skip_null=False
+    )
+    assert got.tolist() == [True, True]
+
+
+def test_dict_union_rejects_non_native_byte_order():
+    # r4 advisor: a '>U' dictionary must fall back to numpy, not be
+    # compared byteswapped by the native UCS4 merge
+    from cylon_tpu.native import dict_union
+
+    a = np.array(["a", "b"], dtype="<U4" if np.little_endian else ">U4")
+    swapped = a.astype(a.dtype.newbyteorder())
+    assert dict_union(swapped, a) is None
+    assert dict_union(a, swapped) is None
+
+
+def test_compare_array_like_unhashable_and_text_paths():
+    from cylon_tpu.compute import compare_array_like_values
+
+    # unhashable elements on either side must not raise (review r5):
+    vals = np.array([[1, 2], "x", np.arange(3)], dtype=object)
+    got = compare_array_like_values(vals, ["x"])
+    assert got.tolist() == [False, True, False]
+    got = compare_array_like_values(vals, [[1, 2], "x"])
+    assert got.tolist() == [True, True, False]
+    # pure-text dtypes take the vectorized path and drop non-text members
+    got = compare_array_like_values(np.array(["1", "2"]), ["1", 2])
+    assert got.tolist() == [True, False]
+    got = compare_array_like_values(np.array([b"a", b"z"], dtype="S1"), ["a"])
+    assert got.tolist() == [True, False]

@@ -1,0 +1,123 @@
+#!/bin/bash
+# Round-5 TPU evidence chain — run by tpu_watchdog.sh on the first tunnel
+# wake (and re-runnable by hand). Kept OUT of the watchdog so the chain can
+# grow mid-round while the prober loop keeps running: the watchdog re-reads
+# this file on every invocation.
+#
+# Priority order (VERDICT r4 "Next round" items):
+#   1. bench.py                 -> BENCH_TPU_attempt.json (driver must-have)
+#   2. gather_ab.py 16M         -> windowed-emit A/B decision (item 2)
+#   2b. bench.py (windowed)     -> headline recapture iff windowed wins
+#   3. compile_profile 8M       -> cold-compile gate data (item 6)
+#   4. run_bench cold+warm      -> BENCH_TPU.md regen incl. ooc row (items 1,5)
+#   5. sliced_join_bench 16M    -> num_slices sweep (item 4)
+#   6. pallas_bench / micro_bench (radix pre-bucket) / string_join_bench
+#   7. profile_join_pieces      -> stage split incl. windowed emit
+# Each step is individually timeouted and failure-tolerant: a dead tunnel
+# mid-chain must still leave every earlier capture on disk.
+set -u
+LOG=${LOG:-/root/repo/.tpu_watchdog.log}
+JSONL=${JSONL:-BENCH_TPU_r05.jsonl}
+cd /root/repo
+note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+note "chain: step 1 bench.py"
+# freshness gate: the repo already carries a committed attempt file from a
+# previous round, so existence alone would let a failed bench.py "pass" and
+# burn the done-marker with no fresh capture — require a write NEWER than
+# this chain start
+START_MARK=$(mktemp)
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 timeout 1200 python bench.py >> "$LOG" 2>&1
+if [ -z "$(find BENCH_TPU_attempt.json -newer "$START_MARK" 2>/dev/null)" ]; then
+  rm -f "$START_MARK"
+  note "chain: bench.py produced no FRESH attempt - abort"
+  exit 1
+fi
+rm -f "$START_MARK"
+note "chain: captured fresh BENCH_TPU_attempt.json"
+
+note "chain: step 2 gather A/B (emit impl decision)"
+GAB_OUT=$(mktemp)
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 3600 python benchmarks/gather_ab.py --rows 16000000 \
+  > "$GAB_OUT" 2>> "$LOG"
+note "chain: gather_ab rc=$?"
+cat "$GAB_OUT" >> "$JSONL"
+# verdict scoped to THIS run's output (the jsonl appends across runs)
+if grep -q '"verdict": "windowed"' "$GAB_OUT"; then
+  # pin the SPECIFIC expand variant that won the full-join A/B
+  GAB_VARIANT=$(python - "$GAB_OUT" <<'PYEOF'
+import json, sys
+best, name = None, "take"
+for line in open(sys.argv[1]):
+    try:
+        r = json.loads(line)
+    except ValueError:
+        continue
+    b = r.get("benchmark", "")
+    if b.startswith("spec_join_windowed_") and "warm_s" in r:
+        if best is None or r["warm_s"] < best:
+            best, name = r["warm_s"], b.split("spec_join_windowed_", 1)[1]
+print(name)
+PYEOF
+)
+  note "chain: step 2b windowed($GAB_VARIANT) wins - headline recapture"
+  # persist the winning config so the watchdog's periodic recaptures
+  # measure the SAME kernel the verdict picked (a slower default-config
+  # recapture would never refresh the keep-best top-level capture)
+  printf 'export CYLON_TPU_EMIT_IMPL=windowed CYLON_TPU_EXPAND_GATHER=%s\n' \
+    "$GAB_VARIANT" > .tpu_bench_env
+  CYLON_TPU_EMIT_IMPL=windowed CYLON_TPU_EXPAND_GATHER="$GAB_VARIANT" \
+    BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+    timeout 1200 python bench.py >> "$LOG" 2>&1
+fi
+
+note "chain: step 3 cold-compile profile (8M headline shape)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 3600 python benchmarks/compile_profile.py --rows 8000000 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: compile_profile rc=$?"
+
+note "chain: step 4 run_bench suite (cold compile)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_HBM_GBPS=819 \
+  timeout 5400 python benchmarks/run_bench.py --rows 4000000 --reps 3 \
+  --compile-gate 0 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: run_bench cold rc=$?"
+note "chain: step 4b run_bench warm -> BENCH_TPU.md (gate <30s cached)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_HBM_GBPS=819 \
+  timeout 5400 python benchmarks/run_bench.py --rows 4000000 --reps 3 \
+  --compile-gate 30 --out BENCH_TPU.md \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: run_bench warm rc=$?"
+
+if [ -f benchmarks/sliced_join_bench.py ]; then
+  note "chain: step 5 sliced join sweep (num_slices 1/4/32/256)"
+  BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+    timeout 3600 python benchmarks/sliced_join_bench.py --rows 16000000 \
+    >> "$JSONL" 2>> "$LOG"
+  note "chain: sliced rc=$?"
+fi
+
+note "chain: step 6 pallas head-to-head"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 2400 python benchmarks/pallas_bench.py --rows 4000000 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: pallas rc=$?"
+note "chain: step 6b repeat-impl + radix micro bench"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 2400 python benchmarks/micro_bench.py --rows 16000000 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: micro rc=$?"
+note "chain: step 6c string-key join (high cardinality)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 2400 python benchmarks/string_join_bench.py --rows 16000000 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: string rc=$?"
+
+note "chain: step 7 join stage profile (incl. windowed emit)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 BENCH_ROWS=16000000 \
+  timeout 2400 python benchmarks/profile_join_pieces.py \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: stage profile rc=$? - chain complete"
+exit 0
