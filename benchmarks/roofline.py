@@ -96,9 +96,35 @@ class Report:
         )
 
 
+def _merge_scaled(rep: Report, sub: Report, scale: float) -> None:
+    rep.sort_bytes_per_pass += int(sub.sort_bytes_per_pass * scale)
+    rep.sort_pass_bytes += sub.sort_pass_bytes * scale
+    rep.sort_count += int(sub.sort_count * scale)
+    rep.gather_bytes += sub.gather_bytes * scale
+    rep.scatter_bytes += sub.scatter_bytes * scale
+    rep.elementwise_bytes += sub.elementwise_bytes * scale
+    rep.collective_bytes += int(sub.collective_bytes * scale)
+    rep.collective_count += int(sub.collective_count * scale)
+    for k, v in sub.by_prim.items():
+        rep.by_prim[k] = rep.by_prim.get(k, 0.0) + v * scale
+
+
 def _walk(jaxpr, rep: Report) -> None:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
+        if prim == "scan":
+            # a scan body executes `length` times: walk it once and scale
+            # (the K-sliced fused join runs its K rounds in ONE scan — an
+            # unscaled walk under-reports its collectives/sorts by K).
+            # `while` has no static trip count and stays counted once.
+            sub = eqn.params.get("jaxpr")
+            inner = getattr(sub, "jaxpr", sub)
+            if inner is not None and hasattr(inner, "eqns"):
+                trips = int(eqn.params.get("length", 1))
+                sub_rep = Report()
+                _walk(inner, sub_rep)
+                _merge_scaled(rep, sub_rep, trips)
+            continue
         if prim == "pallas_call":
             # a hand-scheduled kernel: price it as STREAMED bytes (one read
             # of inputs + one write of outputs) and do NOT recurse into the
@@ -144,8 +170,8 @@ def _walk(jaxpr, rep: Report) -> None:
         ):
             # container primitives: their bodies were just recursed into;
             # adding the container's own in/out bytes would double-count
-            # every jit/shard_map boundary. (Loop bodies are still counted
-            # ONCE — a known undercount for multi-iteration scans.)
+            # every jit/shard_map boundary. (scan bodies are scaled by trip
+            # count above; `while` bodies are still counted once.)
             continue
         in_bytes = sum(_nbytes(x.aval) for x in eqn.invars if hasattr(x, "aval"))
         out_bytes = sum(_nbytes(x.aval) for x in eqn.outvars if hasattr(x, "aval"))

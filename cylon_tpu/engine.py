@@ -41,6 +41,27 @@ def recorded_kernels():
     return list(_KERNEL_RECORD or [])
 
 
+def record_dispatch(fn, *args) -> None:
+    """Record a kernel dispatch for the roofline analyzer — the ONE copy of
+    the recording discipline, used both by get_kernel's wrapper and by
+    dispatches that bypass get_kernel (the fused-join step is cached
+    directly on the context).
+
+    Records SHAPES, not the live arrays: pinning every dispatched kernel's
+    inputs for a whole op chain would hold intermediates XLA otherwise
+    frees, inflating peak HBM exactly on the big TPU runs the recorder
+    exists to model."""
+    if _KERNEL_RECORD is None:
+        return
+    spec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") and hasattr(x, "dtype")
+        else x,
+        args,
+    )
+    _KERNEL_RECORD.append((fn, spec))
+
+
 def round_cap(n: int, minimum: int = 8) -> int:
     """Round a capacity up to a power of two (>= minimum)."""
     n = max(int(n), minimum)
@@ -95,17 +116,7 @@ def get_kernel(
         return fn
 
     def recording(*args, _fn=fn):
-        # record SHAPES, not the live arrays: pinning every dispatched
-        # kernel's inputs for a whole op chain would hold intermediates XLA
-        # otherwise frees, inflating peak HBM exactly on the big TPU runs
-        # the recorder exists to model
-        spec = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-            if hasattr(x, "shape") and hasattr(x, "dtype")
-            else x,
-            args,
-        )
-        _KERNEL_RECORD.append((_fn, spec))
+        record_dispatch(_fn, *args)
         return _fn(*args)
 
     return recording

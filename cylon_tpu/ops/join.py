@@ -427,9 +427,17 @@ def emit_impl_for(world_size: int, platform: str) -> str:
     get 'windowed_interp' (interpret-mode pallas — the MESH platform
     decides, not jax.default_backend(): on a TPU host driving a CPU-device
     mesh the two disagree and a compiled Mosaic kernel would crash);
-    1-device TPU meshes get compiled 'windowed'; multi-chip TPU keeps the
-    XLA gather (compiled pallas under jit(shard_map) recurses — same
-    constraint as algorithm='pallas_pk')."""
+    accelerator meshes get compiled 'windowed' at EVERY world size.
+
+    Multi-chip history: round 3 found compiled pallas recursing at trace
+    time under ``jit(shard_map(...))`` and gated world>1 off. The trigger
+    was the NESTED jit (`expand_rows` carried its own @jax.jit inside the
+    shard_map-wrapped kernel); the emit path now calls the unjitted
+    `expand_rows_raw`, the same construction `dryrun_multichip` executes on
+    multi-device meshes (interpret) and `benchmarks/shardmap_pallas_probe.py`
+    validates compiled-on-hardware under shard_map. The whole path stays
+    opt-in behind CYLON_TPU_EMIT_IMPL=windowed, so the default join never
+    depends on it."""
     import os
 
     if os.environ.get("CYLON_TPU_EMIT_IMPL", "gather") != "windowed":
@@ -440,25 +448,34 @@ def emit_impl_for(world_size: int, platform: str) -> str:
         return "gather"
     if platform == "cpu":
         return "windowed_interp"
-    if world_size > 1:
-        return "gather"
     return "windowed"
 
 
 def emit_impl_kwargs(ctx) -> Tuple[str, dict]:
     """(emit_impl, engine.get_kernel kwargs) for a context — ONE home for
-    the three-way invariant: a windowed emit embeds a pallas_call, whose
-    outputs trip shard_map's vma checker (check_vma=False) and which
-    recurses under jit(shard_map) when compiled on a 1-device TPU mesh
-    (use_shard_map=False there)."""
+    the invariant: a windowed emit embeds a pallas_call, whose outputs trip
+    shard_map's vma checker (check_vma=False). 1-device meshes skip
+    shard_map entirely (it is a no-op there and skipping it also sidesteps
+    any residual pallas-under-shard_map fragility on the headline path);
+    multi-device meshes run the pallas_call per-shard inside shard_map,
+    UNJITTED (expand_rows_raw) — the nested jit was the round-3 recursion
+    trigger."""
+    import os
+
     impl = emit_impl_for(
         ctx.world_size, ctx.mesh.devices.flat[0].platform
     )
     if not impl.startswith("windowed"):
         return impl, {}
+    # CYLON_TPU_FORCE_SHARD_MAP=1 keeps shard_map on a 1-device mesh: the
+    # hardware probe (benchmarks/shardmap_pallas_probe.py) uses it to run
+    # the exact multi-chip construction — compiled pallas inside
+    # jit(shard_map) — on the single real chip (get_kernel keys include the
+    # wrapping flags, so this cannot alias the unwrapped program)
+    force_sm = os.environ.get("CYLON_TPU_FORCE_SHARD_MAP", "0") == "1"
     return impl, {
         "check_vma": False,
-        "use_shard_map": ctx.world_size > 1,
+        "use_shard_map": ctx.world_size > 1 or force_sm,
     }
 
 
@@ -539,7 +556,7 @@ def _emit_inner_left_windowed(
     import os
 
     from .gather import pack_cols, pack_gather, unpack_cols
-    from .pallas_gather import expand_rows
+    from .pallas_gather import expand_rows_raw
 
     impl = os.environ.get("CYLON_TPU_EXPAND_GATHER", "take")
     cap_l = lo.shape[0]
@@ -572,7 +589,10 @@ def _emit_inner_left_windowed(
     srcT = jnp.concatenate(
         [packed_c.T, offs_c[None, :]], axis=0
     )  # [LA+1, cap_l]
-    outT = expand_rows(srcT, li_c, impl=impl, interpret=interpret)
+    # unjitted on purpose: this call site is always inside the engine's
+    # jit / jit(shard_map); wrapping the pallas_call in its own jit was the
+    # round-3 unbounded-recursion trigger under shard_map on compiled TPU
+    outT = expand_rows_raw(srcT, li_c, impl=impl, interpret=interpret)
     g_lanes = [outT[j] for j in range(LA + 1)]
     out_pos = jnp.arange(cap_out, dtype=jnp.int32)
     in_out = out_pos < total

@@ -186,10 +186,7 @@ def _expand_kernel_db(
         )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("T", "impl", "interpret")
-)
-def expand_rows(
+def expand_rows_raw(
     srcT: jax.Array,
     li: jax.Array,
     T: int = 4096,
@@ -287,6 +284,16 @@ def expand_rows(
         interpret=interpret,
     )(gstarts, srcT, li2d)
     return out[:, :n_out]
+
+
+# Jitted wrapper for STANDALONE use (tests, gather_ab's isolated rows).
+# In-kernel callers (ops/join, already traced under the engine's jit or
+# jit(shard_map)) must use expand_rows_raw: a nested jit around the
+# pallas_call was the construction that hit jax's unbounded-recursion bug
+# under jit(shard_map) on compiled TPU (round-3 finding; VERDICT r4 item 3).
+expand_rows = jax.jit(
+    expand_rows_raw, static_argnames=("T", "impl", "interpret")
+)
 
 
 def expand_available() -> bool:

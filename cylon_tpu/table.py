@@ -1580,6 +1580,11 @@ class Table:
                 )
                 cache[key] = step
             with span("join.fused", rows=int(self.row_count)):
+                from .engine import record_dispatch
+
+                record_dispatch(
+                    step, (lflat, left.counts_dev, rflat, right.counts_dev), ()
+                )
                 out, nout, overflow = step(
                     (lflat, left.counts_dev, rflat, right.counts_dev), ()
                 )

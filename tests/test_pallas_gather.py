@@ -127,7 +127,7 @@ def test_windowed_emit_wide_table_gate(rng, monkeypatch):
     def boom(*a, **k):  # pragma: no cover - must not be reached
         raise AssertionError("expand_rows called despite the VMEM gate")
 
-    monkeypatch.setattr(pg, "expand_rows", boom)
+    monkeypatch.setattr(pg, "expand_rows_raw", boom)
     n, cap = 40, 64
     lk = np.zeros(cap, np.int32)
     lk[:n] = rng.integers(0, 10, n)
@@ -154,3 +154,38 @@ def test_windowed_emit_empty_left(rng):
     outs, total = _emit_pair(rng, "inner", 0, 50, 5)
     (a_cols, a_n), (b_cols, b_n) = outs.values()
     assert a_n == b_n == total == 0
+
+
+@pytest.mark.parametrize("force_sm", [False, True])
+def test_windowed_emit_multidevice_shard_map(ctx8, rng, monkeypatch, force_sm):
+    """The windowed emit per-shard inside jit(shard_map) on a multi-device
+    mesh (VERDICT r4 item 3's correctness gate), plus the forced-shard_map
+    knob the hardware probe uses. Compares the full distributed join
+    against pandas."""
+    import pandas as pd
+
+    import cylon_tpu as ct
+
+    monkeypatch.setenv("CYLON_TPU_EMIT_IMPL", "windowed")
+    if force_sm:
+        monkeypatch.setenv("CYLON_TPU_FORCE_SHARD_MAP", "1")
+    n = 300
+    ldf = pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+    })
+    rdf = pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32),
+    })
+    left = ct.Table.from_pydict(ctx8, {c: ldf[c].values for c in ldf})
+    right = ct.Table.from_pydict(ctx8, {c: rdf[c].values for c in rdf})
+    got = left.distributed_join(right, on="k", how="left").to_pandas()
+    want = ldf.merge(rdf, on="k", how="left")
+    want = want.assign(k_x=want["k"], k_y=want["k"]).drop(columns=["k"])
+    # left-join null k_y: table semantics keep k_y null only for unmatched
+    want.loc[want["w"].isna(), "k_y"] = np.nan
+    cols = sorted(got.columns)
+    g = got[cols].sort_values(cols, kind="mergesort").reset_index(drop=True)
+    w = want[cols].sort_values(cols, kind="mergesort").reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w, check_dtype=False, atol=1e-6)

@@ -153,3 +153,29 @@ def test_container_prims_not_double_counted():
     # one multiply: ~in+out = 8KB; a double-counted pjit boundary would
     # add another ~8KB on top
     assert rep.elementwise_bytes <= 3 * 8192, rep.elementwise_bytes
+
+
+def test_scan_body_scaled_by_trip_count():
+    """A scan body executes `length` times — its sorts/collectives must be
+    scaled, not counted once (the K-sliced fused join runs K rounds in ONE
+    scan; an unscaled walk under-reported its collective volume by K)."""
+    import jax
+    import jax.numpy as jnp
+
+    K = 7
+
+    @jax.jit
+    def f(x):
+        def body(carry, _):
+            s = jax.lax.sort(carry)
+            return s, jnp.sum(s)
+
+        out, sums = jax.lax.scan(body, x, None, length=K)
+        return out, sums
+
+    x = jnp.zeros((2048,), jnp.int32)
+    rep = analyze(f, x)
+    assert rep.sort_count == K, rep.sort_count
+    # pass-weighted bytes scale with K too
+    one = analyze(jax.jit(lambda x: jax.lax.sort(x)), x)
+    assert abs(rep.sort_pass_bytes - K * one.sort_pass_bytes) < 1e-6

@@ -36,6 +36,12 @@ fi
 rm -f "$START_MARK"
 note "chain: captured fresh BENCH_TPU_attempt.json"
 
+note "chain: step 1b shard_map pallas probe (multi-chip construction on 1 chip)"
+BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
+  timeout 2400 python benchmarks/shardmap_pallas_probe.py --rows 2000000 \
+  >> "$JSONL" 2>> "$LOG"
+note "chain: shardmap probe rc=$?"
+
 note "chain: step 2 gather A/B (emit impl decision)"
 GAB_OUT=$(mktemp)
 BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
