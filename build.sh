@@ -45,6 +45,15 @@ fi
 
 if [ "$TEST" = 1 ]; then
   python -m pytest tests/ -q
+  # Java binding: execute on a JVM automatically when one exists (VERDICT
+  # r4 item 10 — no JDK ships in this image, so the binding is otherwise
+  # proven via the C ABI harness in tests/test_java_abi_harness.py)
+  if command -v javac >/dev/null 2>&1 && command -v java >/dev/null 2>&1; then
+    echo "JDK detected: compiling + running the Java binding smoke test"
+    (cd java && ./run_smoke.sh)
+  else
+    echo "no JDK on PATH: Java binding validated via the C ABI harness only"
+  fi
 fi
 
 if [ "$WHEEL" = 1 ]; then
