@@ -69,6 +69,8 @@ from . import compute  # noqa: E402
 from .series import Series  # noqa: E402
 from . import indexing  # noqa: E402
 from .join_config import JoinAlgorithm, JoinConfig  # noqa: E402
+from . import plan  # noqa: E402
+from .plan import LazyFrame, col, lit  # noqa: E402
 from .indexing.index import (  # noqa: E402
     CategoricalIndex,
     HashIndex,
@@ -89,6 +91,10 @@ __all__ = [
     "Index",
     "JoinAlgorithm",
     "JoinConfig",
+    "LazyFrame",
+    "col",
+    "lit",
+    "plan",
     "LinearIndex",
     "indexing",
     "IntegerIndex",

@@ -97,7 +97,9 @@ class CylonContext:
             # multi-host: one jax process per host, devices global across the
             # mesh (the mpirun-rank analog; reference mpi_communicator.cpp:51
             # lazily calls MPI_Init the same way)
-            if not jax.distributed.is_initialized():
+            from .compat import distributed_is_initialized
+
+            if not distributed_is_initialized():
                 jax.distributed.initialize(
                     coordinator_address=config.coordinator_address,
                     num_processes=config.num_processes,

@@ -24,7 +24,9 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from .compat import shard_map
 from .context import CylonContext
+from .utils.tracing import bump
 
 # kernel-invocation recording for roofline analysis (benchmarks/roofline.py):
 # when enabled, every get_kernel dispatch appends (compiled_fn, args) so a
@@ -101,7 +103,7 @@ def get_kernel(
         kernel = builder()
         if use_shard_map:
             fn = jax.jit(
-                jax.shard_map(
+                shard_map(
                     kernel,
                     mesh=ctx.mesh,
                     in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
@@ -124,3 +126,48 @@ def get_kernel(
 
 def run(ctx: CylonContext, key: Tuple, builder, dp_args, rep_args=()):
     return get_kernel(ctx, key, builder)(dp_args, rep_args)
+
+
+# ----------------------------------------------------------------------
+# plan-fingerprint executable cache (cylon_tpu/plan)
+# ----------------------------------------------------------------------
+_PLAN_CACHE_MAX = 256
+
+
+def plan_executable(ctx: CylonContext, fingerprint, compile_fn):
+    """Per-context cache of optimized+lowered plan executables, keyed by the
+    plan's structural fingerprint (node shapes + schemas + world size; NOT
+    row counts — jit's shape specialization inside each eager kernel handles
+    sizes). A hit skips optimize+lower entirely and every kernel the
+    executor dispatches re-uses its ``_jit_cache`` entry, so a repeated
+    ``.collect()`` of the same plan shape compiles nothing.
+
+    Returns ``(entry, hit)``; hits/misses are counted in the tracing
+    registry (``plan.cache.hit`` / ``plan.cache.miss``) for tests and
+    benchmarks to assert on.
+    """
+    cache = ctx.__dict__.setdefault("_plan_cache", {})
+    entry = cache.get(fingerprint)
+    if entry is not None:
+        bump("plan.cache.hit")
+        return entry, True
+    bump("plan.cache.miss")
+    entry = compile_fn()
+    # bounded: literal values are part of the fingerprint, so a literal
+    # sweep (filter(col('v') > t) for many t) would otherwise grow one
+    # entry per value for the context's lifetime. FIFO eviction — dropping
+    # an entry only costs a re-optimize, the jitted kernels stay cached.
+    if len(cache) >= _PLAN_CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[fingerprint] = entry
+    return entry, False
+
+
+def plan_cache_stats() -> dict:
+    """{hits, misses} of the plan-fingerprint cache (process-wide)."""
+    from .utils.tracing import get_count
+
+    return {
+        "hits": get_count("plan.cache.hit"),
+        "misses": get_count("plan.cache.miss"),
+    }

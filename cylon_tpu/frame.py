@@ -128,6 +128,11 @@ class DataFrame:
     def to_table(self) -> Table:
         return self._table
 
+    def lazy(self):
+        """Lazy query plan over this frame's table (plan/lazy.py):
+        ``df.lazy().filter(...).join(...).groupby(...).collect()``."""
+        return self._table.lazy()
+
     @property
     def columns(self) -> List[str]:
         return self._table.column_names

@@ -21,6 +21,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <cmath>
+#if !(defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L)
+#include <locale.h>  // newlocale/strtod_l for the pre-C++17-to_chars fallback
+#endif
 #include <memory>
 #include <string>
 #include <string_view>
@@ -137,8 +141,37 @@ inline bool parse_i64(std::string_view s, int64_t* out) {
 inline bool parse_f64(std::string_view s, double* out) {
   const char* b = s.data();
   const char* e = s.data() + s.size();
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   auto r = std::from_chars(b, e, *out);
   return r.ec == std::errc() && r.ptr == e;
+#else
+  // libstdc++ < 11 has integer-only from_chars: strtod_l over a bounded
+  // copy (cells are short; the buffer is mmap'd, NOT NUL-terminated).
+  // The explicit C locale keeps '.' as the decimal point even when an
+  // embedding host (the C-ABI path) has called setlocale(LC_NUMERIC,...).
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  char buf[64];
+  std::string big;  // cells >= 64 chars (rare) take the heap copy
+  size_t n = s.size();
+  if (n == 0) return false;
+  const char* p;
+  if (n < sizeof(buf)) {
+    memcpy(buf, b, n);
+    buf[n] = '\0';
+    p = buf;
+  } else {
+    big.assign(b, n);
+    p = big.c_str();
+  }
+  char* endp = nullptr;
+  errno = 0;
+  *out = strtod_l(p, &endp, c_loc);
+  if (endp != p + n) return false;
+  // ERANGE underflow (subnormal -> rounded value) is data, not failure;
+  // ERANGE overflow (+-HUGE_VAL) matches from_chars' rejection
+  if (errno == ERANGE && (*out == HUGE_VAL || *out == -HUGE_VAL)) return false;
+  return true;
+#endif
 }
 
 inline bool parse_bool(std::string_view s, uint8_t* out) {
@@ -365,7 +398,13 @@ void parse_column(const char* base, const std::vector<Cell>& cells, size_t ncols
           owned = unescape(base, c);
           key = owned;
         }
+#if defined(__cpp_lib_generic_unordered_lookup)
         auto it = lut.find(key);
+#else
+        // libstdc++ < 11: no heterogeneous unordered lookup — pay one
+        // std::string materialization per cell on this toolchain only
+        auto it = lut.find(std::string(key));
+#endif
         if (it == lut.end()) {
           int32_t id = static_cast<int32_t>(order.size());
           order.emplace_back(key);
@@ -664,8 +703,15 @@ int32_t ct_csv_write(const char* path, char delim, int64_t nrows, int32_t ncols,
         case CT_FLOAT64: {
           auto v = static_cast<const double*>(data[c])[r];
           // shortest round-trip form, matching what pandas/python repr emit
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
           auto res = std::to_chars(tmp, tmp + sizeof(tmp), v);
           buf.append(tmp, res.ptr - tmp);
+#else
+          // libstdc++ < 11: %.17g round-trips every double (not always
+          // shortest — cosmetic only, the reader parses both forms)
+          int m = snprintf(tmp, sizeof(tmp), "%.17g", v);
+          buf.append(tmp, m);
+#endif
           break;
         }
         case CT_BOOL:

@@ -714,7 +714,8 @@ def join_sum_by_key_pushdown(
     nl: jax.Array,
     nr: jax.Array,
     group_cap: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return_reps: bool = False,
+):
     """INNER join + groupby-SUM(left column) BY the join key, fused into the
     probe sort itself — no join emit, no groupby sort.
 
@@ -729,7 +730,13 @@ def join_sum_by_key_pushdown(
     for join-then-groupby; the roofline model prices that at >3x.
 
     Returns (group sums [group_cap] float, ng UNCLAMPED, n_join,
-    overflow_groups). ``ng`` may exceed ``group_cap`` (the caller detects
+    overflow_groups) — plus, with ``return_reps``, per-group representative
+    LEFT row indices [group_cap] (the first live left row of each group's
+    key run; the planner's fused node gathers the group-key VALUES through
+    it, which this sums-only kernel otherwise discards) and per-group
+    VALID-left-value counts (the caller rebuilds the generic SUM's all-null
+    -> null validity from them). ``ng`` may exceed ``group_cap`` (the caller
+    detects
     truncation, mirroring the generic group_ids contract); ``n_join``
     saturates to 2^31-1 on int32 wrap (a float32 shadow mirrors the count,
     exactly like join_shard's count_overflow_check policy). Null/padding
@@ -818,4 +825,19 @@ def join_sum_by_key_pushdown(
     wrapped = (nj_i < 0) | (nj_f > jnp.float32(2**31))
     n_join = jnp.where(wrapped, jnp.int32(2**31 - 1), nj_i)
     overflow_groups = jnp.maximum(ng - group_cap, 0)
-    return s, ng, n_join, overflow_groups
+    if not return_reps:
+        return s, ng, n_join, overflow_groups
+    # representative LEFT row per group: segment-min of the left row index
+    # over the same (tgt, grp) scatter discipline as the sums — every group
+    # has >= 1 live left row by construction, so slots < ng are always real
+    lrow = spay - jnp.int32(cap_r)  # left row index in sorted space
+    reps = jnp.full((group_cap + 1,), cap_l, jnp.int32).at[tgt].min(
+        jnp.where(grp & is_l_live, lrow, jnp.int32(cap_l)), **kw
+    )
+    # per-group count of VALID left values, so the caller can mirror the
+    # generic aggregate_column SUM validity (all-null group -> null)
+    vok_s = vok[jnp.clip(lrow, 0, cap_l - 1)] & is_l_live
+    vcnt = jnp.zeros((group_cap + 1,), jnp.int32).at[tgt].add(
+        (grp & vok_s).astype(jnp.int32), **kw
+    )
+    return s, ng, n_join, overflow_groups, reps[:group_cap], vcnt[:group_cap]

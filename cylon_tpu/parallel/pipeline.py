@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
+from ..compat import shard_map
 from ..ops import join as _j
 from ..ops import partition as _p
 from ..ops.sort import KeyCol
@@ -274,17 +275,37 @@ def make_distributed_join_step(
         # the carry must match the body outputs' varying-manual-axes type
         # under shard_map: mark the unvarying zero initializers as varying
         # over the mesh axis
-        def _vary(x):
-            try:
-                return jax.lax.pcast(x, (axis_name,), to="varying")
-            except (AttributeError, TypeError):
-                return jax.lax.pvary(x, (axis_name,))
+        from ..compat import VMA_NATIVE, pvary
 
-        (ov_shuffle, ov_join), (ds, vs, ns) = jax.lax.scan(
-            slice_body,
-            (_vary(jnp.int32(0)), _vary(jnp.int32(0))),
-            jnp.arange(num_slices, dtype=jnp.int32),
-        )
+        def _vary(x):
+            return pvary(x, axis_name)
+
+        if VMA_NATIVE:
+            (ov_shuffle, ov_join), (ds, vs, ns) = jax.lax.scan(
+                slice_body,
+                (_vary(jnp.int32(0)), _vary(jnp.int32(0))),
+                jnp.arange(num_slices, dtype=jnp.int32),
+            )
+        else:
+            # old-API shard_map mis-lowers the collectives inside a scanned
+            # body (measured: rows silently lost/duplicated per slice on
+            # jax 0.4.x CPU) — unroll the K slice rounds instead. Program
+            # size grows O(K), results match the scan on current JAX.
+            carry = (jnp.int32(0), jnp.int32(0))
+            ys_all = []
+            for s in range(num_slices):
+                carry, ys = slice_body(carry, jnp.int32(s))
+                ys_all.append(ys)
+            ov_shuffle, ov_join = carry
+            ds = tuple(
+                jnp.stack([y[0][ci] for y in ys_all])
+                for ci in range(len(ys_all[0][0]))
+            )
+            vs = tuple(
+                jnp.stack([y[1][vi] for y in ys_all])
+                for vi in range(len(ys_all[0][1]))
+            )
+            ns = jnp.stack([y[2] for y in ys_all])
         # reassemble the [K, join_cap]-stacked outputs into flat columns and
         # compact the K live prefixes into ONE (a segment mask + one stable
         # sort + one packed gather — the only output-sized cost of slicing)
@@ -308,7 +329,7 @@ def make_distributed_join_step(
         return list(out_cols), total.reshape(1), overflow
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(PartitionSpec(axis_name), PartitionSpec()),
@@ -380,7 +401,7 @@ def make_join_groupby_step(
         return s, ng.reshape(1), n_join.reshape(1), total.reshape(1)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(PartitionSpec(axis_name), PartitionSpec()),

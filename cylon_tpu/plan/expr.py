@@ -1,0 +1,300 @@
+"""Structured column expressions for the planner.
+
+``Table.select`` takes an opaque Python callable — fine for eager execution,
+useless for an optimizer, which must know *which columns a predicate reads*
+to push it below a projection, a shuffle, or one side of a join. ``Expr`` is
+the minimal structured alternative: column refs, literals, comparisons,
+arithmetic and boolean connectives, each knowing its column set, a
+structural fingerprint (for the plan cache) and how to evaluate itself over
+a dict of :class:`~cylon_tpu.column.Column`.
+
+Null semantics are pandas-flavored: a row where any referenced column is
+null evaluates to null, and ``Filter`` drops null rows (the same rows the
+eager ``select``/``filter`` pair drops once the mask's validity is folded
+in). Dictionary-encoded (string) columns compare against *string literals*
+via the sorted dictionary: code order == value order, so every comparison is
+two ``searchsorted`` bounds on the host and a code compare on device.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column
+
+KeyCol = Tuple[jax.Array, Optional[jax.Array]]
+
+
+def _and_valid(a: Optional[jax.Array], b: Optional[jax.Array]):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class Expr:
+    """Base class; build via :func:`col` / :func:`lit` and operators."""
+
+    def columns(self) -> FrozenSet[str]:
+        raise NotImplementedError
+
+    def rename(self, mapping: Mapping[str, str]) -> "Expr":
+        """Substitute column names (used when pushing a filter through a
+        projection rename or down one side of a join)."""
+        raise NotImplementedError
+
+    def key(self) -> tuple:
+        """Structural fingerprint (feeds the plan-fingerprint cache)."""
+        raise NotImplementedError
+
+    def evaluate(self, cols: Mapping[str, Column]) -> KeyCol:
+        """-> (data, valid|None) arrays over the table's physical rows."""
+        raise NotImplementedError
+
+    # -- operator sugar ----------------------------------------------------
+    def _bin(self, op: str, other) -> "BinOp":
+        return BinOp(op, self, other if isinstance(other, Expr) else Lit(other))
+
+    def __eq__(self, other):  # noqa: A003 — expression building, not identity
+        return self._bin("==", other)
+
+    def __ne__(self, other):
+        return self._bin("!=", other)
+
+    def __lt__(self, other):
+        return self._bin("<", other)
+
+    def __le__(self, other):
+        return self._bin("<=", other)
+
+    def __gt__(self, other):
+        return self._bin(">", other)
+
+    def __ge__(self, other):
+        return self._bin(">=", other)
+
+    def __add__(self, other):
+        return self._bin("+", other)
+
+    def __sub__(self, other):
+        return self._bin("-", other)
+
+    def __mul__(self, other):
+        return self._bin("*", other)
+
+    def __truediv__(self, other):
+        return self._bin("/", other)
+
+    def __mod__(self, other):
+        return self._bin("%", other)
+
+    def __and__(self, other):
+        return self._bin("&", other)
+
+    def __or__(self, other):
+        return self._bin("|", other)
+
+    def __invert__(self):
+        return UnOp("~", self)
+
+    def __neg__(self):
+        return UnOp("-", self)
+
+    def __hash__(self):
+        return hash(self.key())
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def columns(self) -> FrozenSet[str]:
+        return frozenset({self.name})
+
+    def rename(self, mapping) -> "Col":
+        return Col(mapping.get(self.name, self.name))
+
+    def key(self) -> tuple:
+        return ("col", self.name)
+
+    def evaluate(self, cols) -> KeyCol:
+        c = cols[self.name]
+        if c.dtype.is_dictionary:
+            # codes only compare meaningfully against an encoded literal;
+            # BinOp special-cases that pair before evaluating this side
+            raise TypeError(
+                f"string column {self.name!r} only supports comparison "
+                "against a string literal in plan expressions"
+            )
+        return c.data, c.valid
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class Lit(Expr):
+    def __init__(self, value):
+        if isinstance(value, Expr) or not isinstance(
+            value, (int, float, bool, str, np.integer, np.floating, np.bool_)
+        ):
+            # fail at build time with a clear message — an unhashable value
+            # would otherwise surface as a bare TypeError from the plan
+            # fingerprint inside collect()
+            raise TypeError(
+                f"plan literals must be scalars (int/float/bool/str), "
+                f"got {type(value).__name__}"
+            )
+        self.value = value
+
+    def columns(self) -> FrozenSet[str]:
+        return frozenset()
+
+    def rename(self, mapping) -> "Lit":
+        return self
+
+    def key(self) -> tuple:
+        return ("lit", type(self.value).__name__, self.value)
+
+    def evaluate(self, cols) -> KeyCol:
+        return jnp.asarray(self.value), None
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+_CMP = {"==", "!=", "<", "<=", ">", ">="}
+_BOOL = {"&", "|"}
+
+
+class BinOp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def columns(self) -> FrozenSet[str]:
+        return self.left.columns() | self.right.columns()
+
+    def rename(self, mapping) -> "BinOp":
+        return BinOp(self.op, self.left.rename(mapping), self.right.rename(mapping))
+
+    def key(self) -> tuple:
+        return ("bin", self.op, self.left.key(), self.right.key())
+
+    def _dict_literal_cmp(self, c: Column, value, flip: bool) -> KeyCol:
+        """Dictionary-encoded column vs string literal: compare codes
+        against the literal's position bounds in the SORTED dictionary."""
+        op = self.op
+        if flip:  # lit <op> col  ==  col <flipped-op> lit
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        d = c.dictionary
+        lo = int(np.searchsorted(d, value, side="left"))
+        hi = int(np.searchsorted(d, value, side="right"))
+        code = c.data
+        if op == "==":
+            out = (code >= lo) & (code < hi)
+        elif op == "!=":
+            out = (code < lo) | (code >= hi)
+        elif op == "<":
+            out = code < lo
+        elif op == "<=":
+            out = code < hi
+        elif op == ">":
+            out = code >= hi
+        else:  # ">="
+            out = code >= lo
+        return out, c.valid
+
+    def evaluate(self, cols) -> KeyCol:
+        if self.op in _CMP:
+            # string-column comparisons route through the dictionary
+            l, r = self.left, self.right
+            if isinstance(l, Col) and isinstance(r, Lit):
+                c = cols[l.name]
+                if c.dtype.is_dictionary:
+                    return self._dict_literal_cmp(c, r.value, flip=False)
+            if isinstance(l, Lit) and isinstance(r, Col):
+                c = cols[r.name]
+                if c.dtype.is_dictionary:
+                    return self._dict_literal_cmp(c, l.value, flip=True)
+        ld, lv = self.left.evaluate(cols)
+        rd, rv = self.right.evaluate(cols)
+        valid = _and_valid(lv, rv)
+        op = self.op
+        if op == "==":
+            out = ld == rd
+        elif op == "!=":
+            out = ld != rd
+        elif op == "<":
+            out = ld < rd
+        elif op == "<=":
+            out = ld <= rd
+        elif op == ">":
+            out = ld > rd
+        elif op == ">=":
+            out = ld >= rd
+        elif op == "+":
+            out = ld + rd
+        elif op == "-":
+            out = ld - rd
+        elif op == "*":
+            out = ld * rd
+        elif op == "/":
+            out = ld / rd
+        elif op == "%":
+            out = ld % rd
+        elif op == "&":
+            out = ld & rd
+        elif op == "|":
+            out = ld | rd
+        else:
+            raise ValueError(f"unknown operator {op!r}")
+        return out, valid
+
+    def __repr__(self):
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class UnOp(Expr):
+    def __init__(self, op: str, operand: Expr):
+        self.op = op
+        self.operand = operand
+
+    def columns(self) -> FrozenSet[str]:
+        return self.operand.columns()
+
+    def rename(self, mapping) -> "UnOp":
+        return UnOp(self.op, self.operand.rename(mapping))
+
+    def key(self) -> tuple:
+        return ("un", self.op, self.operand.key())
+
+    def evaluate(self, cols) -> KeyCol:
+        d, v = self.operand.evaluate(cols)
+        return (~d if self.op == "~" else -d), v
+
+    def __repr__(self):
+        return f"{self.op}{self.operand!r}"
+
+
+def col(name: str) -> Col:
+    """Reference a column by name in a plan expression."""
+    return Col(name)
+
+
+def lit(value) -> Lit:
+    """Wrap a Python scalar as a plan-expression literal."""
+    return Lit(value)
+
+
+def filter_mask(expr: Expr, cols: Mapping[str, Column]) -> jax.Array:
+    """Evaluate a predicate to the boolean KEEP mask ``Table.filter`` takes:
+    null predicate rows (any referenced column null) are dropped."""
+    data, valid = expr.evaluate(cols)
+    if data.dtype != jnp.bool_:
+        raise TypeError(f"filter predicate must be boolean, got {data.dtype}")
+    return data if valid is None else data & valid

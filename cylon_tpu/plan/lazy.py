@@ -1,0 +1,238 @@
+"""LazyFrame: the user-facing lazy query surface.
+
+``Table.lazy()`` / ``DataFrame.lazy()`` return a :class:`LazyFrame`; each
+method appends a logical node; nothing executes until ``.collect()``, which
+optimizes (rules.py), lowers (lower.py) and runs — with the whole
+optimize+lower product cached in ``engine.py`` under the plan's structural
+fingerprint, so repeated collects of the same plan shape skip straight to
+execution (and the eager kernels underneath hit the jit cache: no
+recompile). ``.explain()`` shows the pre- and post-rewrite plans and which
+rules fired.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+
+from ..engine import plan_executable
+from ..utils.tracing import bump, span
+from . import lower as _lower
+from . import rules as _rules
+from .expr import Col, Expr, col
+from .nodes import (
+    Filter,
+    GroupBy,
+    Join,
+    Limit,
+    Node,
+    Project,
+    Scan,
+    Sort,
+    Union,
+)
+
+
+def _as_list(x) -> List[str]:
+    if isinstance(x, str):
+        return [x]
+    return list(x)
+
+
+def _normalize_aggs(agg: Dict[str, TUnion[str, Sequence[str]]]) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    for c, ops in agg.items():
+        ops_list = ops if isinstance(ops, (list, tuple)) else [ops]
+        for o in ops_list:
+            if not isinstance(o, str):
+                raise TypeError(f"agg op must be a string name, got {o!r}")
+            out.append((c, o))
+    return out
+
+
+class LazyFrame:
+    """A deferred query plan over :class:`~cylon_tpu.table.Table` inputs."""
+
+    def __init__(self, plan: Node, ctx):
+        self._plan = plan
+        self._ctx = ctx
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_table(cls, table) -> "LazyFrame":
+        return cls(Scan(table), table.ctx)
+
+    def _wrap(self, node: Node) -> "LazyFrame":
+        return LazyFrame(node, self._ctx)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.names
+
+    @property
+    def plan(self) -> Node:
+        return self._plan
+
+    def __repr__(self):
+        return f"LazyFrame[{', '.join(self.columns)}]\n{self._plan.render()}"
+
+    # -- plan builders -----------------------------------------------------
+    def filter(self, predicate: Expr) -> "LazyFrame":
+        """Keep rows where the :mod:`~cylon_tpu.plan.expr` predicate is true
+        (null predicate rows drop, pandas-style)."""
+        if not isinstance(predicate, Expr):
+            raise TypeError(
+                "LazyFrame.filter takes a plan expression, e.g. "
+                "filter(col('a') > 3) — opaque callables would be invisible "
+                "to the optimizer"
+            )
+        return self._wrap(Filter(self._plan, predicate))
+
+    def select(self, columns: TUnion[str, Sequence[str]], *more: str) -> "LazyFrame":
+        items = (
+            [columns] if isinstance(columns, (str, Col)) else list(columns)
+        ) + list(more)
+        cols = [c.name if isinstance(c, Col) else c for c in items]
+        return self._wrap(Project(self._plan, cols))
+
+    def join(
+        self,
+        other: "LazyFrame",
+        on: Optional[TUnion[str, Sequence[str]]] = None,
+        how: str = "inner",
+        left_on: Optional[TUnion[str, Sequence[str]]] = None,
+        right_on: Optional[TUnion[str, Sequence[str]]] = None,
+        suffixes: Tuple[str, str] = ("_x", "_y"),
+    ) -> "LazyFrame":
+        if not isinstance(other, LazyFrame):
+            raise TypeError("join expects another LazyFrame (use .lazy())")
+        if other._ctx is not self._ctx:
+            raise ValueError("cannot join LazyFrames from different contexts")
+        if on is not None:
+            if left_on is not None or right_on is not None:
+                raise ValueError("pass either on= or left_on/right_on, not both")
+            l_on = r_on = _as_list(on)
+        else:
+            if left_on is None or right_on is None:
+                raise ValueError("join needs on= or both left_on/right_on")
+            l_on, r_on = _as_list(left_on), _as_list(right_on)
+            if len(l_on) != len(r_on):
+                raise ValueError("left_on/right_on length mismatch")
+        return self._wrap(
+            Join(self._plan, other._plan, l_on, r_on, how, suffixes)
+        )
+
+    def groupby(
+        self,
+        by: TUnion[str, Sequence[str]],
+        agg: Optional[Dict[str, TUnion[str, Sequence[str]]]] = None,
+    ):
+        """With ``agg``: a GroupBy plan node (column naming matches eager
+        ``Table.groupby``: ``col_op``). Without: a :class:`LazyGroupBy`
+        builder (``.agg()/.sum()/...``)."""
+        keys = _as_list(by)
+        if agg is None:
+            return LazyGroupBy(self, keys)
+        return self._wrap(GroupBy(self._plan, keys, _normalize_aggs(agg)))
+
+    def sort(
+        self,
+        by: TUnion[str, Sequence[str]],
+        ascending: TUnion[bool, Sequence[bool]] = True,
+    ) -> "LazyFrame":
+        keys = _as_list(by)
+        asc = [ascending] * len(keys) if isinstance(ascending, bool) else list(ascending)
+        if len(asc) != len(keys):
+            raise ValueError("ascending length must match sort keys")
+        return self._wrap(Sort(self._plan, keys, asc))
+
+    def union(self, other: "LazyFrame") -> "LazyFrame":
+        if other._ctx is not self._ctx:
+            raise ValueError("cannot union LazyFrames from different contexts")
+        return self._wrap(Union(self._plan, other._plan))
+
+    def limit(self, n: int) -> "LazyFrame":
+        return self._wrap(Limit(self._plan, n))
+
+    def head(self, n: int = 5) -> "LazyFrame":
+        return self.limit(n)
+
+    # -- execution ---------------------------------------------------------
+    def explain(self) -> str:
+        """Pre-rewrite plan, post-rewrite plan, and the rules that fired."""
+        opt, fired = _rules.optimize(self._plan, self._ctx.world_size)
+        lines = ["== Logical plan ==", self._plan.render(), "",
+                 "== Optimized plan ==", opt.render(), ""]
+        if fired:
+            counts: Dict[str, int] = {}
+            for f in fired:
+                counts[f] = counts.get(f, 0) + 1
+            lines.append(
+                "Rewrites fired: "
+                + ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+            )
+        else:
+            lines.append("Rewrites fired: (none)")
+        return "\n".join(lines)
+
+    def collect(self):
+        """Optimize, lower and execute the plan; returns an eager Table."""
+        ctx = self._ctx
+        tables = _lower.scan_tables(self._plan)
+        fingerprint = self._plan.fingerprint()
+
+        def compile_plan():
+            with span("plan.optimize"):
+                opt, fired = _rules.optimize(self._plan, ctx.world_size)
+            with span("plan.lower"):
+                # detach first: the cached executor must hold frozen scan
+                # ordinals and no table references (lower.detach_scans)
+                opt = _lower.detach_scans(opt)
+                fn = _lower.build_executor(opt)
+            return opt, tuple(fired), fn
+
+        entry, hit = plan_executable(ctx, fingerprint, compile_plan)
+        opt, fired, fn = entry
+        if hit:
+            # cached optimize+lower: emit the spans anyway so every collect
+            # is visible in tracing.report() (at ~zero cost)
+            with span("plan.optimize"):
+                pass
+            with span("plan.lower"):
+                pass
+        for f in fired:
+            bump(f"plan.rule.{f}")
+        with span("plan.execute"):
+            return fn(tables)
+
+
+class LazyGroupBy:
+    """``lf.groupby('k')`` builder: ``.agg({...})`` or a shortcut reducer."""
+
+    def __init__(self, frame: LazyFrame, keys: List[str]):
+        self._frame = frame
+        self._keys = keys
+
+    def agg(self, spec: Dict[str, TUnion[str, Sequence[str]]]) -> LazyFrame:
+        return self._frame.groupby(self._keys, spec)
+
+    def _all_values(self, op: str) -> LazyFrame:
+        vals = [c for c in self._frame.columns if c not in self._keys]
+        return self.agg({c: op for c in vals})
+
+    def sum(self) -> LazyFrame:
+        return self._all_values("sum")
+
+    def min(self) -> LazyFrame:
+        return self._all_values("min")
+
+    def max(self) -> LazyFrame:
+        return self._all_values("max")
+
+    def mean(self) -> LazyFrame:
+        return self._all_values("mean")
+
+    def count(self) -> LazyFrame:
+        return self._all_values("count")
+
+
+_ = col  # re-exported via plan/__init__

@@ -1,0 +1,202 @@
+"""Lowering: optimized plan -> calls into the existing eager Table ops.
+
+``build_executor`` compiles a plan into a closure ``fn(tables) -> Table``
+(``tables`` = the Scan inputs in ordinal order). The closure is what the
+plan-fingerprint cache in ``engine.py`` stores: re-collecting an
+equal-shape plan skips optimize+lower entirely, and the eager ops it calls
+hit the per-context jit cache, so nothing recompiles.
+
+Join-family nodes own their input Shuffles: the eager layer promotes key
+dtypes and unifies dictionaries BEFORE hashing (``table.distributed_join``),
+so a planner-inserted Shuffle under a Join must run after that pairing —
+lowering peels it off the child and replays it inside the join recipe.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+from .expr import filter_mask
+from .nodes import (
+    Filter,
+    FusedJoinGroupBySum,
+    GroupBy,
+    Join,
+    Limit,
+    Node,
+    Project,
+    Scan,
+    Shuffle,
+    Sort,
+    Union,
+)
+
+
+def scan_tables(root: Node) -> list:
+    """Assign Scan ordinals in DFS order (shared scans keep one ordinal) and
+    return their bound tables in that order. Called before fingerprinting."""
+    tables: list = []
+    seen: Dict[int, int] = {}
+
+    def walk(n: Node) -> None:
+        if isinstance(n, Scan):
+            if id(n) not in seen:
+                seen[id(n)] = len(tables)
+                tables.append(n.table)
+            n.ordinal = seen[id(n)]
+            return
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return tables
+
+
+def detach_scans(root: Node) -> Node:
+    """Copy the plan with table-less Scan stubs (frozen ordinals, same
+    schema). The plan cache stores executors built over the DETACHED plan:
+    live Scan nodes are shared with the user's LazyFrame and mutable (a
+    later collect of a plan sharing a Scan re-assigns its ordinal), and
+    their ``.table`` refs would otherwise pin the first collect's device
+    buffers for the context's lifetime."""
+    memo: Dict[int, Node] = {}
+
+    def walk(n: Node) -> Node:
+        got = memo.get(id(n))
+        if got is not None:
+            return got
+        if isinstance(n, Scan):
+            stub = Scan.__new__(Scan)
+            stub.table = None
+            stub.ordinal = n.ordinal
+            stub.schema = n.schema
+            out: Node = stub
+        elif n.children:
+            out = n.with_children([walk(c) for c in n.children])
+        else:
+            out = n
+        memo[id(n)] = out
+        return out
+
+    return walk(root)
+
+
+def _peel_shuffle(child: Node, keys: Sequence[str]):
+    """(grandchild, needs_shuffle) for a join-family input: a planner
+    Shuffle on exactly the side's keys is replayed inside the join recipe
+    (after dict unification + key promotion)."""
+    if (
+        isinstance(child, Shuffle)
+        and child.kind == "hash"
+        and set(child.keys) == set(keys)
+    ):
+        return child.children[0], True
+    return child, False
+
+
+def _prepare_join_inputs(lt, rt, l_keys, r_keys, l_shuf: bool, r_shuf: bool):
+    """The join-input invariant in ONE place (used by Join and the fused
+    node): unify dictionaries and promote key dtypes BEFORE hashing, then
+    replay the peeled planner Shuffles on the prepared pair."""
+    from ..table import _promote_key_pair, _unify_dict_pair
+
+    lt, rt = _unify_dict_pair(lt, rt, l_keys, r_keys)
+    lt, rt = _promote_key_pair(lt, rt, l_keys, r_keys)
+    if lt.world_size > 1:
+        if l_shuf:
+            lt = lt._shuffle_impl(kind="hash", key_names=l_keys)
+        if r_shuf:
+            rt = rt._shuffle_impl(kind="hash", key_names=r_keys)
+    return lt, rt
+
+
+def build_executor(root: Node) -> Callable[[List], "object"]:
+    """Compile the plan into ``fn(tables) -> Table``."""
+
+    def run(tables: List):
+        memo: Dict[int, object] = {}
+
+        def ex(node: Node):
+            got = memo.get(id(node))
+            if got is not None:
+                return got
+            out = _lower_one(node, ex, tables)
+            memo[id(node)] = out
+            return out
+
+        return ex(root)
+
+    return run
+
+
+def _lower_one(node: Node, ex, tables):
+    if isinstance(node, Scan):
+        return tables[node.ordinal]
+    if isinstance(node, Project):
+        return ex(node.children[0]).project(list(node.cols))
+    if isinstance(node, Filter):
+        t = ex(node.children[0])
+        mask = filter_mask(node.expr, {n: t._columns[n] for n in t.column_names})
+        return t.filter(mask)
+    if isinstance(node, Sort):
+        return ex(node.children[0]).sort(list(node.by), list(node.ascending))
+    if isinstance(node, Shuffle):
+        t = ex(node.children[0])
+        if t.world_size == 1:
+            return t
+        if node.kind == "hash":
+            return t._shuffle_impl(kind="hash", key_names=list(node.keys))
+        return t._shuffle_impl(
+            kind="range", key_names=[node.keys[0]], asc0=node.asc0
+        )
+    if isinstance(node, GroupBy):
+        t = ex(node.children[0])
+        spec: Dict[str, list] = {}
+        for c, op in node.aggs:
+            spec.setdefault(c, []).append(op)
+        res = t.groupby(list(node.keys), spec)
+        # multiple ops per column group in dict order; restore plan order
+        if res.column_names != node.names:
+            res = res.project(node.names)
+        return res
+    if isinstance(node, Join):
+        lchild, l_shuf = _peel_shuffle(node.children[0], node.l_on)
+        rchild, r_shuf = _peel_shuffle(node.children[1], node.r_on)
+        lt, rt = ex(lchild), ex(rchild)
+        # pre-rename both sides to the build-time output names so pruning
+        # can never change the suffixing (nodes.Join docstring)
+        lt = lt.rename({n: node.l_rename[n] for n in lt.column_names})
+        rt = rt.rename({n: node.r_rename[n] for n in rt.column_names})
+        l_keys, r_keys = list(node.l_key_out), list(node.r_key_out)
+        lt, rt = _prepare_join_inputs(
+            lt, rt, l_keys, r_keys, l_shuf, r_shuf
+        )
+        return lt.join(
+            rt, left_on=l_keys, right_on=r_keys, how=node.how,
+            suffixes=node.suffixes,
+        )
+    if isinstance(node, FusedJoinGroupBySum):
+        lchild, l_shuf = _peel_shuffle(node.children[0], node.l_on)
+        rchild, r_shuf = _peel_shuffle(node.children[1], node.r_on)
+        l_on, r_on = list(node.l_on), list(node.r_on)
+        lt, rt = _prepare_join_inputs(
+            ex(lchild), ex(rchild), l_on, r_on, l_shuf, r_shuf
+        )
+        # kernel emits key columns in join-pair order; name them so that
+        # projecting to node.names restores the groupby key order
+        pair_names = [None] * len(l_on)
+        for name, ki in zip(node.out_keys, node.key_order):
+            pair_names[ki] = name
+        res = lt._join_sum_pushdown(
+            rt, l_on, r_on, node.val_col, pair_names, node.out_val
+        )
+        if res.column_names != node.names:
+            res = res.project(node.names)
+        return res
+    if isinstance(node, Union):
+        return ex(node.children[0]).union(ex(node.children[1]))
+    if isinstance(node, Limit):
+        t = ex(node.children[0])
+        return t.take(np.arange(min(node.n, t.row_count), dtype=np.int64))
+    raise TypeError(f"no lowering for plan node {type(node).__name__}")

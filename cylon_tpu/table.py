@@ -1637,6 +1637,89 @@ class Table:
             f"(extreme skew); use mode='eager'"
         )
 
+    def _join_sum_pushdown(
+        self,
+        other: "Table",
+        left_on: Sequence[str],
+        right_on: Sequence[str],
+        val_col: str,
+        out_key_names: Sequence[str],
+        out_val: str,
+    ) -> "Table":
+        """INNER join + groupby-SUM(``val_col``, a LEFT column) BY the join
+        key as ONE per-shard kernel (ops.join.join_sum_by_key_pushdown with
+        key-value emission) — the lowering target of the planner's
+        ``fused_join_groupby`` rewrite. The caller (plan/lower.py) must have
+        already co-partitioned, dictionary-unified and dtype-promoted the
+        pair, exactly as it would before a local join.
+
+        Output: the left key columns named ``out_key_names`` (join-pair
+        order) then ``out_val`` = per-group sum over the join result.
+        ``group_cap = min(cap_l, cap_r)`` is a static EXACT bound (a group
+        needs a live row on both sides), so like groupby there is no count
+        phase and ONE host sync."""
+        left, right = self, other
+        lk_idx = tuple(left.column_names.index(n) for n in left_on)
+        rk_idx = tuple(right.column_names.index(n) for n in right_on)
+        val_idx = left.column_names.index(val_col)
+        lflat = left._flat_cols()
+        rflat = right._flat_cols()
+        group_cap = min(left.shard_cap, right.shard_cap)
+        key = (
+            "join_sum_pushdown", lk_idx, rk_idx, val_idx, len(lflat),
+            len(rflat), group_cap,
+        )
+
+        def build():
+            def kern(dp, rep):
+                (lcols, lcounts, rcols, rcounts) = dp
+                nl, nr = lcounts[0], rcounts[0]
+                lk = [lcols[i] for i in lk_idx]
+                rk = [rcols[i] for i in rk_idx]
+                s, ng, _nj, _og, reps, vcnt = _j.join_sum_by_key_pushdown(
+                    lk, rk, lcols[val_idx], nl, nr, group_cap,
+                    return_reps=True,
+                )
+                gmask = jnp.arange(group_cap, dtype=jnp.int32) < ng
+                rep_idx = jnp.where(gmask, reps, -1)
+                out = [_j.gather_column(d, v, rep_idx) for d, v in lk]
+                # mirror aggregate_column's SUM validity: a group whose
+                # left values are ALL null sums to null, not 0
+                sum_valid = (
+                    None if lcols[val_idx][1] is None
+                    else gmask & (vcnt > 0)
+                )
+                out.append((s, sum_valid))
+                return out, _scalar(ng)
+
+            return kern
+
+        with span(
+            "join.sum_pushdown", rows=int(self.row_count + other.row_count)
+        ):
+            out, nout = get_kernel(self.ctx, key, build)(
+                (lflat, left.counts_dev, rflat, right.counts_dev), ()
+            )
+            counts = self._out_counts(nout)  # the ONE host sync
+        cols_od: "OrderedDict[str, Column]" = OrderedDict()
+        for name, srcn, (d, v) in zip(
+            out_key_names, left_on, out[: len(left_on)]
+        ):
+            src = left._columns[srcn]
+            cols_od[name] = Column(d, src.dtype, v, src.dictionary)
+        d, v = out[-1]
+        cols_od[out_val] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
+        res = Table(self.ctx, cols_od, counts, group_cap)
+        return res._maybe_compact(counts)
+
+    def lazy(self) -> "object":
+        """Start a lazy query plan over this table: build with
+        ``.filter/.select/.join/.groupby/.sort``, inspect with
+        ``.explain()``, run with ``.collect()`` (plan/lazy.py)."""
+        from .plan.lazy import LazyFrame
+
+        return LazyFrame.from_table(self)
+
     # ------------------------------------------------------------------
     # set operations
     # ------------------------------------------------------------------

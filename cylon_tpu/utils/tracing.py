@@ -82,6 +82,17 @@ def get_trace_report() -> Dict[str, Dict[str, float]]:
         return {k: dict(v) for k, v in _stats.items()}
 
 
+def report(prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    """Aggregated span/counter stats as a plain dict, optionally filtered by
+    name prefix — e.g. ``report("plan.rule.")`` tells a benchmark exactly
+    which optimizer rewrites fired (and how often) since the last
+    :func:`reset_trace`."""
+    stats = get_trace_report()
+    if prefix is None:
+        return stats
+    return {k: v for k, v in stats.items() if k.startswith(prefix)}
+
+
 def reset_trace() -> None:
     with _lock:
         _stats.clear()

@@ -161,11 +161,11 @@ def test_mixed_width_int_keys_distributed(ctx8, rng):
 def test_mixed_sign_promotion_requires_x64(ctx8):
     """int32 x uint32 promotes to int64; with x64 disabled that must raise
     (silent wrap would fabricate matches, e.g. 2**31 == -2**31)."""
-    import jax
+    from cylon_tpu.compat import enable_x64
 
     lt = ct.Table.from_pydict(ctx8, {"k": np.array([-(2**31)], np.int32)})
     rt = ct.Table.from_pydict(ctx8, {"k": np.array([2**31], np.uint32)})
-    with jax.enable_x64(False):
+    with enable_x64(False):
         with pytest.raises(ValueError, match="64-bit"):
             lt.join(rt, on="k", how="inner")
 

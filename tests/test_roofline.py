@@ -6,6 +6,7 @@ import sys
 
 import jax
 import jax.numpy as jnp
+from cylon_tpu.compat import shard_map
 import numpy as np
 import pytest
 
@@ -63,7 +64,7 @@ def test_recurses_into_jit_and_shard_map(devices):
         return s
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             kern, mesh=mesh,
             in_specs=PartitionSpec("dp"), out_specs=PartitionSpec("dp"),
         )

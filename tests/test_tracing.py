@@ -39,3 +39,21 @@ def test_shuffle_records_spans(ctx8, rng):
     rep = get_trace_report()
     assert rep["shuffle.count"]["count"] == 1
     assert rep["shuffle.exchange"]["count"] == 1
+
+
+def test_report_helper_prefix_filter(local_ctx):
+    from cylon_tpu.utils.tracing import bump, report, span
+
+    reset_trace()
+    with span("unit.a"):
+        pass
+    bump("unit.b", rows=3)
+    bump("other.c")
+    full = report()
+    assert {"unit.a", "unit.b", "other.c"} <= set(full)
+    only = report("unit.")
+    assert set(only) == {"unit.a", "unit.b"}
+    assert only["unit.b"]["rows"] == 3
+    # report returns copies: mutating it must not poison the registry
+    only["unit.b"]["rows"] = 999
+    assert report("unit.")["unit.b"]["rows"] == 3

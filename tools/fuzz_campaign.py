@@ -180,6 +180,61 @@ def skew_round_once(seed) -> bool:
     return ok
 
 
+def plan_round_once(seed) -> bool:
+    """Plan-vs-eager oracle round: build a random LazyFrame pipeline
+    (join [+ filter] -> groupby | sort | project), collect it through the
+    optimizer, and compare against the same pipeline composed from the
+    EAGER ops. The eager path is the oracle: the optimizer must never
+    change a result, only the work done to produce it."""
+    from cylon_tpu import col
+    from cylon_tpu.plan.expr import filter_mask
+
+    rng = np.random.default_rng(seed)
+    n_l = int(rng.integers(2, MAX_N))
+    n_r = int(rng.integers(2, MAX_N))
+    keyspace = int(rng.integers(1, 40))
+    dtype = str(rng.choice(["int32", "int64", "string"]))
+    null_p = float(rng.choice([0.0, 0.15]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    how = str(rng.choice(["inner", "left", "right"]))
+    filt = bool(rng.integers(0, 2))
+    tail = str(rng.choice(["groupby", "sort", "project"]))
+    agg_op = str(rng.choice(["sum", "min", "max", "count", "mean"]))
+    params = dict(seed=seed, profile="plan", n_l=n_l, n_r=n_r,
+                  keyspace=keyspace, dtype=dtype, null_p=null_p, world=world,
+                  how=how, filt=filt, tail=tail, agg=agg_op)
+    ctx = ctx_for(world)
+    ldf = rand_frame(rng, n_l, keyspace, dtype, null_p, "v")
+    rdf = rand_frame(rng, n_r, keyspace, dtype, null_p, "w").rename(
+        columns={"k": "rk"})
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+
+    lazy = lt.lazy().join(rt.lazy(), left_on="k", right_on="rk", how=how)
+    eager = lt.distributed_join(rt, left_on=["k"], right_on=["rk"], how=how)
+    if filt:
+        expr = col("v") > 0.0
+        lazy = lazy.filter(expr)
+        eager = eager.filter(filter_mask(
+            expr, {c: eager.column(c) for c in eager.column_names}))
+    if tail == "groupby":
+        lazy = lazy.groupby("k", {"v": agg_op})
+        eager = eager.distributed_groupby("k", {"v": agg_op})
+    elif tail == "sort":
+        lazy = lazy.sort("k")
+        eager = eager.distributed_sort("k")
+    else:
+        lazy = lazy.select(["k", "v"])
+        eager = eager.project(["k", "v"])
+    fired = lazy.explain()
+    got = lazy.collect().to_pandas()
+    want = eager.to_pandas()
+    ok = check(got, want, f"plan/{how}/{tail}", params)
+    if not ok:
+        print(fired, flush=True)
+    return ok
+
+
 def round_once(seed) -> bool:
     rng = np.random.default_rng(seed)
     n_l = int(rng.integers(1, MAX_N))
@@ -355,13 +410,16 @@ def main():
     ap.add_argument("--max-n", type=int, default=400,
                     help="upper bound on random table sizes (bigger stresses "
                          "respill/overflow/capacity-retry paths)")
-    ap.add_argument("--profile", choices=["default", "skew"], default="default",
+    ap.add_argument("--profile", choices=["default", "skew", "plan"],
+                    default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
-                         "of rows, world {4,8}, undersized fused capacities)")
+                         "of rows, world {4,8}, undersized fused capacities); "
+                         "'plan': LazyFrame-optimizer-vs-eager oracle rounds")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
-    fn = skew_round_once if args.profile == "skew" else round_once
+    fn = {"skew": skew_round_once, "plan": plan_round_once}.get(
+        args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
@@ -384,6 +442,8 @@ def main():
         # memory' + SIGSEGV). Clear aggressively; compile time is not
         # what a fuzz campaign optimizes for.
         if rounds % (3 if args.profile == "skew" else 10) == 0:
+            for c in CTXS.values():
+                c.__dict__.get("_plan_cache", {}).clear()
             import jax
 
             jax.clear_caches()
