@@ -212,6 +212,31 @@ def analyze(fn, *args, **kwargs) -> Report:
     return rep
 
 
+def traced_collectives(op, warm: bool = True):
+    """Run ``op`` with engine kernel recording on and return
+    (total traced collective count, per-program collective bytes for the
+    programs that issue any). The shared accounting of the shuffle bench's
+    CI gate and tests/test_shuffle_chunked.py. ``warm=True`` runs ``op``
+    once first so compilation happens outside the recorded call."""
+    from cylon_tpu import engine
+
+    if warm:
+        op()
+    engine.record_kernels(True)
+    try:
+        op()
+    finally:
+        kernels = engine.recorded_kernels()
+        engine.record_kernels(False)
+    count, per_bytes = 0, []
+    for fn, args in kernels:
+        rep = analyze(fn, *args)
+        count += rep.collective_count
+        if rep.collective_count:
+            per_bytes.append(rep.collective_bytes)
+    return count, per_bytes
+
+
 def model_seconds(rep: Report, hbm_gbps: float = HBM_GBPS_DEFAULT) -> float:
     """Bandwidth-bound lower time for the modeled traffic."""
     return rep.total_model_bytes / (hbm_gbps * 1e9)

@@ -111,15 +111,22 @@ def _roofline_recorded(extra: dict, hbm: float, measured_s: float, op) -> None:
 def _roofline(extra: dict, hbm: float, measured_s: float, fn, *args) -> None:
     """Attach model_s / pct_membw for a traced program to a record's extras.
     The traced (fn, args) MUST reproduce the measured path's exact
-    capacities — a different cap models a different kernel."""
-    if hbm <= 0:
-        return
+    capacities — a different cap models a different kernel.
+
+    Collective accounting (collectives / collective_mb) is attached even
+    with hbm<=0, exactly like :func:`_roofline_recorded` — the fused
+    single-program rows (dist_inner_join_fused / q3_fused) previously left
+    their BENCH.md colls / coll MB cells blank because only the
+    bandwidth-relative numbers were gated on a real chip's hbm."""
     try:
         from benchmarks.roofline import analyze, model_seconds, pct_membw
 
         rep = analyze(fn, *args)
-        extra["model_s"] = round(model_seconds(rep, hbm), 4)
-        extra["pct_membw"] = round(100 * pct_membw(rep, measured_s, hbm), 1)
+        extra["collectives"] = rep.collective_count
+        extra["collective_mb"] = round(rep.collective_bytes / 1e6, 2)
+        if hbm > 0:
+            extra["model_s"] = round(model_seconds(rep, hbm), 4)
+            extra["pct_membw"] = round(100 * pct_membw(rep, measured_s, hbm), 1)
         if rep.sort_pass_bytes:
             extra["sort_passes_bytes_gb"] = round(rep.sort_pass_bytes / 1e9, 2)
     except Exception as e:  # the model must never sink the bench
@@ -251,29 +258,41 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         "vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3),
         "host_syncs": fused_syncs, "host_syncs_eager": eager_syncs,
     }
-    if hbm > 0:
-        from cylon_tpu.engine import round_cap
-        from cylon_tpu.ops.join import INNER as _INNER
-        from cylon_tpu.parallel.pipeline import make_distributed_join_step
+    # traced even with hbm<=0: the collective cells are platform-free
+    from cylon_tpu.engine import round_cap
+    from cylon_tpu.ops.join import INNER as _INNER
+    from cylon_tpu.parallel import shuffle as _shmod
+    from cylon_tpu.parallel.pipeline import make_distributed_join_step
 
-        # reproduce _fused_join's EXACT first-attempt capacities
-        # (table.py _fused_join: capacity_factor=2.0, respill=1)
-        cap = max(left.shard_cap, right.shard_cap)
-        respill = 1
-        bucket_cap = round_cap(int(2.0 * cap / max(world, 1)))
-        if world > 1:
-            join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
-        else:
-            join_cap = round_cap(left.shard_cap + right.shard_cap)
-        js = make_distributed_join_step(
-            ctx.mesh, ctx.axis_name, (0,), (0,), _INNER,
-            bucket_cap=bucket_cap, join_cap=join_cap, respill=respill,
+    # reproduce _fused_join's EXACT first-attempt capacities
+    # (table.py _fused_join: capacity_factor=2.0, respill=1, and the
+    # byte-budget clamp of the chunked engine)
+    cap = max(left.shard_cap, right.shard_cap)
+    respill = 1
+    bucket_cap = round_cap(int(2.0 * cap / max(world, 1)))
+    if world > 1:
+        row_bytes = max(
+            _shmod.exchange_row_bytes(left._flat_cols()),
+            _shmod.exchange_row_bytes(right._flat_cols()),
         )
-        _roofline(
-            djf_extra, hbm, s, js,
-            (left._flat_cols(), left.counts_dev,
-             right._flat_cols(), right.counts_dev), (),
+        bucket_cap = min(
+            bucket_cap,
+            _shmod.budget_bucket_cap(
+                row_bytes, world, ctx.shuffle_byte_budget, bucket_cap
+            ),
         )
+        join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
+    else:
+        join_cap = round_cap(left.shard_cap + right.shard_cap)
+    js = make_distributed_join_step(
+        ctx.mesh, ctx.axis_name, (0,), (0,), _INNER,
+        bucket_cap=bucket_cap, join_cap=join_cap, respill=respill,
+    )
+    _roofline(
+        djf_extra, hbm, s, js,
+        (left._flat_cols(), left.counts_dev,
+         right._flat_cols(), right.counts_dev), (),
+    )
     record("dist_inner_join_fused", s, c, 2 * n_rows, world, djf_extra)
 
     # config 2: join + groupby aggregate (TPC-H Q3-ish)

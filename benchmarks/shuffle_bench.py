@@ -1,0 +1,185 @@
+"""Chunked-shuffle benchmark: sweep round counts (K) and per-round byte
+budgets, with TRACED per-round collective bytes — the same jaxpr-walking
+accounting BENCH.md uses to predict real ICI behavior (benchmarks/roofline).
+
+What it demonstrates / asserts:
+
+- the byte budget bounds PEAK per-round exchange bytes: every traced
+  collective program ships <= the effective budget (the budget, floored at
+  the engine's 8-row minimum bucket), while total shuffled volume stays
+  constant across K — chunking trades peak memory for rounds, not bytes;
+- the fused count/payload exchange: a distributed join issues exactly
+  2 collectives (one per side's shuffle), down from the pre-fusion 4;
+- the overlap machinery is live: ``tracing.report()`` carries the
+  ``shuffle.overlap_efficiency`` gauge and the per-round
+  ``shuffle.round.{pack,collective,compact}`` spans.
+
+Usage:
+  python benchmarks/shuffle_bench.py                   # full sweep
+  python benchmarks/shuffle_bench.py --rows 50000 --smoke   # CI gate:
+      fails (exit 1) on traced-collective-count or budget regressions
+Each result prints as a JSON line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import numpy as np
+
+
+def run(n_rows: int, world: int, devices, smoke: bool) -> int:
+    import cylon_tpu as ct
+    from benchmarks.roofline import traced_collectives
+    from cylon_tpu.parallel import shuffle as _sh
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+    rng = np.random.default_rng(7)
+    t = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, max(n_rows // 2, 1), n_rows).astype(np.int32),
+            "v": rng.normal(size=n_rows).astype(np.float32),
+        },
+    )
+    row_bytes = _sh.exchange_row_bytes(t._flat_cols())
+    failures = 0
+
+    # ---- sweep K via budgets sized for known round counts ------------------
+    # the K sweep runs on a ONE-HOT key table: every shard sends its whole
+    # (even) row split to a single destination, so the hottest (src,dst)
+    # bucket equals rows-per-shard EXACTLY and the sweep can target K
+    # through the planner's public inverse (shuffle.budget_for_rounds)
+    # without probing engine internals
+    th = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": np.zeros(n_rows, np.int32),
+            "v": rng.normal(size=n_rows).astype(np.float32),
+        },
+    )
+    max_bucket = int(th.row_counts.max())
+
+    # reference output (one maximal-budget exchange) for the differential
+    huge = 1 << 40
+    baseline = np.sort(th.shuffle(["k"], byte_budget=huge).to_pandas()["v"].to_numpy())
+
+    ks = [1, 2, 4, 8, 16] if not smoke else [1, 4, 16]
+    for k_target in ks:
+        budget = _sh.budget_for_rounds(max_bucket, k_target, world, row_bytes)
+        cap = budget // (world * row_bytes)
+
+        reset_trace()
+        t0 = time.perf_counter()
+        out = th.shuffle(["k"], byte_budget=budget)
+        wall = time.perf_counter() - t0
+        rep = report("shuffle.")
+        n_rounds = int(rep["shuffle.rounds"]["rows"])
+        overlap = rep["shuffle.overlap_efficiency"]["total_s"] / max(
+            rep["shuffle.overlap_efficiency"]["count"], 1
+        )
+
+        colls, per_bytes = traced_collectives(
+            lambda: th.shuffle(["k"], byte_budget=budget), warm=False
+        )
+        peak = max(per_bytes) if per_bytes else 0
+        effective_budget = max(budget, world * 8 * row_bytes)
+        # header overhead: one row per (src,dst) chunk per round
+        header_bytes = world * _sh.HEADER_ROWS * row_bytes
+        budget_ok = peak <= effective_budget + header_bytes
+        row = {
+            "bench": "chunked_shuffle",
+            "rows": n_rows,
+            "world": world,
+            "k_target": k_target,
+            "rounds": n_rounds,
+            "byte_budget": budget,
+            "bucket_cap": cap,
+            "wall_s": round(wall, 4),
+            "collectives": colls,
+            "peak_round_coll_bytes": peak,
+            "total_coll_mb": round(sum(per_bytes) / 1e6, 3),
+            "peak_within_budget": bool(budget_ok),
+            "overlap_efficiency": round(overlap, 4),
+        }
+        print(json.dumps(row), flush=True)
+        if not budget_ok:
+            print(
+                f"FAIL: K={k_target} peak per-round collective bytes {peak} "
+                f"> budget {effective_budget} (+header {header_bytes})",
+                file=sys.stderr,
+            )
+            failures += 1
+        if colls != n_rounds:
+            print(
+                f"FAIL: K={k_target} traced {colls} collectives for "
+                f"{n_rounds} rounds (fused exchange = exactly one per round)",
+                file=sys.stderr,
+            )
+            failures += 1
+        if k_target > 1 and n_rounds < 2:
+            print(f"FAIL: K={k_target} budget did not force chunking", file=sys.stderr)
+            failures += 1
+        got = np.sort(out.to_pandas()["v"].to_numpy())
+        if not np.allclose(got, baseline):
+            print(f"FAIL: K={k_target} chunked output != unchunked", file=sys.stderr)
+            failures += 1
+
+    # ---- the collective-count gate: distributed join == 2 ------------------
+    r = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, max(n_rows // 2, 1), n_rows // 2).astype(np.int32),
+            "w": rng.normal(size=n_rows // 2).astype(np.float32),
+        },
+    )
+    colls, _per = traced_collectives(
+        lambda: t.distributed_join(r, on="k", how="inner")
+    )
+    row = {"bench": "dist_join_collectives", "world": world, "collectives": colls}
+    print(json.dumps(row), flush=True)
+    if colls != 2:
+        print(
+            f"FAIL: distributed join traced {colls} collectives, expected 2 "
+            "(count exchange fused into the payload header)",
+            file=sys.stderr,
+        )
+        failures += 1
+    return failures
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=int(os.environ.get("BENCH_ROWS", 500_000)))
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small sweep + hard assertions (CI gate)")
+    args = ap.parse_args()
+
+    import __graft_entry__ as ge
+
+    devices = ge._force_cpu_mesh(max(args.world, 1))
+    d0 = devices[0]
+    print(
+        f"# platform={d0.platform} mesh={args.world} rows={args.rows}",
+        file=sys.stderr,
+    )
+    failures = run(args.rows, args.world, devices, args.smoke)
+    if failures:
+        print(f"# {failures} FAILURES", file=sys.stderr)
+        sys.exit(1)
+    print("# shuffle bench ok", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,34 @@ concrete backends are TPU-native:
 from __future__ import annotations
 
 import enum
+import os
 from typing import Any, Dict, Optional, Sequence
+
+# ----------------------------------------------------------------------
+# chunked-shuffle byte budget (parallel/shuffle.py plan_rounds)
+# ----------------------------------------------------------------------
+# Per-round, per-shard cap on the shuffle exchange buffer: the engine sizes
+# bucket_cap so ``world * bucket_cap * row_bytes <= budget`` and drains the
+# table over ceil(hottest_bucket / bucket_cap) rounds — peak shuffle memory
+# is O(budget), not O(max-shard padding), which is what lets tables far
+# larger than the budget shuffle without the full padded buffer ever
+# materializing. Override per context via
+# ``ctx.add_config("shuffle_byte_budget", str(n))`` / ``TPUConfig
+# .add_config``, per call via the ``byte_budget=`` kwarg, or process-wide
+# via CYLON_TPU_SHUFFLE_BUDGET.
+DEFAULT_SHUFFLE_BYTE_BUDGET = 32 * 1024 * 1024
+
+
+def shuffle_byte_budget(configured: Optional[object] = None) -> int:
+    """Resolve the effective per-round shuffle byte budget: an explicit
+    value wins, then the CYLON_TPU_SHUFFLE_BUDGET env var, then the
+    module default."""
+    if configured:
+        return int(configured)
+    env = os.environ.get("CYLON_TPU_SHUFFLE_BUDGET", "")
+    if env:
+        return int(env)
+    return DEFAULT_SHUFFLE_BYTE_BUDGET
 
 
 class CommType(enum.IntEnum):

@@ -142,6 +142,15 @@ class CylonContext:
     def get_config(self, key: str, default: str = "") -> str:
         return self._config.get(key, default)
 
+    @property
+    def shuffle_byte_budget(self) -> int:
+        """Effective per-round chunked-shuffle byte budget for this context
+        (config KV ``shuffle_byte_budget`` > CYLON_TPU_SHUFFLE_BUDGET env >
+        config.DEFAULT_SHUFFLE_BYTE_BUDGET)."""
+        from .config import shuffle_byte_budget
+
+        return shuffle_byte_budget(self._config.get("shuffle_byte_budget"))
+
     # -- sequencing (reference GetNextSequence, cylon_context.cpp:106) ------
     def get_next_sequence(self) -> int:
         return next(self._sequence)

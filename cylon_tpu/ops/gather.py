@@ -73,22 +73,42 @@ def _from_lanes(lanes: List[jax.Array], tag: str) -> jax.Array:
     return u.astype(dt)
 
 
+def lane_plan(cols: Sequence[KeyCol]):
+    """The lane-codec PLAN of a column set from dtypes alone (no device
+    work): (tag-or-None, n_lanes, has_valid) per column — a None tag marks
+    an f64 column that has no 32-bit lane route on TPU and must be
+    transported separately. Kernels that receive already-packed lane
+    buffers (the chunked shuffle's compact phase) rebuild the plan with
+    this instead of re-encoding the columns."""
+    plan = []
+    for data, valid in cols:
+        dt = data.dtype
+        if dt == jnp.float64:
+            plan.append((None, 0, valid is not None))
+        elif np.dtype(dt).itemsize == 8:
+            plan.append((str(dt), 2, valid is not None))  # hi/lo split
+        elif dt == jnp.bool_:
+            plan.append(("bool", 1, valid is not None))
+        elif dt == jnp.int32:
+            plan.append(("int32", 1, valid is not None))
+        else:
+            plan.append((str(dt), 1, valid is not None))
+    return plan
+
+
 def pack_cols(cols: Sequence[KeyCol]):
     """Shared lane-plan builder: encode every column (+ validity) as int32
-    lanes. Returns (plan, lanes, passthrough) where plan entries are
-    (tag-or-None, n_lanes, has_valid) — a None tag marks an f64 column that
-    has no 32-bit lane route on TPU and must be transported separately —
-    and passthrough maps column position -> its raw f64 data."""
-    plan = []
+    lanes. Returns (plan, lanes, passthrough) where plan entries follow
+    :func:`lane_plan` and passthrough maps column position -> its raw f64
+    data. NOTE: an f64 column's VALIDITY lane still rides ``lanes``."""
+    plan = lane_plan(cols)
     lanes: List[jax.Array] = []
     passthrough = {}
     for ci, (data, valid) in enumerate(cols):
-        if data.dtype == jnp.float64:
-            plan.append((None, 0, valid is not None))
+        if plan[ci][0] is None:
             passthrough[ci] = data
         else:
-            dl, tag = _to_lanes(data)
-            plan.append((tag, len(dl), valid is not None))
+            dl, _tag = _to_lanes(data)
             lanes.extend(dl)
         if valid is not None:
             lanes.append(valid.astype(jnp.int32))

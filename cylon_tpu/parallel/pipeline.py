@@ -52,9 +52,12 @@ def _shuffle_rounds(
 ) -> Tuple[ShardTable, jax.Array]:
     """The shared respill-round loop: ``dest_fn(r) -> (dest, leftover)``
     supplies each round's send slots (plain hash shuffle or one hash
-    slice of a SlicePlan); everything else — count exchange, packed
-    column exchange, mask accumulation, compaction, overflow psum — is
-    identical machinery and lives ONCE here."""
+    slice of a SlicePlan); everything else — header-fused exchange, mask
+    accumulation, compaction, overflow psum — is identical machinery and
+    lives ONCE here. The per-round receive counts ride the payload
+    collective's header lanes (shuffle.exchange_columns_fused), so each
+    round is ONE all_to_all instead of a count exchange + a payload
+    exchange — half the collectives per fused shuffle."""
     rounds = 1 + respill
     parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
     masks = []
@@ -62,10 +65,10 @@ def _shuffle_rounds(
     leftover = jnp.int32(0)
     for r in range(rounds):
         dest, leftover = dest_fn(r)
-        recv_counts = _sh.exchange_counts(
-            _sh.round_counts(cnt, bucket_cap, r), axis_name
+        got, recv_counts = _sh.exchange_columns_fused(
+            st.cols, dest, _sh.round_counts(cnt, bucket_cap, r),
+            world, bucket_cap, axis_name,
         )
-        got = _sh.exchange_columns(st.cols, dest, world, bucket_cap, axis_name)
         for ci, dv in enumerate(got):
             parts[ci].append(dv)
         mask_r, total_r = _sh.received_row_mask(recv_counts, world, bucket_cap)

@@ -5,12 +5,19 @@ messages (cpp/src/cylon/net/mpi/mpi_channel.cpp:30-233), the buffer-level
 AllToAll with per-target queues + FIN protocol (net/ops/all_to_all.cpp:64-177)
 and the Arrow-aware table reassembly (arrow/arrow_all_to_all.cpp:68-231).
 
-TPU-native design: none of that machinery survives. One ``lax.all_to_all``
-over the ICI mesh moves all buckets of all columns in a single fused XLA
-collective; "reassembly" is a compaction argsort. Raggedness (the reference
-streams variable-size byte buffers) is handled by the static-shape two-phase
-recipe from SURVEY.md §7: exchange exact bucket counts (cheap int all_to_all),
-let the host pick the bucket capacity, then exchange padded buckets.
+TPU-native design: none of that machinery survives. The exchange is a
+CHUNKED pipeline of bounded-size ``lax.all_to_all`` rounds (Exoshuffle's
+composable-rounds thesis, PAPERS.md): the host sizes ``bucket_cap`` from a
+per-round BYTE BUDGET (:func:`plan_rounds`; config.py) so peak exchange
+memory is O(budget) instead of O(max-shard padding), hot buckets drain over
+``ceil(count/cap)`` rounds, and each round's per-destination send counts
+ride HEADER ROWS of the packed lane buffer (:func:`pack_lane_buffer` /
+:func:`split_header`) — one collective per round moves the payload AND the
+counts, so a distributed join issues 2 collectives, not 4. "Reassembly" is
+a lane-level compaction argsort (:func:`compact_received_lanes`). The
+round scheduler and double-buffered dispatch live in
+``table.py _shuffle_many``; the fused pipeline composes the same
+primitives in-program via :func:`exchange_columns_fused`.
 
 Runs inside ``shard_map``; every function here is per-shard code.
 """
@@ -22,9 +29,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.gather import pack_cols, pack_gather, unpack_cols
+from ..ops.gather import lane_plan, pack_cols, pack_gather, unpack_cols
 
 Cols = Sequence[Tuple[jax.Array, Optional[jax.Array]]]
+
+# one header row per (src, dst) chunk of the lane buffer carries that
+# round's send count in lane 0 — the count exchange rides the payload
+# all_to_all instead of being its own collective (2 collectives per
+# distributed join instead of 4)
+HEADER_ROWS = 1
+
+# dispatch-count bound for extreme skew: past this many rounds the planner
+# raises bucket_cap (over the byte budget) rather than exploding round count
+DEFAULT_MAX_ROUNDS = 16
 
 
 def bucket_counts(pid: jax.Array, num_partitions: int) -> jax.Array:
@@ -84,14 +101,6 @@ def build_send_slots_round(
         (spid < num_partitions) & (pos >= (r + 1) * bucket_cap)
     ).astype(jnp.int32)
     return dest, leftover
-
-
-def build_send_slots(
-    pid: jax.Array, counts: jax.Array, num_partitions: int, bucket_cap: int
-) -> Tuple[jax.Array, jax.Array]:
-    """Round 0 of :func:`build_send_slots_round`: (dest, overflow) where
-    overflow counts rows that did not fit their bucket."""
-    return build_send_slots_round(pid, counts, num_partitions, bucket_cap, 0)
 
 
 class SlicePlan(NamedTuple):
@@ -172,6 +181,170 @@ def round_counts(counts: jax.Array, bucket_cap: int, round_idx) -> jax.Array:
     return jnp.clip(counts - r * bucket_cap, 0, bucket_cap)
 
 
+# ----------------------------------------------------------------------
+# chunked-round planning (the byte-budget knob, config.py)
+# ----------------------------------------------------------------------
+
+def exchange_row_bytes(cols: Cols) -> int:
+    """Bytes one row occupies in the round exchange buffers: 4 per int32
+    lane of the packed codec (incl. validity lanes and the hi/lo split of
+    64-bit ints), 8 per f64 passthrough column. This is what converts the
+    per-round byte budget into a bucket capacity."""
+    total = 0
+    for tag, n_lanes, has_valid in lane_plan(cols):
+        total += 8 if tag is None else 4 * n_lanes
+        total += 4 if has_valid else 0
+    return max(total, 1)
+
+
+def budget_bucket_cap(
+    row_bytes: int, num_partitions: int, byte_budget: int, max_cap: int
+) -> int:
+    """Largest power-of-two bucket_cap (<= max_cap) whose per-round send
+    buffer ``P * cap * row_bytes`` fits the budget. Floor 8 (the engine
+    minimum) — a budget below the floor's footprint is satisfied as closely
+    as static shapes allow."""
+    cap = 8
+    while 2 * cap <= max_cap and (
+        num_partitions * 2 * cap * row_bytes <= byte_budget
+    ):
+        cap *= 2
+    return cap
+
+
+def budget_for_rounds(
+    max_bucket: int, k: int, num_partitions: int, row_bytes: int
+) -> int:
+    """Inverse of the budget bound: the byte budget that targets
+    ``bucket_cap = round_cap(max(ceil(max_bucket / k), 8))`` and hence
+    ~k rounds over a hottest bucket of ``max_bucket`` rows. The single
+    source of the arithmetic used by benchmarks/tests/fuzz to sweep K —
+    if :func:`plan_rounds`' floor or rounding changes, this moves with it."""
+    from ..engine import round_cap
+
+    cap = round_cap(max(-(-max_bucket // max(k, 1)), 8))
+    return num_partitions * cap * row_bytes
+
+
+def plan_rounds(
+    send_counts: np.ndarray,
+    row_bytes: int,
+    num_partitions: int,
+    byte_budget: int,
+    max_rounds: int = DEFAULT_MAX_ROUNDS,
+) -> Tuple[int, int]:
+    """(bucket_cap, n_rounds) for the chunked exchange.
+
+    bucket_cap is the tightest of three bounds: the full hot-bucket cap
+    (one round, no chunking), the skew-balancing cap (4x the mean bucket —
+    a hot bucket drains over rounds instead of inflating every bucket),
+    and the BYTE-BUDGET cap (peak per-round exchange memory is
+    O(P * cap * row_bytes) <= budget, so a table K times the budget
+    shuffles in K bounded rounds without the full padded buffer ever
+    materializing). n_rounds = ceil(hottest bucket / cap), bounded by
+    ``max_rounds`` (beyond it the cap grows past the budget — dispatch
+    count is the scarcer resource under extreme skew).
+    """
+    from ..engine import round_cap
+
+    max_cnt = int(send_counts.max()) if send_counts.size else 0
+    mean_bucket = -(-int(send_counts.sum()) // max(send_counts.size, 1))
+    c_full = round_cap(max_cnt)
+    cap = c_full
+    c_balanced = round_cap(4 * max(mean_bucket, 1))
+    if c_balanced < cap:
+        cap = c_balanced
+    c_budget = budget_bucket_cap(row_bytes, num_partitions, byte_budget, c_full)
+    if c_budget < cap:
+        cap = c_budget
+    n_rounds = max(-(-max_cnt // cap), 1)
+    if n_rounds > max_rounds:
+        cap = round_cap(-(-max_cnt // max_rounds))
+        n_rounds = max(-(-max_cnt // cap), 1)
+    return cap, n_rounds
+
+
+# ----------------------------------------------------------------------
+# send-side pack / collective / receive-side split (the three phases of a
+# chunked round; the fused pipeline composes them in one program, the eager
+# engine dispatches them as separate overlapped programs)
+# ----------------------------------------------------------------------
+
+def scatter_send(
+    data: jax.Array, dest: jax.Array, num_partitions: int, bucket_cap: int
+) -> jax.Array:
+    """Scatter one column into its padded [P * bucket_cap, *trailing] send
+    buffer (the pack phase of an un-headered exchange)."""
+    trailing = data.shape[1:]
+    return jnp.zeros((num_partitions * bucket_cap, *trailing), data.dtype).at[
+        dest
+    ].set(data, mode="drop")
+
+
+def header_slots(dest: jax.Array, num_partitions: int, bucket_cap: int) -> jax.Array:
+    """Remap plain send slots into the header-augmented buffer layout
+    [P * (bucket_cap + HEADER_ROWS)]: each chunk's data rows shift down by
+    its header row(s); the dropped sentinel follows along."""
+    pid = dest // bucket_cap  # == num_partitions for the dropped sentinel
+    return jnp.where(
+        dest >= num_partitions * bucket_cap,
+        num_partitions * (bucket_cap + HEADER_ROWS),
+        dest + (pid + 1) * HEADER_ROWS,
+    ).astype(jnp.int32)
+
+
+def pack_lane_buffer(
+    lanes: List[jax.Array],
+    dest: jax.Array,
+    counts_round: jax.Array,
+    num_partitions: int,
+    bucket_cap: int,
+) -> jax.Array:
+    """Stack the int32 lanes and scatter them into the header-augmented
+    send buffer [P * (bucket_cap + 1), L]; row 0 of each destination chunk
+    carries this shard's round send count for that destination in lane 0
+    (the fused count exchange)."""
+    packed = jnp.stack(lanes, axis=1)  # [cap, L]
+    L = packed.shape[1]
+    rows = bucket_cap + HEADER_ROWS
+    buf = jnp.zeros((num_partitions * rows, L), jnp.int32)
+    buf = buf.at[
+        jnp.arange(num_partitions, dtype=jnp.int32) * rows, 0
+    ].set(counts_round.astype(jnp.int32))
+    return buf.at[header_slots(dest, num_partitions, bucket_cap)].set(
+        packed, mode="drop"
+    )
+
+
+def exchange_buffer(buf: jax.Array, num_partitions: int, axis_name: str) -> jax.Array:
+    """all_to_all a [P * rows, *trailing] send buffer; chunk s of the output
+    holds what source shard s sent."""
+    trailing = buf.shape[1:]
+    rows = buf.shape[0] // num_partitions
+    return jax.lax.all_to_all(
+        buf.reshape(num_partitions, rows, *trailing),
+        axis_name,
+        split_axis=0,
+        concat_axis=0,
+        tiled=False,
+    ).reshape(num_partitions * rows, *trailing)
+
+
+def split_header(
+    got: jax.Array, num_partitions: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Strip the header rows off a received lane buffer: (data rows
+    [P * bucket_cap, L], recv_counts [P] — entry s = rows source shard s
+    sent this round)."""
+    rows = got.shape[0] // num_partitions
+    g = got.reshape(num_partitions, rows, *got.shape[1:])
+    recv_counts = g[:, 0, 0].astype(jnp.int32)
+    data = g[:, HEADER_ROWS:].reshape(
+        num_partitions * (rows - HEADER_ROWS), *got.shape[1:]
+    )
+    return data, recv_counts
+
+
 def exchange_column(
     data: jax.Array, dest: jax.Array, num_partitions: int, bucket_cap: int,
     axis_name: str,
@@ -183,17 +356,8 @@ def exchange_column(
     sent by source shard s (front-packed within the chunk, garbage after its
     count).
     """
-    trailing = data.shape[1:]
-    buf = jnp.zeros((num_partitions * bucket_cap, *trailing), data.dtype).at[
-        dest
-    ].set(data, mode="drop")
-    return jax.lax.all_to_all(
-        buf.reshape(num_partitions, bucket_cap, *trailing),
-        axis_name,
-        split_axis=0,
-        concat_axis=0,
-        tiled=False,
-    ).reshape(num_partitions * bucket_cap, *trailing)
+    buf = scatter_send(data, dest, num_partitions, bucket_cap)
+    return exchange_buffer(buf, num_partitions, axis_name)
 
 
 def exchange_columns(
@@ -226,6 +390,44 @@ def exchange_columns(
     return out
 
 
+def exchange_columns_fused(
+    cols: Cols,
+    dest: jax.Array,
+    counts_round: jax.Array,
+    num_partitions: int,
+    bucket_cap: int,
+    axis_name: str,
+) -> Tuple[List[Tuple[jax.Array, Optional[jax.Array]]], jax.Array]:
+    """:func:`exchange_columns` with the COUNT EXCHANGE FUSED into the
+    payload collective: the per-destination round send counts ride the
+    header row of the packed lane buffer, so one all_to_all moves the whole
+    table AND the counts (vs a dedicated count collective per round — this
+    is what takes a distributed join from 4 collectives to 2).
+
+    Returns (received cols, recv_counts [P]). Tables with no int32 lanes at
+    all (pure f64, no validity masks) fall back to a dedicated tiny count
+    exchange — there is no lane buffer for the header to ride.
+    """
+    plan, lanes, passthrough = pack_cols(cols)
+    out_lanes: List[jax.Array] = []
+    if lanes:
+        buf = pack_lane_buffer(lanes, dest, counts_round, num_partitions, bucket_cap)
+        got = exchange_buffer(buf, num_partitions, axis_name)
+        data, recv_counts = split_header(got, num_partitions)
+        out_lanes = [data[:, j] for j in range(data.shape[1])]
+    else:
+        recv_counts = exchange_counts(counts_round, axis_name)
+    out, _ = unpack_cols(
+        plan,
+        out_lanes,
+        lambda ci: exchange_column(
+            passthrough[ci], dest, num_partitions, bucket_cap, axis_name
+        ),
+        lambda lane: None if lane is None else lane.astype(jnp.bool_),
+    )
+    return out, recv_counts
+
+
 def received_row_mask(
     recv_counts: jax.Array, num_partitions: int, bucket_cap: int
 ) -> Tuple[jax.Array, jax.Array]:
@@ -234,6 +436,32 @@ def received_row_mask(
     src = jnp.arange(num_partitions * bucket_cap, dtype=jnp.int32) // bucket_cap
     mask = slot < recv_counts[src]
     return mask, jnp.sum(recv_counts).astype(jnp.int32)
+
+
+def compact_received_lanes(
+    plan,
+    lane_rows: Optional[jax.Array],
+    pt_cols: dict,
+    mask: jax.Array,
+) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
+    """Receive-side compaction straight at the LANE level: one stable sort
+    by liveness + ONE gather of the already-packed [rows, L] lane matrix
+    (plus one per f64 passthrough column), then unpack. The chunked
+    engine's compact phase uses this instead of :func:`compact_received`,
+    which would re-pack rows that arrived packed."""
+    order = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    out_lanes: List[jax.Array] = []
+    if lane_rows is not None and lane_rows.shape[1]:
+        g = lane_rows[order]
+        out_lanes = [g[:, j] for j in range(g.shape[1])]
+    sorted_pt = {ci: d[order] for ci, d in pt_cols.items()}
+    out, _ = unpack_cols(
+        plan,
+        out_lanes,
+        lambda ci: sorted_pt[ci],
+        lambda lane: None if lane is None else lane.astype(jnp.bool_),
+    )
+    return out
 
 
 def compact_received(

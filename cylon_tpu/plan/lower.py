@@ -98,15 +98,20 @@ def _peel_shuffle(child: Node, keys: Sequence[str]):
 def _prepare_join_inputs(lt, rt, l_keys, r_keys, l_shuf: bool, r_shuf: bool):
     """The join-input invariant in ONE place (used by Join and the fused
     node): unify dictionaries and promote key dtypes BEFORE hashing, then
-    replay the peeled planner Shuffles on the prepared pair."""
-    from ..table import _promote_key_pair, _unify_dict_pair
+    replay the peeled planner Shuffles on the prepared pair. When BOTH
+    sides re-partition, one chunked-engine call shuffles the pair with
+    interleaved round dispatch (table._shuffle_pair) — the lazy path picks
+    up the same overlap and byte-budget plumbing as the eager join."""
+    from ..table import _promote_key_pair, _shuffle_pair, _unify_dict_pair
 
     lt, rt = _unify_dict_pair(lt, rt, l_keys, r_keys)
     lt, rt = _promote_key_pair(lt, rt, l_keys, r_keys)
     if lt.world_size > 1:
-        if l_shuf:
+        if l_shuf and r_shuf:
+            lt, rt = _shuffle_pair(lt, l_keys, rt, r_keys)
+        elif l_shuf:
             lt = lt._shuffle_impl(kind="hash", key_names=l_keys)
-        if r_shuf:
+        elif r_shuf:
             rt = rt._shuffle_impl(kind="hash", key_names=r_keys)
     return lt, rt
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import numbers
 from collections import OrderedDict
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +40,7 @@ from .ops import setops as _s
 from .ops import gather as _g_pack
 from .ops import sort as _sort_mod
 from .parallel import shuffle as _sh
-from .utils.tracing import bump, span
+from .utils.tracing import bump, gauge, span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
@@ -48,6 +48,7 @@ KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 # forces the exact two-phase count->emit path
 import operator as _op
 import os as _os
+import time as _time
 
 _SPECULATIVE_JOIN = _os.environ.get("CYLON_TPU_EXACT_JOIN", "0") != "1"
 
@@ -930,13 +931,22 @@ class Table:
     # ------------------------------------------------------------------
     # shuffle (the distributed backbone)
     # ------------------------------------------------------------------
-    def shuffle(self, hash_columns: Sequence[Union[str, int]]) -> "Table":
+    def shuffle(
+        self,
+        hash_columns: Sequence[Union[str, int]],
+        byte_budget: Optional[int] = None,
+    ) -> "Table":
         """Reference Shuffle (table.cpp:910-921): hash-partition on the given
-        columns to world_size partitions + all-to-all."""
+        columns to world_size partitions + the chunked all-to-all.
+        ``byte_budget`` caps the per-round exchange buffer (default: the
+        context's ``shuffle_byte_budget``); smaller budgets trade one big
+        padded exchange for more bounded-size rounds."""
         names = self._resolve_cols(hash_columns)
         if self.world_size == 1:
             return self
-        return self._shuffle_impl(kind="hash", key_names=names)
+        return self._shuffle_impl(
+            kind="hash", key_names=names, byte_budget=byte_budget
+        )
 
     def _key_hash_cols(self, key_names: Sequence[str]) -> List[KeyCol]:
         """Key columns for HASH partitioning, with dictionary columns replaced
@@ -962,126 +972,21 @@ class Table:
         asc0: bool = True,
         num_bins: int = 0,
         task_map: Optional[np.ndarray] = None,
+        byte_budget: Optional[int] = None,
     ) -> "Table":
-        """hash/range partition -> exact-size exchange -> padded all_to_all ->
-        compact (SURVEY.md §7 stage 5; reference shuffle_table_by_hashing
-        table.cpp:135-157 / MapToSortPartitions partition.cpp:168-198)."""
-        ctx = self.ctx
-        world = ctx.world_size
-        all_names = self.column_names
-        key_idx = tuple(all_names.index(n) for n in key_names)
-        flat = self._flat_cols()
-        khash = tuple(self._key_hash_cols(key_names))
-        ax = ctx.axis_name
-        nb = num_bins if num_bins else 16 * world
-
-        if task_map is not None:
-            task_map_dev = jnp.asarray(np.asarray(task_map, np.int32))
-
-        def compute_pid(cols, kcols, n):
-            if kind == "hash":
-                return _p.hash_partition_ids(kcols, n, world)
-            if kind == "task":
-                # rows already carry logical task ids in the key column;
-                # route task t to worker task_map[t] (reference
-                # LogicalTaskPlan task->worker mapping,
-                # arrow_task_all_to_all.h:23-40)
-                tasks, _ = cols[key_idx[0]]
-                cap = tasks.shape[0]
-                live = jnp.arange(cap, dtype=jnp.int32) < n
-                wid = task_map_dev[jnp.clip(tasks, 0, len(task_map) - 1)]
-                return jnp.where(live, wid, world).astype(jnp.int32)
-            keys = [cols[i] for i in key_idx]
-            return _p.range_partition_ids(
-                keys[0], n, world, num_bins=nb, axis_name=ax, ascending=asc0
-            )
-
-        tm_key = tuple(np.asarray(task_map).tolist()) if task_map is not None else None
-        key = ("shuffle", kind, key_idx, asc0, nb, len(flat), tm_key)
-
-        def build_count():
-            def kern(dp, rep):
-                (cols, kcols, counts) = dp
-                n = counts[0]
-                pid = compute_pid(cols, kcols, n)
-                return _sh.bucket_counts(pid, world)
-
-            return kern
-
-        with span("shuffle.count", rows=int(self.row_count)):
-            send_counts = get_kernel(ctx, key + ("count",), build_count)(
-                (flat, khash, self.counts_dev), ()
-            )
-            bump("host_sync")
-            send_counts = _fetch(send_counts).reshape(world, world)  # [src, dst]
-        new_counts = send_counts.sum(axis=0).astype(np.int64)  # rows per dst
-
-        # Skew-robust capacity (reference sidesteps raggedness by streaming
-        # bytes, arrow_all_to_all.cpp:83-141 — impossible under XLA static
-        # shapes): a single all_to_all must give EVERY (src,dst) bucket the
-        # same capacity, so one hot bucket would inflate the whole exchange
-        # and the output table by P x. Instead the exchange runs in
-        # ceil(max_bucket / C) rounds at a balanced capacity C; hot buckets
-        # drain across rounds (the two-round-respill plan of SURVEY.md §7,
-        # generalized to K rounds with ONE compiled program — the round index
-        # is a traced scalar).
-        max_cnt = int(send_counts.max())
-        mean_bucket = -(-int(send_counts.sum()) // (world * world))  # ceil
-        c_full = round_cap(max_cnt)
-        c_balanced = round_cap(4 * max(mean_bucket, 1))
-        if c_balanced < c_full:
-            bucket_cap = c_balanced
-            n_rounds = -(-max_cnt // bucket_cap)
-            if n_rounds > 16:  # bound dispatch count for extreme skew
-                bucket_cap = round_cap(-(-max_cnt // 16))
-                n_rounds = -(-max_cnt // bucket_cap)
-        else:
-            bucket_cap, n_rounds = c_full, 1
-
-        def build_emit():
-            def kern(dp, rep):
-                (cols, kcols, counts) = dp
-                (dummy, rnd) = rep
-                bc = dummy.shape[0]
-                n = counts[0]
-                pid = compute_pid(cols, kcols, n)
-                cnt = _sh.bucket_counts(pid, world)
-                dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
-                recv_counts = _sh.exchange_counts(
-                    _sh.round_counts(cnt, bc, rnd), ax
+        """hash/range partition -> chunked header-fused exchange -> compact
+        (SURVEY.md §7 stage 5; reference shuffle_table_by_hashing
+        table.cpp:135-157 / MapToSortPartitions partition.cpp:168-198).
+        The round scheduler lives in :func:`_shuffle_many`; ``byte_budget``
+        overrides the context's per-round exchange budget."""
+        return _shuffle_many(
+            [
+                _ShuffleSpec(
+                    self, kind, tuple(key_names), asc0, num_bins, task_map,
+                    byte_budget,
                 )
-                out_cols = _sh.exchange_columns(cols, dest, world, bc, ax)
-                mask, total = _sh.received_row_mask(recv_counts, world, bc)
-                out_cols = _sh.compact_received(out_cols, mask)
-                return out_cols, _scalar(total)
-
-            return kern
-
-        src_pairs = list(zip(all_names, self._columns.values()))
-        rounds: List["Table"] = []
-        with span("shuffle.exchange", rows=int(self.row_count)):
-            for r in range(n_rounds):
-                out, nout = get_kernel(ctx, key + ("emit",), build_emit)(
-                    (flat, khash, self.counts_dev),
-                    (jnp.zeros((bucket_cap,), jnp.int8), jnp.asarray(r, jnp.int32)),
-                )
-                got = self._out_counts(nout)
-                expect = (
-                    np.clip(send_counts - r * bucket_cap, 0, bucket_cap)
-                    .sum(axis=0)
-                    .astype(np.int64)
-                )
-                if not (got == expect).all():
-                    raise RuntimeError(
-                        f"shuffle round {r}: received row counts {got} != "
-                        f"expected {expect} — internal routing bug"
-                    )
-                rounds.append(
-                    self._rebuild_cols(src_pairs, out, got, world * bucket_cap)
-                )
-        res = rounds[0] if n_rounds == 1 else _concat_tables(rounds)
-        # compact single-round output when the uniform bucket sizing overshot
-        return res._maybe_compact(new_counts, factor=2)
+            ]
+        )[0]
 
     def task_partition(
         self, hash_columns: Sequence[Union[str, int]], plan
@@ -1504,8 +1409,10 @@ class Table:
         # independently, and murmur words depend on the physical dtype — an
         # int32 5 and int64 5 would otherwise land on different shards
         left, right = _promote_key_pair(left, right, l_names, r_names)
-        ls = left._shuffle_impl(kind="hash", key_names=l_names)
-        rs = right._shuffle_impl(kind="hash", key_names=r_names)
+        # one engine call for both sides: the two shuffles' rounds interleave
+        # in the dispatch queue (pack of one hides behind the collective of
+        # the other) instead of serializing table-by-table
+        ls, rs = _shuffle_pair(left, l_names, right, r_names)
         return ls.join(rs, **kwargs)
 
     def _fused_join(
@@ -1563,6 +1470,20 @@ class Table:
             )
         )
         if world > 1:
+            # thread the chunked engine's byte budget through the fused
+            # path: cap the per-round exchange buffer the same way the
+            # eager engine does (an undersized first attempt is recovered
+            # by the overflow retry loop below, which may exceed the
+            # budget — correctness over memory)
+            row_bytes = max(
+                _sh.exchange_row_bytes(lflat), _sh.exchange_row_bytes(rflat)
+            )
+            bucket_cap = min(
+                bucket_cap,
+                _sh.budget_bucket_cap(
+                    row_bytes, world, ctx.shuffle_byte_budget, bucket_cap
+                ),
+            )
             join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
         else:
             join_cap = round_cap(cap_l + cap_r)
@@ -1820,12 +1741,12 @@ class Table:
 
     def _dist_setop(self, other: "Table", op: str) -> "Table":
         """Reference DoDistributedSetOperation (table.cpp:727-785): shuffle
-        both tables on ALL columns, then run the local op per shard."""
+        both tables on ALL columns — through ONE chunked-engine call, so the
+        pair's exchange rounds overlap — then run the local op per shard."""
         if self.world_size == 1:
             return getattr(self, op)(other)
         a, b = self._setop_pair(other)
-        asf = a._shuffle_impl(kind="hash", key_names=a.column_names)
-        bsf = b._shuffle_impl(kind="hash", key_names=b.column_names)
+        asf, bsf = _shuffle_pair(a, a.column_names, b, b.column_names)
         return getattr(asf, op)(bsf)
 
     # ------------------------------------------------------------------
@@ -2688,6 +2609,285 @@ class Table:
         if left_on is None or right_on is None:
             raise ValueError("join requires `on` or both `left_on`/`right_on`")
         return self._resolve_cols(left_on), other._resolve_cols(right_on)
+
+
+# ----------------------------------------------------------------------
+# the chunked, compute-overlapped shuffle engine
+# ----------------------------------------------------------------------
+
+class _ShuffleSpec(NamedTuple):
+    """One table's shuffle request for :func:`_shuffle_many`."""
+
+    table: "Table"
+    kind: str
+    key_names: Tuple[str, ...]
+    asc0: bool = True
+    num_bins: int = 0
+    task_map: Optional[np.ndarray] = None
+    byte_budget: Optional[int] = None
+
+
+def _shuffle_state(spec: "_ShuffleSpec") -> dict:
+    """Static per-table state: partition-id closure, cache keys, the lane
+    plan, and the three phase-kernel builders."""
+    t = spec.table
+    ctx = t.ctx
+    world = ctx.world_size
+    all_names = t.column_names
+    key_idx = tuple(all_names.index(n) for n in spec.key_names)
+    flat = t._flat_cols()
+    khash = tuple(t._key_hash_cols(spec.key_names))
+    ax = ctx.axis_name
+    nb = spec.num_bins if spec.num_bins else 16 * world
+    kind, asc0, task_map = spec.kind, spec.asc0, spec.task_map
+    task_map_dev = (
+        jnp.asarray(np.asarray(task_map, np.int32))
+        if task_map is not None
+        else None
+    )
+
+    def compute_pid(cols, kcols, n):
+        if kind == "hash":
+            return _p.hash_partition_ids(kcols, n, world)
+        if kind == "task":
+            # rows already carry logical task ids in the key column; route
+            # task t to worker task_map[t] (reference LogicalTaskPlan
+            # task->worker mapping, arrow_task_all_to_all.h:23-40)
+            tasks, _ = cols[key_idx[0]]
+            cap = tasks.shape[0]
+            live = jnp.arange(cap, dtype=jnp.int32) < n
+            wid = task_map_dev[jnp.clip(tasks, 0, len(task_map) - 1)]
+            return jnp.where(live, wid, world).astype(jnp.int32)
+        keys = [cols[i] for i in key_idx]
+        return _p.range_partition_ids(
+            keys[0], n, world, num_bins=nb, axis_name=ax, ascending=asc0
+        )
+
+    tm_key = (
+        tuple(np.asarray(task_map).tolist()) if task_map is not None else None
+    )
+    plan_sig = tuple(_g_pack.lane_plan(flat))
+    # the lane plan is part of the kernel identity: the pack/compact
+    # builders bake the passthrough layout in, so same-arity tables with
+    # different dtypes must not alias to one cache entry
+    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key)
+    has_lanes = any(
+        tag is not None or has_valid for tag, _nl, has_valid in plan_sig
+    )
+    pt_order = tuple(ci for ci, (tag, _nl, _hv) in enumerate(plan_sig) if tag is None)
+
+    def build_count():
+        def kern(dp, rep):
+            (cols, kcols, counts) = dp
+            n = counts[0]
+            pid = compute_pid(cols, kcols, n)
+            return _sh.bucket_counts(pid, world)
+
+        return kern
+
+    def build_pack():
+        def kern(dp, rep):
+            (cols, kcols, counts) = dp
+            (dummy, rnd) = rep
+            bc = dummy.shape[0]
+            n = counts[0]
+            pid = compute_pid(cols, kcols, n)
+            cnt = _sh.bucket_counts(pid, world)
+            dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
+            rc = _sh.round_counts(cnt, bc, rnd)
+            _plan, lanes, passthrough = _g_pack.pack_cols(list(cols))
+            if lanes:
+                # the fused count/payload exchange: this round's per-
+                # destination send counts ride the lane buffer's header row
+                head = _sh.pack_lane_buffer(lanes, dest, rc, world, bc)
+            else:
+                head = rc  # pure-f64 table: dedicated count lane
+            pts = tuple(
+                _sh.scatter_send(passthrough[ci], dest, world, bc)
+                for ci in pt_order
+            )
+            return head, pts
+
+        return kern
+
+    def build_coll():
+        def kern(dp, rep):
+            (head, pts) = dp
+            if has_lanes:
+                out_head = _sh.exchange_buffer(head, world, ax)
+            else:
+                out_head = _sh.exchange_counts(head, ax)
+            out_pts = tuple(_sh.exchange_buffer(p, world, ax) for p in pts)
+            return out_head, out_pts
+
+        return kern
+
+    def build_compact():
+        def kern(dp, rep):
+            (head, pts) = dp
+            if has_lanes:
+                lane_rows, recv_counts = _sh.split_header(head, world)
+                bc = lane_rows.shape[0] // world
+            else:
+                lane_rows, recv_counts = None, head
+                bc = pts[0].shape[0] // world
+            mask, total = _sh.received_row_mask(recv_counts, world, bc)
+            pt_cols = dict(zip(pt_order, pts))
+            out = _sh.compact_received_lanes(
+                list(plan_sig), lane_rows, pt_cols, mask
+            )
+            return out, _scalar(total)
+
+        return kern
+
+    return dict(
+        spec=spec, t=t, ctx=ctx, world=world, flat=flat, khash=khash,
+        key=key, plan_sig=plan_sig, has_lanes=has_lanes, n_pt=len(pt_order),
+        build_count=build_count, build_pack=build_pack,
+        build_coll=build_coll, build_compact=build_compact,
+    )
+
+
+def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
+    """The chunked, compute-overlapped shuffle engine (the distributed
+    backbone — every Distributed* op funnels through here).
+
+    One shuffle = a COUNT kernel (a host sync, but NOT a collective) + K
+    chunked exchange rounds with ``K = ceil(hottest bucket / bucket_cap)``,
+    where bucket_cap is derived from the per-round byte budget
+    (config.py DEFAULT_SHUFFLE_BYTE_BUDGET; shuffle.plan_rounds) — peak
+    exchange memory is O(budget), not O(max-shard padding), so a table K
+    times the budget streams through in K bounded rounds without the full
+    padded buffer ever materializing.
+
+    Each round is three ASYNC dispatches — PACK (partition ids + send
+    slots + header-fused scatter), COLLECTIVE (the one all_to_all; the
+    round's send counts ride the lane buffer's header rows instead of a
+    separate count collective, so a distributed join issues 2 collectives,
+    down from 4), COMPACT (header split + lane-level front-pack) — with no
+    host sync anywhere in the loop: while round r's collective is in
+    flight the host has already queued round r+1's pack and round r-1's
+    compact, and every round's received count comes back in ONE deferred
+    fetch at the end. Shuffling several tables through one call (the
+    join / set-op pair path) interleaves their rounds in the dispatch
+    queue, so table B's pack hides behind table A's collective even at
+    K = 1. ``tracing.report()`` shows the per-phase spans
+    (``shuffle.round.{pack,collective,compact}``) and the
+    ``shuffle.overlap_efficiency`` gauge = fraction of the exchange wall
+    spent issuing overlapped work rather than blocked on the device.
+    """
+    states = [_shuffle_state(s) for s in specs]
+    rows_total = sum(int(st["t"].row_count) for st in states)
+
+    # phase 0: counts — dispatch every table's count kernel before fetching
+    # any, so a pair's two count programs overlap on the device
+    for st in states:
+        with span("shuffle.count", rows=int(st["t"].row_count)):
+            st["counts_fut"] = get_kernel(
+                st["ctx"], st["key"] + ("count",), st["build_count"]
+            )((st["flat"], st["khash"], st["t"].counts_dev), ())
+    for st in states:
+        bump("host_sync")
+        st["send_counts"] = _fetch(st["counts_fut"]).reshape(
+            st["world"], st["world"]
+        )  # [src, dst]
+        st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
+
+    # phase 1: round plan from the byte budget
+    for st in states:
+        budget = st["spec"].byte_budget or st["ctx"].shuffle_byte_budget
+        row_bytes = _sh.exchange_row_bytes(st["flat"])
+        st["bucket_cap"], st["n_rounds"] = _sh.plan_rounds(
+            st["send_counts"], row_bytes, st["world"], int(budget)
+        )
+        bump("shuffle.rounds", rows=st["n_rounds"])
+        st["rounds_out"] = []
+
+    # phase 2: the double-buffered round loop — all dispatches async, the
+    # single blocking fetch deferred past the last round
+    results: List["Table"] = []
+    with span("shuffle.exchange", rows=rows_total):
+        t0 = _time.perf_counter()
+        for r in range(max(st["n_rounds"] for st in states)):
+            for st in states:
+                if r >= st["n_rounds"]:
+                    continue
+                ctx = st["ctx"]
+                rep = (
+                    jnp.zeros((st["bucket_cap"],), jnp.int8),
+                    jnp.asarray(r, jnp.int32),
+                )
+                with span("shuffle.round.pack"):
+                    head, pts = get_kernel(
+                        ctx, st["key"] + ("pack",), st["build_pack"]
+                    )((st["flat"], st["khash"], st["t"].counts_dev), rep)
+                with span("shuffle.round.collective"):
+                    head, pts = get_kernel(
+                        ctx,
+                        ("shuffle_coll", st["has_lanes"], st["n_pt"]),
+                        st["build_coll"],
+                    )((head, pts), ())
+                with span("shuffle.round.compact"):
+                    out, nout = get_kernel(
+                        ctx,
+                        ("shuffle_compact", st["plan_sig"], st["has_lanes"]),
+                        st["build_compact"],
+                    )((head, pts), ())
+                st["rounds_out"].append((out, nout))
+        t_disp = _time.perf_counter()
+
+        # the ONE deferred sync: fetch every round's received counts,
+        # validate against the count-phase expectation, assemble tables
+        for st in states:
+            bump("host_sync")
+            t = st["t"]
+            src_pairs = list(zip(t.column_names, t._columns.values()))
+            bc = st["bucket_cap"]
+            round_tables: List["Table"] = []
+            for r, (out, nout) in enumerate(st["rounds_out"]):
+                got = _fetch(nout).astype(np.int64)
+                expect = (
+                    np.clip(st["send_counts"] - r * bc, 0, bc)
+                    .sum(axis=0)
+                    .astype(np.int64)
+                )
+                if not (got == expect).all():
+                    raise RuntimeError(
+                        f"shuffle round {r}: received row counts {got} != "
+                        f"expected {expect} — internal routing bug"
+                    )
+                round_tables.append(
+                    t._rebuild_cols(src_pairs, out, got, st["world"] * bc)
+                )
+            res = (
+                round_tables[0]
+                if len(round_tables) == 1
+                else _concat_tables(round_tables)
+            )
+            # compact when the uniform bucket sizing overshot
+            results.append(res._maybe_compact(st["new_counts"], factor=2))
+        total_s = max(_time.perf_counter() - t0, 1e-9)
+        gauge("shuffle.overlap_efficiency", (t_disp - t0) / total_s)
+    return results
+
+
+def _shuffle_pair(
+    a: "Table",
+    a_keys: Sequence[str],
+    b: "Table",
+    b_keys: Sequence[str],
+    byte_budget: Optional[int] = None,
+) -> Tuple["Table", "Table"]:
+    """Hash-shuffle two tables with INTERLEAVED round dispatch (one engine
+    call): the pair path of distributed joins and set ops, where table B's
+    pack/compact hides behind table A's collective."""
+    out = _shuffle_many(
+        [
+            _ShuffleSpec(a, "hash", tuple(a_keys), byte_budget=byte_budget),
+            _ShuffleSpec(b, "hash", tuple(b_keys), byte_budget=byte_budget),
+        ]
+    )
+    return out[0], out[1]
 
 
 # ----------------------------------------------------------------------

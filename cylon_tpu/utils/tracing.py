@@ -71,6 +71,22 @@ def bump(name: str, rows: Optional[int] = None) -> None:
             s["rows"] += int(rows)
 
 
+def gauge(name: str, value: float) -> None:
+    """Record a measured VALUE (not a duration) in the registry: count is
+    the sample count, total_s accumulates the values (mean = total_s/count)
+    and max_s tracks the peak. Used for the shuffle's per-op
+    ``shuffle.overlap_efficiency`` ratio (fraction of the exchange wall
+    spent issuing overlapped round work rather than blocked on the device)
+    so :func:`report` exposes it next to the phase spans."""
+    with _lock:
+        s = _stats[name]
+        s["count"] += 1
+        s["total_s"] += float(value)
+        s["max_s"] = max(s["max_s"], float(value))
+    if trace_enabled():
+        print(f"[cylon_tpu] {name} = {value:.4f}", file=sys.stderr)
+
+
 def get_count(name: str) -> int:
     with _lock:
         return int(_stats[name]["count"]) if name in _stats else 0

@@ -1,0 +1,190 @@
+"""Chunked, compute-overlapped shuffle engine (ISSUE 2).
+
+Pins the tentpole's observable contracts:
+
+- collective count: the count exchange rides the payload collective's
+  header lanes, so an eager distributed join issues EXACTLY 2 traced
+  collectives (one per side's shuffle). The pre-fusion engine issued 4
+  (2 count all_to_alls + 2 payload all_to_alls) — that pinned baseline
+  flipped with the fusion and this test is its regression gate.
+- the fused pipeline halves its shuffle collectives the same way
+  (one all_to_all per respill round per side, plus the two overflow psums).
+- the byte budget drives round count K and peak per-round exchange bytes,
+  and chunked output is differential-equal to the unchunked shuffle.
+- tracing carries the per-round pack/collective/compact spans and the
+  overlap-efficiency gauge.
+"""
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.engine import round_cap
+from cylon_tpu.parallel import shuffle as _sh
+
+
+def _traced_collectives(op):
+    """(total traced collective count, per-program collective bytes) for one
+    warm call of ``op`` — the BENCH.md accounting (benchmarks/roofline)."""
+    from benchmarks.roofline import traced_collectives
+
+    return traced_collectives(op, warm=True)
+
+
+def _ctx8(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:8]))
+
+
+def test_distributed_join_exactly_two_collectives(devices, rng):
+    """The acceptance gate: traced collectives per eager distributed join
+    dropped from 4 (pre-fusion pinned baseline) to 2."""
+    ctx = _ctx8(devices)
+    lt = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 200, 2000).astype(np.int32),
+         "v": rng.normal(size=2000).astype(np.float32)},
+    )
+    rt = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 200, 1500).astype(np.int32),
+         "w": rng.normal(size=1500).astype(np.float32)},
+    )
+    colls, _ = _traced_collectives(
+        lambda: lt.distributed_join(rt, on="k", how="inner")
+    )
+    assert colls == 2, f"expected 2 collectives per distributed join, traced {colls}"
+
+
+def test_single_shuffle_one_collective_per_round(devices, rng):
+    """A K-round chunked shuffle issues exactly K collectives — the count
+    exchange adds none."""
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 100, 4000).astype(np.int32),
+         "v": rng.normal(size=4000).astype(np.float32)},
+    )
+    for budget in (1 << 40, 8 * 64 * 12):
+        reset_trace()
+        t.shuffle(["k"], byte_budget=budget)
+        rounds = int(report("shuffle.")["shuffle.rounds"]["rows"])
+        colls, _ = _traced_collectives(
+            lambda: t.shuffle(["k"], byte_budget=budget)
+        )
+        assert colls == rounds, (budget, rounds, colls)
+
+
+def test_fused_pipeline_collectives_halved(devices):
+    """The fused join program's shuffle rounds use the header-fused exchange:
+    2 sides x (1 + respill) all_to_alls + the 2 overflow psums — the
+    pre-fusion program traced twice the all_to_alls."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from benchmarks.roofline import analyze
+    from cylon_tpu.ops import join as _j
+    from cylon_tpu.parallel.pipeline import make_distributed_join_step
+
+    world, cap = 4, 64
+    mesh = Mesh(np.array(devices[:world]), ("dp",))
+    for respill in (0, 1, 2):
+        step = make_distributed_join_step(
+            mesh, "dp", l_key_idx=(0,), r_key_idx=(0,), how=_j.INNER,
+            bucket_cap=32, join_cap=512, respill=respill,
+        )
+        import jax
+
+        sds = jax.ShapeDtypeStruct
+        cols = [(sds((world * cap,), jnp.int32), None),
+                (sds((world * cap,), jnp.float32), None)]
+        counts = sds((world,), jnp.int32)
+        rep = analyze(step, (cols, counts, cols, counts), ())
+        expect = 2 * (1 + respill) + 2
+        assert rep.collective_count == expect, (
+            respill, rep.collective_count, expect
+        )
+
+
+def test_budget_bounds_peak_round_bytes(devices, rng):
+    """Peak traced bytes of any single collective program stay within the
+    byte budget (+ the header rows), while TOTAL shuffled volume is
+    unchanged across K — chunking caps memory, not traffic."""
+    ctx = _ctx8(devices)
+    n = 4096
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 1000, n).astype(np.int32),
+         "v": rng.normal(size=n).astype(np.float32)},
+    )
+    row_bytes = _sh.exchange_row_bytes(t._flat_cols())
+    world = t.world_size
+    totals = []
+    for cap_target in (256, 64, 16):
+        budget = world * cap_target * row_bytes
+        colls, per_bytes = _traced_collectives(
+            lambda: t.shuffle(["k"], byte_budget=budget)
+        )
+        header = world * _sh.HEADER_ROWS * row_bytes
+        assert max(per_bytes) <= budget + header, (cap_target, per_bytes)
+        totals.append(sum(per_bytes))
+    # total volume constant-ish across K: only per-round header rows differ
+    assert max(totals) - min(totals) <= 64 * world * row_bytes
+
+
+def test_chunked_output_matches_unchunked(devices, rng):
+    """Differential: tiny-budget many-round shuffle == huge-budget shuffle
+    (as a row multiset), with identical destination shards per row."""
+    ctx = _ctx8(devices)
+    n = 3000
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(-50, 50, n).astype(np.int32),
+         "v": rng.normal(size=n).astype(np.float32)},
+    )
+    base = t.shuffle(["k"], byte_budget=1 << 40)
+    for budget in (8 * 16 * 12, 8 * 64 * 12):
+        got = t.shuffle(["k"], byte_budget=budget)
+        # routing is budget-independent: same rows land on the same shards
+        assert (got.row_counts == base.row_counts).all()
+        gp = got.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        bp = base.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        assert np.array_equal(gp["k"].to_numpy(), bp["k"].to_numpy())
+        assert np.allclose(gp["v"].to_numpy(), bp["v"].to_numpy())
+
+
+def test_round_spans_and_overlap_gauge(devices, rng):
+    """tracing.report() carries the per-round phase spans and the
+    overlap-efficiency gauge (a 0..1 ratio)."""
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    t = ct.Table.from_pydict(
+        ctx, {"k": rng.integers(0, 30, 512).astype(np.int32)}
+    )
+    reset_trace()
+    t.shuffle(["k"])
+    rep = report("shuffle.")
+    rounds = int(rep["shuffle.rounds"]["rows"])
+    for phase in ("pack", "collective", "compact"):
+        assert rep[f"shuffle.round.{phase}"]["count"] == rounds
+    eff = rep["shuffle.overlap_efficiency"]
+    assert eff["count"] == 1
+    assert 0.0 <= eff["total_s"] <= 1.0
+
+
+def test_pure_f64_passthrough_shuffle(devices, rng):
+    """A table with NO int32 lanes (pure f64, no validity) takes the
+    dedicated-count-lane fallback and still round-trips correctly."""
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        pytest.skip("x64 disabled")
+    ctx = _ctx8(devices)
+    n = 1000
+    k = rng.integers(0, 40, n).astype(np.float64)
+    t = ct.Table.from_pydict(ctx, {"k": k})
+    s = t.shuffle(["k"])
+    assert s.row_count == n
+    got = np.sort(s.to_pandas()["k"].to_numpy())
+    assert np.allclose(got, np.sort(k))

@@ -142,6 +142,93 @@ def test_fused_respill_recovers_hot_bucket(devices):
     assert int(np.asarray(overflow0).sum()) > 0
 
 
+def _chunk_budget(t, max_bucket: int, k: int) -> int:
+    """Byte budget that targets ~k rounds for table ``t`` — the planner's
+    own inverse (shuffle.budget_for_rounds), so the sweep can't drift."""
+    from cylon_tpu.parallel import shuffle as _sh
+
+    return _sh.budget_for_rounds(
+        max_bucket, k, t.world_size, _sh.exchange_row_bytes(t._flat_cols())
+    )
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_chunked_all_rows_to_one_shard(devices, k):
+    """All-rows-to-one-shard skew (one-hot key) under K ∈ {1, 4, 16}
+    chunked rounds: round count matches the planner's prediction for the
+    analytically known send counts, and the output is differential-equal
+    to the unchunked shuffle."""
+    from cylon_tpu.parallel import shuffle as _sh
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    n, world = 2048, 8
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": np.zeros(n, np.int32),
+         "v": np.arange(n, dtype=np.float32)},
+    )
+    # every shard sends its whole even split to ONE destination
+    max_bucket = n // world
+    budget = _chunk_budget(t, max_bucket, k)
+    # the planner's own prediction on the analytically known count matrix
+    counts = np.zeros((world, world), np.int64)
+    counts[:, 0] = max_bucket  # (the hot destination's column; dst index
+    # is hash-dependent but the count DISTRIBUTION is exact)
+    row_bytes = _sh.exchange_row_bytes(t._flat_cols())
+    _cap, expect_rounds = _sh.plan_rounds(counts, row_bytes, world, budget)
+
+    reset_trace()
+    s = t.shuffle(["k"], byte_budget=budget)
+    got_rounds = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    assert got_rounds == expect_rounds
+    if k > 1:
+        assert got_rounds >= k  # the budget actually forced chunking
+    assert s.row_count == n
+    assert s.row_counts.max() == n  # all rows on the one target shard
+    base = t.shuffle(["k"], byte_budget=1 << 40)
+    assert (s.row_counts == base.row_counts).all()
+    assert np.array_equal(
+        np.sort(s.to_pandas()["v"].to_numpy()),
+        np.sort(base.to_pandas()["v"].to_numpy()),
+    )
+
+
+@pytest.mark.parametrize("k", [1, 4, 16])
+def test_chunked_empty_shard_skew(devices, k):
+    """Empty-shard skew (one shard owns EVERY row, seven are empty) under
+    K ∈ {1, 4, 16}: chunked rounds drain the single hot source and the
+    result matches the unchunked shuffle row-for-row after sorting."""
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    n, world = 2048, 8
+    rng = np.random.default_rng(11)
+    shards = [
+        {"k": rng.integers(0, 97, n).astype(np.int32),
+         "v": rng.normal(size=n).astype(np.float32)}
+    ] + [
+        {"k": np.empty(0, np.int32), "v": np.empty(0, np.float32)}
+        for _ in range(world - 1)
+    ]
+    t = ct.Table.from_shards(ctx, shards)
+    assert (t.row_counts[1:] == 0).all()
+    # the hot source spreads ~n/world rows per destination bucket
+    budget = _chunk_budget(t, -(-n // world), k)
+    reset_trace()
+    s = t.shuffle(["k"], byte_budget=budget)
+    rounds = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    if k >= 4:
+        assert rounds > 1  # chunking engaged on the hot source
+    base = t.shuffle(["k"], byte_budget=1 << 40)
+    assert s.row_count == n
+    assert (s.row_counts == base.row_counts).all()
+    sp = s.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    bp = base.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    assert np.array_equal(sp["k"].to_numpy(), bp["k"].to_numpy())
+    assert np.allclose(sp["v"].to_numpy(), bp["v"].to_numpy())
+
+
 def test_shuffle_jit_cache_stable(devices):
     """Repeated shuffles with same shapes/statics reuse one compiled kernel
     (VERDICT weak 9: pin compile counts)."""

@@ -180,6 +180,124 @@ def skew_round_once(seed) -> bool:
     return ok
 
 
+def shuffle_round_once(seed) -> bool:
+    """Chunked-shuffle oracle round (ISSUE 2 satellite): randomize round
+    count K (via the byte budget), dtype mix, null density and skew shape,
+    and differential-check the chunked shuffle against the EAGER UNCHUNKED
+    result (a huge-budget shuffle = one padded round wherever the skew
+    heuristic allows). Also cross-checks a distributed join run under the
+    same random budget against pandas."""
+    from cylon_tpu.parallel import shuffle as _sh
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(64, max(MAX_N, 65)))
+    keyspace = int(rng.integers(2, 128))
+    world = int(rng.choice([2, 4, 8]))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.2]))
+    skew = str(rng.choice(["uniform", "one_hot", "hot_key", "empty_shards"]))
+    k_target = int(rng.choice([1, 2, 3, 4, 8, 16]))
+    # extra value columns stress the lane codec width mix (bool lane,
+    # 64-bit hi/lo split, f64 passthrough when x64 is live)
+    import jax as _jax
+
+    extra_cols = list(rng.choice(
+        ["i64", "bool", "f64"], size=int(rng.integers(0, 3)), replace=False
+    ))
+    params = dict(seed=seed, profile="shuffle", n=n, keyspace=keyspace,
+                  world=world, dtype=dtype, null_p=null_p, skew=skew,
+                  k_target=k_target, extra=extra_cols)
+    ctx = ctx_for(world)
+
+    df = rand_frame(rng, n, keyspace, dtype, null_p)
+    # reshape skew via numpy object arrays: pandas scalar assignment would
+    # silently upcast the object key column (float64) and desync the oracle.
+    # The hot value must be NON-NULL (an all-None key column would encode
+    # as string and make the join cross-check unjoinable by construction)
+    karr = df["k"].to_numpy(copy=True)
+    non_null = [v for v in karr if v is not None]
+    hot = non_null[0] if non_null else None
+    if skew == "one_hot" and hot is not None:
+        karr[:] = hot
+        df["k"] = karr
+    elif skew == "hot_key" and hot is not None:
+        karr[rng.random(n) < 0.6] = hot
+        df["k"] = karr
+    for c in extra_cols:
+        if c == "i64":
+            df["i64"] = (rng.integers(-(2**40), 2**40, n)).astype(np.int64)
+        elif c == "bool":
+            df["flag"] = rng.random(n) < 0.5
+        elif c == "f64" and _jax.config.jax_enable_x64:
+            df["f64"] = rng.normal(size=n)  # float64 passthrough lane
+
+    if skew == "empty_shards":
+        shards = [{c: df[c].to_numpy() for c in df.columns}] + [
+            {c: df[c].to_numpy()[:0] for c in df.columns}
+            for _ in range(world - 1)
+        ]
+        t = ct.Table.from_shards(ctx, shards)
+    else:
+        t = ct.Table.from_pandas(ctx, df)
+
+    # budget targeting ~k_target rounds over the hottest possible bucket
+    # (the planner's own inverse — shuffle.budget_for_rounds)
+    max_bucket = max(int(t.row_counts.max()), 1)
+    budget = _sh.budget_for_rounds(
+        max_bucket, k_target, world, _sh.exchange_row_bytes(t._flat_cols())
+    )
+
+    reset_trace()
+    got = t.shuffle(["k"], byte_budget=budget)
+    rounds = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    want = t.shuffle(["k"], byte_budget=1 << 40)
+    params["rounds"] = rounds
+    ok = True
+    if not (got.row_counts == want.row_counts).all():
+        print(f"MISMATCH shuffle_routing params={params} "
+              f"got={got.row_counts} want={want.row_counts}", flush=True)
+        ok = False
+    ok &= check(got.to_pandas(), want.to_pandas(), "shuffle_chunked", params)
+    if skew != "empty_shards":
+        # content vs the source frame; skipped for the shard-built table,
+        # whose per-shard ingest may promote nullable columns' host
+        # REPRESENTATION (an ingest property the chunked-vs-unchunked
+        # differential above is independent of)
+        ok &= check(want.to_pandas(), df, "shuffle_content", params)
+
+    # a distributed join under the same random budget vs pandas. Both sides
+    # are re-ingested via from_pandas so they share one encoding (the
+    # empty-shard ingest can promote a nullable-int key to string on the
+    # shard-built table — an ingest property, not a shuffle one). When
+    # nulls are in play, force one into EACH frame: a side that randomly
+    # drew zero nulls would encode its key numerically while the other
+    # side's null-bearing keys encode as strings, and the pair is then
+    # unjoinable by construction (same reason the default profile's two
+    # frames share one null density)
+    rdf = rand_frame(rng, max(n // 2, 1), keyspace, dtype, null_p, "w")
+    jdf = df[["k", "v"]].copy()
+    if null_p > 0:
+        for fr in (jdf, rdf):
+            ka = fr["k"].to_numpy(copy=True)
+            ka[0] = None
+            fr["k"] = ka
+    lt2 = ct.Table.from_pandas(ctx, jdf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    prev = os.environ.get("CYLON_TPU_SHUFFLE_BUDGET")
+    os.environ["CYLON_TPU_SHUFFLE_BUDGET"] = str(budget)
+    try:
+        gotj = lt2.distributed_join(rt, on="k", how="inner").to_pandas()
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_SHUFFLE_BUDGET", None)
+        else:
+            os.environ["CYLON_TPU_SHUFFLE_BUDGET"] = prev
+    wantj = expected_join(jdf, rdf, "inner")
+    ok &= check(gotj, wantj, "shuffle_join", params)
+    return ok
+
+
 def plan_round_once(seed) -> bool:
     """Plan-vs-eager oracle round: build a random LazyFrame pipeline
     (join [+ filter] -> groupby | sort | project), collect it through the
@@ -410,16 +528,19 @@ def main():
     ap.add_argument("--max-n", type=int, default=400,
                     help="upper bound on random table sizes (bigger stresses "
                          "respill/overflow/capacity-retry paths)")
-    ap.add_argument("--profile", choices=["default", "skew", "plan"],
+    ap.add_argument("--profile", choices=["default", "skew", "plan", "shuffle"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
-                         "'plan': LazyFrame-optimizer-vs-eager oracle rounds")
+                         "'plan': LazyFrame-optimizer-vs-eager oracle rounds; "
+                         "'shuffle': chunked-shuffle oracle (random K / byte "
+                         "budget / dtype mix / skew vs the eager unchunked "
+                         "result)")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
-    fn = {"skew": skew_round_once, "plan": plan_round_once}.get(
-        args.profile, round_once)
+    fn = {"skew": skew_round_once, "plan": plan_round_once,
+          "shuffle": shuffle_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
