@@ -1,0 +1,188 @@
+"""Order-property benchmark + the CI sort-pass regression gate.
+
+Measures the q3 pipeline (distributed inner join -> groupby-SUM on the join
+key) two ways on the same inputs:
+
+  eager    distributed_join(...) + distributed_groupby(...)   [left-order
+           emit; every kernel re-derives order from scratch]
+  ordered  distributed_join(..., emit_order='key') + the same groupby —
+           the join's probe kv-sort doubles as the key sort (ordering
+           descriptor stamped on the output), so the groupby run-detects
+           instead of lexsorting (tracing counter
+           ``ordering.groupby_run_detect``).
+
+Traced sort-pass bytes (benchmarks/roofline.py — the quantity BENCH.md's
+sliced-join sweep established prices TPU wall time) are summed over every
+recorded kernel dispatch of one warm call each.
+
+``--smoke`` (the CI ``benchmark-smoke`` job) gates three ways and exits 1
+on regression:
+  1. the ordered pipeline must execute strictly FEWER traced sort ops;
+  2. ordered sort-pass bytes must be >= GATE (default 30%) below eager;
+  3. the groupby lexsort elision must actually have fired (tracing span
+     counters: ``ordering.groupby_run_detect`` and
+     ``ordering.join_key_order_emit`` advance), with identical output.
+
+Usage:
+  python benchmarks/ordering_bench.py --rows 50000 --smoke
+  python benchmarks/ordering_bench.py --rows 1000000   # report only
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import numpy as np
+
+
+def measure(op):
+    """(Report totals, warm seconds) for one eager op chain: record every
+    kernel dispatch during a warm call and sum the traced roofline models."""
+    from benchmarks.roofline import Report, analyze
+    from cylon_tpu import engine
+
+    op()  # warm (compile outside the recorded call)
+    engine.record_kernels(True)
+    t0 = time.perf_counter()
+    try:
+        op()
+    finally:
+        dt = time.perf_counter() - t0
+        kernels = engine.recorded_kernels()
+        engine.record_kernels(False)
+    total = Report()
+    for fn, args in kernels:
+        rep = analyze(fn, *args)
+        total.sort_count += rep.sort_count
+        total.sort_bytes_per_pass += rep.sort_bytes_per_pass
+        total.sort_pass_bytes += rep.sort_pass_bytes
+        total.gather_bytes += rep.gather_bytes
+        total.scatter_bytes += rep.scatter_bytes
+        total.elementwise_bytes += rep.elementwise_bytes
+        total.collective_bytes += rep.collective_bytes
+        total.collective_count += rep.collective_count
+    return total, dt
+
+
+def run(rows: int, world: int, smoke: bool, gate: float) -> int:
+    import __graft_entry__ as ge
+
+    devices = ge._force_cpu_mesh(max(world, 1))
+
+    import cylon_tpu as ct
+    from cylon_tpu.utils.tracing import get_count, reset_trace
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+    rng = np.random.default_rng(0)
+    n = rows
+    lt = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+    })
+    rt = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, n, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32),
+    })
+
+    res = {}
+
+    def q3_eager():
+        res["eager"] = lt.distributed_join(
+            rt, on="k", how="inner"
+        ).distributed_groupby("k_x", {"v": "sum"})
+
+    def q3_ordered():
+        res["ordered"] = lt.distributed_join(
+            rt, on="k", how="inner", emit_order="key"
+        ).distributed_groupby("k_x", {"v": "sum"})
+
+    te, se = measure(q3_eager)
+    reset_trace()
+    to, so = measure(q3_ordered)
+    elided = get_count("ordering.groupby_run_detect")
+    key_emits = get_count("ordering.join_key_order_emit")
+
+    reduction = (
+        1.0 - to.sort_pass_bytes / te.sort_pass_bytes
+        if te.sort_pass_bytes else 0.0
+    )
+    rec = {
+        "benchmark": "q3_order_propagation",
+        "rows": 2 * n,
+        "world": world,
+        "eager_sorts": te.sort_count,
+        "eager_sort_gb": round(te.sort_pass_bytes / 1e9, 4),
+        "ordered_sorts": to.sort_count,
+        "ordered_sort_gb": round(to.sort_pass_bytes / 1e9, 4),
+        "sort_bytes_reduction_pct": round(100 * reduction, 1),
+        "groupby_lexsorts_elided": elided,
+        "key_order_emits": key_emits,
+        "eager_warm_s": round(se, 4),
+        "ordered_warm_s": round(so, 4),
+    }
+    print(json.dumps(rec), flush=True)
+
+    # the two pipelines must agree row-for-row (groupby key order included)
+    import pandas.testing as pdt
+
+    pdt.assert_frame_equal(
+        res["eager"].to_pandas().sort_values("k_x").reset_index(drop=True),
+        res["ordered"].to_pandas().sort_values("k_x").reset_index(drop=True),
+    )
+
+    if not smoke:
+        return 0
+    fail = []
+    if to.sort_count >= te.sort_count:
+        fail.append(
+            f"ordered path ran {to.sort_count} sorts, eager {te.sort_count} "
+            "(must be strictly fewer)"
+        )
+    if reduction < gate:
+        fail.append(
+            f"sort-pass bytes reduced {100 * reduction:.1f}% "
+            f"(< gate {100 * gate:.0f}%)"
+        )
+    if elided < 1:
+        fail.append("ordering.groupby_run_detect never fired")
+    if key_emits < 1:
+        fail.append("ordering.join_key_order_emit never fired")
+    for f in fail:
+        print(f"ORDERING GATE FAIL: {f}", file=sys.stderr)
+    if not fail:
+        print(
+            f"# ordering gate ok: {te.sort_count}->{to.sort_count} sorts, "
+            f"-{100 * reduction:.1f}% sort-pass bytes, "
+            f"{elided} groupby lexsort(s) elided",
+            file=sys.stderr,
+        )
+    return 1 if fail else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=50_000)
+    ap.add_argument("--world", type=int, default=1,
+                    help="mesh size (virtual CPU devices); the gate runs at "
+                         "1 where the whole pipeline is shuffle-free and the "
+                         "elision is largest")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate mode: exit 1 on sort-pass regression")
+    ap.add_argument("--gate", type=float,
+                    default=float(os.environ.get("ORDERING_GATE", 0.30)),
+                    help="minimum fractional sort-pass-byte reduction")
+    args = ap.parse_args()
+    sys.exit(run(args.rows, args.world, args.smoke, args.gate))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,13 @@ import numpy as np
 BASELINE_JOIN_ROWS_PER_SEC = 400e6 / 141.5  # reference 1-worker rate
 
 
+
+def _vs_baseline(work_rows: int, seconds: float, world: int) -> float:
+    """Per-chip rate vs the reference's published 1-worker rate — the ONE
+    definition every bench row's vs_baseline cell uses."""
+    return round(work_rows / seconds / BASELINE_JOIN_ROWS_PER_SEC / max(world, 1), 3)
+
+
 def _bench(fn, reps: int):
     """(best wall seconds, first-call seconds [compile])."""
     t0 = time.perf_counter()
@@ -194,7 +201,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(out)
 
     s, c = _bench(local_join, reps)
-    lj_extra = {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC, 3)}
+    lj_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, 1)}
     if hbm > 0:
         import jax as _jax
         import jax.numpy as jnp
@@ -233,7 +240,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(out)
 
     s, c = _bench(dist_join, reps)
-    dj_extra = {"vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3)}
+    dj_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _roofline_recorded(dj_extra, hbm, s, dist_join)
     record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra)
 
@@ -255,7 +262,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     dist_join_fused()
     fused_syncs = get_count("host_sync")
     djf_extra = {
-        "vs_baseline": round(2 * n_rows / s / BASELINE_JOIN_ROWS_PER_SEC / world, 3),
+        "vs_baseline": _vs_baseline(2 * n_rows, s, world),
         "host_syncs": fused_syncs, "host_syncs_eager": eager_syncs,
     }
     # traced even with hbm<=0: the collective cells are platform-free
@@ -302,9 +309,24 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(g)
 
     s, c = _bench(q3, reps)
-    q3_extra = {}
+    q3_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _roofline_recorded(q3_extra, hbm, s, q3)
     record("dist_join_groupby_q3", s, c, 2 * n_rows, world, q3_extra)
+
+    # config 2a': the same chain with order propagation — the join emits
+    # grouped-key order (emit_order='key', same kernel cost) and the
+    # groupby's factorize lexsort elides into a run-detect; the sort GB
+    # column is the measured win (benchmarks/ordering_bench.py gates it)
+    def q3_ordered():
+        out = left.distributed_join(right, on="k", how="inner",
+                                    emit_order="key")
+        g = out.distributed_groupby("k_x", {"v": "sum"})
+        _sync(g)
+
+    s, c = _bench(q3_ordered, reps)
+    q3o_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
+    _roofline_recorded(q3o_extra, hbm, s, q3_ordered)
+    record("dist_join_groupby_q3_ordered", s, c, 2 * n_rows, world, q3o_extra)
 
     # config 2b: the same chain fully fused (join + groupby + psum in one
     # program, parallel/pipeline.make_join_groupby_step — what the multichip
@@ -328,7 +350,10 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _ = np.asarray(out[3])  # the single fetch
 
     s, c = _bench(q3_fused, reps)
-    q3f_extra = {"host_syncs": 1}
+    q3f_extra = {
+        "vs_baseline": _vs_baseline(2 * n_rows, s, world),
+        "host_syncs": 1,
+    }
     # roofline (VERDICT round-2 item 2): same `step`, same args as measured
     _roofline(
         q3f_extra, hbm, s, step,
@@ -342,7 +367,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _sync(out)
 
     s, c = _bench(dsort, reps)
-    ds_extra = {}
+    ds_extra = {"vs_baseline": _vs_baseline(n_rows, s, world)}
     _roofline_recorded(ds_extra, hbm, s, dsort)
     record("dist_sort", s, c, n_rows, world, ds_extra)
 
@@ -359,7 +384,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
             _sync(out)
 
         s, c = _bench(setop, reps)
-        so_extra = {}
+        so_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
         _roofline_recorded(so_extra, hbm, s, setop)
         record(name, s, c, 2 * n_rows, world, so_extra)
 
@@ -416,7 +441,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
             s, c = _bench(djw, reps)
-            sc_extra = {}
+            sc_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, w)}
             _roofline_recorded(sc_extra, hbm, s, djw)
             record("dist_join_strong_scaling", s, c, 2 * n_rows, w, sc_extra)
             # weak scaling: n_rows per shard
@@ -427,7 +452,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
             s, c = _bench(djww, reps)
-            wc_extra = {}
+            wc_extra = {"vs_baseline": _vs_baseline(2 * len(lww), s, w)}
             _roofline_recorded(wc_extra, hbm, s, djww)
             record("dist_join_weak_scaling", s, c, 2 * len(lww), w, wc_extra)
 
@@ -436,8 +461,8 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
 
 def to_markdown(results, header: str) -> str:
     lines = [header, "",
-             "| benchmark | world | rows | warm s | compile s | rows/s | rows/s/core | vs_baseline | %membw | colls | coll MB | coll B/row |",
-             "|---|---|---|---|---|---|---|---|---|---|---|---|"]
+             "| benchmark | world | rows | warm s | compile s | rows/s | rows/s/core | vs_baseline | %membw | colls | coll MB | coll B/row | sort GB |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in results:
         # collective volume per world size: the quantity that predicts real
         # ICI scaling (VERDICT r3 weak point 6 — virtual-CPU-mesh wall time
@@ -455,7 +480,10 @@ def to_markdown(results, header: str) -> str:
             f"| {r['compile_s']} | {r['rows_per_sec']:,} | {rpc} "
             f"| {r.get('vs_baseline', '')} "
             f"| {r.get('pct_membw', '')} | {r.get('collectives', '')} "
-            f"| {cmb} | {cbr} |"
+            f"| {cmb} | {cbr} "
+            # traced sort-pass GB (the TPU wall-time pricing quantity —
+            # BENCH.md sliced-join sweep; ordering rows show the elision)
+            f"| {r.get('sort_passes_bytes_gb', '')} |"
         )
     return "\n".join(lines) + "\n"
 

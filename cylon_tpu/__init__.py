@@ -64,6 +64,8 @@ from .io import (  # noqa: E402
 )
 from .frame import CylonEnv, DataFrame  # noqa: E402
 from .frame import concat as concat_frames  # noqa: E402
+from . import ordering  # noqa: E402
+from .ordering import Ordering  # noqa: E402
 from .table import Table, concat, merge  # noqa: E402
 from . import compute  # noqa: E402
 from .series import Series  # noqa: E402
@@ -92,6 +94,8 @@ __all__ = [
     "JoinAlgorithm",
     "JoinConfig",
     "LazyFrame",
+    "Ordering",
+    "ordering",
     "col",
     "lit",
     "plan",

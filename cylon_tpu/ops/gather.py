@@ -141,6 +141,7 @@ def pack_gather(
     cols: Sequence[KeyCol],
     idx: jax.Array,
     extra_lanes: Sequence[jax.Array] = (),
+    all_valid: bool = False,
 ) -> Tuple[List[KeyCol], List[jax.Array]]:
     """Gather every column (and any extra int32 lanes) by row index in ONE
     XLA gather.
@@ -148,6 +149,12 @@ def pack_gather(
     ``idx`` entries of -1 mean "no source row" (outer-join null side): the
     output value is gathered from a clamped index but its validity is False.
     Returns (gathered cols with merged validity, gathered extra lanes).
+
+    ``all_valid=True``: the caller guarantees every -1 index lands on a
+    PADDING output row (rows past the live count), so the -1 nulling mask is
+    skipped and mask-free source columns stay mask-free — the key-order join
+    emit uses this to keep the output key columns' sortedness descriptor
+    usable by downstream mask-sensitive fast paths.
     """
     cap = cols[0][0].shape[0] if cols else extra_lanes[0].shape[0]
     plan, lanes, passthrough = pack_cols(cols)
@@ -165,6 +172,8 @@ def pack_gather(
         g_cols = []
 
     def make_valid(lane):
+        if all_valid:
+            return None if lane is None else lane.astype(jnp.bool_)
         return ok if lane is None else (ok & lane.astype(jnp.bool_))
 
     out, pos = unpack_cols(

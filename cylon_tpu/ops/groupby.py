@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .factorize import factorize
-from .sort import KeyCol, wide_float, wide_int, lexsort_indices
+from .sort import KeyCol, rows_differ, wide_float, wide_int, lexsort_indices
 
 # aggregation op ids, mirroring reference AggregationOpId
 # (compute/aggregate_kernels.hpp:40-50)
@@ -59,9 +59,14 @@ def sorted_group_ids(
     run-detection pass, no lexsort (reference PipelineGroupBy,
     groupby/pipeline_groupby.cpp:30-90 — run detection + per-run aggregates
     over sorted input). Same contract as :func:`group_ids`, and the ids come
-    out in key order by construction."""
-    from .sort import rows_differ
+    out in key order by construction.
 
+    Callers either guarantee sortedness themselves (``pipeline_groupby``,
+    the reference contract) or let ``Table.groupby`` prove it from the
+    table's ordering descriptor (cylon_tpu/ordering.py): input canonically
+    ordered by a key prefix run-detects with null==null adjacency intact,
+    so the ids — and therefore the emitted group order — match the
+    factorize path exactly."""
     idx = jnp.arange(cap, dtype=jnp.int32)
     live = idx < n
     boundary = rows_differ(key_cols, cap) & live

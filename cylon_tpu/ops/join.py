@@ -110,6 +110,84 @@ def _merged_counts(
     return lo, cnt, r_cnt
 
 
+def _key_order_emit(
+    l_ids: jax.Array,
+    r_ids: jax.Array,
+    l_cols: Sequence[KeyCol],
+    r_sorted_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    how: int,
+    cap_out: int,
+    cap_l: int,
+    cap_r: int,
+) -> Tuple[list, jax.Array, jax.Array]:
+    """Probe + emit with output rows in GROUPED-KEY order, straight out of
+    the merged kv-sort — the order-establishing join emit the planner's
+    ``order_reuse`` rewrite lowers to.
+
+    Where :func:`_merged_counts` pays a second (compaction) sort to return
+    the per-left-row probe state to ORIGINAL left order, the key-order emit
+    wants exactly the order the merged sort already produced: the repeat
+    runs over sorted space directly, and per-output bookkeeping (run base,
+    match count, original left row) comes back through one narrow gather.
+    ONE sort total (plus the right ride sort the caller provides) versus
+    the left-order path's two — fewer sort passes AND the output carries a
+    canonical ordering descriptor downstream ops consume.
+
+    At a left position p inside a run, rights all precede (stable sort of
+    [rights ++ lefts]), so ``run_count_upto`` at p is the run's full live
+    right count and the run-start right prefix sum is the match window
+    base. Left columns keep mask-free-ness (``all_valid=True`` — every -1
+    lands on a padding output row for INNER/LEFT).
+
+    Returns (out_cols = left ++ right, exact total, float32 overflow
+    shadow). INNER/LEFT only — the unmatched-right append of RIGHT/FULL
+    has no key-ordered formulation here."""
+    from .gather import pack_gather
+    from .sort import run_count_upto, run_start_broadcast
+
+    cap_cat = cap_r + cap_l
+    keys = jnp.concatenate([r_ids, l_ids])  # rights FIRST (tie order matters)
+    pay = jnp.arange(cap_cat, dtype=jnp.int32)
+    skey, spay = jax.lax.sort((keys, pay), num_keys=1, is_stable=True)
+    is_l = spay >= cap_r
+    is_l_live = is_l & (spay < cap_r + nl)
+    is_r_live = (~is_l) & (spay < nr)
+    rl = is_r_live.astype(jnp.int32)
+    r_excl = jnp.cumsum(rl) - rl
+    new_run = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+    lo_run = run_start_broadcast(new_run, r_excl)
+    cnt_p = run_count_upto(new_run, is_r_live)
+    cnt = jnp.where(is_l_live, cnt_p, 0)
+    shadow = jnp.sum(cnt.astype(jnp.float32))
+    if how == LEFT:
+        cnt_adj = jnp.where(is_l_live & (cnt == 0), 1, cnt)
+    else:
+        cnt_adj = cnt
+    ends = jnp.cumsum(cnt_adj)
+    offs = ends - cnt_adj
+    total = ends[-1].astype(jnp.int32)
+    base = lo_run - offs
+
+    li = _repeat_ss(ends, cap_out)  # sorted-space position per output row
+    out_pos = jnp.arange(cap_out, dtype=jnp.int32)
+    in_out = out_pos < total
+    li = jnp.where(in_out, li, -1)
+    safe_li = jnp.clip(li, 0, cap_cat - 1)
+    book = jnp.stack(
+        [base, cnt, spay - jnp.int32(cap_r)], axis=1
+    )[safe_li]  # one narrow [cap_out, 3] gather
+    base_g, cnt_g, orig_g = book[:, 0], book[:, 1], book[:, 2]
+    orig_li = jnp.where(li >= 0, orig_g, -1)
+    out_l, _ = pack_gather(l_cols, orig_li, all_valid=True)
+
+    has_match = in_out & (cnt_g > 0)
+    rpos = jnp.where(has_match, jnp.clip(base_g + out_pos, 0, cap_r - 1), -1)
+    out_r, _ = pack_gather(r_sorted_cols, rpos)
+    return list(out_l) + list(out_r), total, shadow
+
+
 def impl_tag() -> tuple:
     """Env-selected kernel-impl choices, as a cache-key component.
 
@@ -262,12 +340,28 @@ def _probe(
 
 
 def probe_arrays(
-    l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int, how: int = FULL_OUTER
+    l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int,
+    how: int = FULL_OUTER, r_presorted: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Phase-1 kernel surface: returns the static-shaped probe state
     (lo, cnt, r_order, r_cnt) so the emit phase need not recompute the sorts.
     For INNER/LEFT joins r_cnt is unused downstream and is returned as zeros,
-    skipping one sort and two sorted searches."""
+    skipping one sort and two sorted searches.
+
+    ``r_presorted=True``: the caller proves (via the right table's ordering
+    descriptor) that the right rows are already canonically ordered by the
+    join key, so the right argsort collapses to the identity permutation —
+    the sorted-run-reuse fast path."""
+    if r_presorted:
+        l_ids, r_ids = _canonical_ids(
+            l_key_cols, r_key_cols, nl, nr, cap_l, cap_r
+        )
+        r_order = jnp.arange(cap_r, dtype=jnp.int32)
+        lo, cnt, r_cnt = _merged_counts(
+            l_ids, r_ids, nl, nr, cap_l, cap_r,
+            need_rcnt=how in (RIGHT, FULL_OUTER),
+        )
+        return (lo, cnt, r_order, r_cnt)
     p = _probe(
         l_key_cols, r_key_cols, nl, nr, cap_l, cap_r,
         need_rcnt=how in (RIGHT, FULL_OUTER),
@@ -631,6 +725,8 @@ def spec_join(
     how: int,
     cap_out: int,
     emit_impl: str = "gather",
+    r_presorted: bool = False,
+    emit_key_order: bool = False,
 ) -> Tuple[list, jax.Array, jax.Array]:
     """Single-dispatch speculative join: probe + count + emit + gather in one
     program with the minimal pass count.
@@ -642,6 +738,14 @@ def spec_join(
     mask-free columns stay mask-free with no lane codec at all).
     RIGHT/FULL_OUTER composes the probe + emit pieces unchanged.
 
+    ``r_presorted=True`` (right rows provably key-ordered already — ordering
+    descriptor): the right ride sort collapses to the identity, one fewer
+    multi-operand sort. ``emit_key_order=True`` (INNER/LEFT only): probe +
+    emit run straight off the merged kv-sort with NO compaction sort
+    (:func:`_key_order_emit`) — one sort fewer than the left-order path —
+    and output rows come out GROUPED BY KEY, so downstream ops on the key
+    skip their own lexsort.
+
     Returns (out_cols = left ++ right, exact total, float32 overflow shadow).
     The caller compares ``total`` against ``cap_out`` on the host and falls
     back to the exact two-phase path on overflow (table.py speculative join).
@@ -649,12 +753,8 @@ def spec_join(
     cap_l = l_key_cols[0][0].shape[0]
     cap_r = r_key_cols[0][0].shape[0]
     need_rcnt = how in (RIGHT, FULL_OUTER)
+    emit_key_order = emit_key_order and how in (INNER, LEFT)
     l_ids, r_ids = _canonical_ids(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
-    lo, cnt, r_cnt = _merged_counts(
-        l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
-    )
-    total = count_from_probe(cnt, r_cnt, nl, nr, how)
-    shadow = count_overflow_check(cnt, r_cnt)
     if how in (INNER, LEFT):
         # <=32-bit right columns ride the key sort as payload operands; any
         # 64-bit columns are gathered by the carried order through the int32
@@ -663,27 +763,52 @@ def spec_join(
         from .gather import pack_gather
         from .sort import merge_ride_cols, split_ride_cols
 
-        ride, payloads, heavy = split_ride_cols(r_cols)
-        if heavy:
-            # carry the order only when something needs gathering by it
-            iota = jnp.arange(cap_r, dtype=jnp.int32)
-            sorted_ops = jax.lax.sort(
-                tuple([r_ids] + payloads + [iota]), num_keys=1, is_stable=True
-            )
-            spays = list(sorted_ops[1:-1])
-            heavy_sorted = pack_gather(heavy, sorted_ops[-1])[0]
+        if r_presorted:
+            # sorted-run reuse: the rows ARE the key-sorted payload
+            r_sorted = list(r_cols)
         else:
-            sorted_ops = jax.lax.sort(
-                tuple([r_ids] + payloads), num_keys=1, is_stable=True
+            ride, payloads, heavy = split_ride_cols(r_cols)
+            if heavy:
+                # carry the order only when something needs gathering by it
+                iota = jnp.arange(cap_r, dtype=jnp.int32)
+                sorted_ops = jax.lax.sort(
+                    tuple([r_ids] + payloads + [iota]),
+                    num_keys=1, is_stable=True,
+                )
+                spays = list(sorted_ops[1:-1])
+                heavy_sorted = pack_gather(heavy, sorted_ops[-1])[0]
+            else:
+                sorted_ops = jax.lax.sort(
+                    tuple([r_ids] + payloads), num_keys=1, is_stable=True
+                )
+                spays = list(sorted_ops[1:])
+                heavy_sorted = []
+            r_sorted = merge_ride_cols(r_cols, ride, spays, heavy_sorted)
+        if emit_key_order:
+            # probe + emit in one sorted-space pass, no compaction sort
+            out_cols, total, shadow = _key_order_emit(
+                l_ids, r_ids, l_cols, r_sorted, nl, nr, how, cap_out,
+                cap_l, cap_r,
             )
-            spays = list(sorted_ops[1:])
-            heavy_sorted = []
-        r_sorted = merge_ride_cols(r_cols, ride, spays, heavy_sorted)
+            return out_cols, total, shadow
+        lo, cnt, r_cnt = _merged_counts(
+            l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
+        )
+        total = count_from_probe(cnt, r_cnt, nl, nr, how)
+        shadow = count_overflow_check(cnt, r_cnt)
         out_cols, n_out = _emit_inner_left(
             lo, cnt, l_cols, r_sorted, nl, how, cap_out, cap_r, emit_impl
         )
     else:
-        r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+        lo, cnt, r_cnt = _merged_counts(
+            l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
+        )
+        total = count_from_probe(cnt, r_cnt, nl, nr, how)
+        shadow = count_overflow_check(cnt, r_cnt)
+        if r_presorted:
+            r_order = jnp.arange(cap_r, dtype=jnp.int32)
+        else:
+            r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
         out_cols, n_out = emit_gather(
             lo, cnt, r_order, r_cnt, l_cols, r_cols, nl, nr, how, cap_out,
             emit_impl,

@@ -24,6 +24,9 @@ import jax.numpy as jnp
 from .sort import (
     KeyCol,
     canonical_row_lanes,
+    lanes_differ,
+    orderable_key,
+    rows_differ,
     run_count_from,
     sentinel_compact,
     sorted_runs,
@@ -245,3 +248,122 @@ def subtract_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
 
 def intersect_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out):
     return setop_emit(l_cols, r_cols, nl, nr, cap_l, cap_r, cap_out, True)
+
+
+# ---------------------------------------------------------------------------
+# sorted-input fast paths (order-property consumers — cylon_tpu/ordering.py).
+# The caller (table.py) proves sortedness via the table's ordering descriptor
+# and routes here; the chosen path is part of the kernel cache key.
+# ---------------------------------------------------------------------------
+
+def unique_emit_sorted(
+    key_cols: Sequence[KeyCol],
+    n: jax.Array,
+    cap: int,
+    cap_out: int,
+    keep: str = "first",
+) -> Tuple[jax.Array, jax.Array]:
+    """:func:`unique_emit` over input ALREADY canonically ordered by the key
+    columns: a single run-detect + byte-mask compaction replaces the two
+    chained canonical sorts (the single-table ``PipelineGroupBy`` analog).
+    Same output as the generic path — kept rows in ascending input order,
+    which on sorted input is first-occurrence order by construction."""
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < n
+    diff = rows_differ(key_cols, cap)
+    if keep == "last":
+        # a run's last LIVE row; the n-1 boundary is forced because diff at
+        # position n compares against padding garbage
+        next_new = jnp.concatenate([diff[1:], jnp.ones((1,), bool)])
+        keepm = (next_new | (idx == n - 1)) & live
+    else:
+        keepm = diff & live
+    return compact_mask(keepm, cap_out)
+
+
+def _promoted_lanes(
+    ld: jax.Array, rd: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Cross-comparable orderable lanes for a mask-free column pair
+    (orderable_key lanes are only comparable within one dtype)."""
+    if ld.dtype != rd.dtype:
+        from ..dtypes import promote_key_dtypes
+
+        common = promote_key_dtypes(ld.dtype, rd.dtype)
+        ld, rd = ld.astype(common), rd.astype(common)
+    return orderable_key(ld), orderable_key(rd)
+
+
+def _member_sorted(
+    lane_q: jax.Array, lane_s: jax.Array, ns: jax.Array
+) -> jax.Array:
+    """Bool per query: does the SORTED live prefix ``lane_s[:ns]`` contain
+    the value? Padding is forced to the lane maximum so the whole array is
+    searchsorted-safe; the ``pos < ns`` guard keeps a live maximum value
+    from matching padding (live rows sort before padding at equal keys)."""
+    cap_s = lane_s.shape[0]
+    top = jnp.asarray(jnp.iinfo(lane_s.dtype).max, lane_s.dtype)
+    srt = jnp.where(jnp.arange(cap_s, dtype=jnp.int32) < ns, lane_s, top)
+    pos = jnp.searchsorted(srt, lane_q, side="left").astype(jnp.int32)
+    hit = srt[jnp.clip(pos, 0, cap_s - 1)]
+    return (pos < ns) & ~lanes_differ(hit, lane_q)
+
+
+def _first_occurrence(lane: jax.Array, live: jax.Array) -> jax.Array:
+    prev = jnp.roll(lane, 1)
+    diff = lanes_differ(lane, prev).at[0].set(True)
+    return diff & live
+
+
+def setop_emit_sorted(
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    cap_out: int,
+    want_in_r,
+) -> Tuple[jax.Array, jax.Array]:
+    """Subtract/intersect over a single MASK-FREE key column with BOTH
+    inputs already sorted ascending: run detection on the left + a sorted
+    membership probe into the right replace the combined canonical sort and
+    the compaction sort of :func:`setop_emit` — zero sort passes over the
+    key lanes (compact_mask's byte argsort is the only remaining sort).
+    ``want_in_r`` stays a traced scalar: both ops share one program."""
+    ld, _ = l_cols[0]
+    rd, _ = r_cols[0]
+    llane, rlane = _promoted_lanes(ld, rd)
+    live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+    first = _first_occurrence(llane, live_l)
+    found = _member_sorted(llane, rlane, nr)
+    hit = jnp.where(jnp.asarray(want_in_r), found, ~found)
+    return compact_mask(first & hit, cap_out)
+
+
+def union_emit_sorted(
+    l_cols: Sequence[KeyCol],
+    r_cols: Sequence[KeyCol],
+    nl: jax.Array,
+    nr: jax.Array,
+    cap_l: int,
+    cap_r: int,
+    cap_out: int,
+):
+    """Distinct union over a single mask-free sorted column pair: left run
+    starts are always kept (lefts precede rights in concat order), right run
+    starts only when absent from the left — reproducing
+    :func:`union_emit`'s first-occurrence-in-concat-order output with no
+    canonical sort. Returns (idx, total, cat_cols) like :func:`union_emit`."""
+    ld, _ = l_cols[0]
+    rd, _ = r_cols[0]
+    llane, rlane = _promoted_lanes(ld, rd)
+    live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+    live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
+    first_l = _first_occurrence(llane, live_l)
+    first_r = _first_occurrence(rlane, live_r)
+    r_in_l = _member_sorted(rlane, llane, nl)
+    keep = jnp.concatenate([first_l, first_r & ~r_in_l])
+    idx, total = compact_mask(keep, cap_out)
+    cat_cols = concat_two_tables(l_cols, r_cols, cap_l, cap_r)
+    return idx, total, cat_cols

@@ -338,6 +338,7 @@ def lexsort_rows_payload(
     payloads: Sequence[jax.Array],
     ascending: Optional[Sequence[bool]] = None,
     nulls_last: bool = True,
+    prefix_lane: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, list]:
     """:func:`lexsort_rows` with ``payloads`` riding the sort passes.
 
@@ -345,6 +346,12 @@ def lexsort_rows_payload(
     a payload operand costs ~one lane of memory traffic per pass; a separate
     row gather by ``order`` costs a full random gather — on TPU the payload
     route wins whenever the column fits a sort operand (<= 32-bit).
+
+    ``prefix_lane``: optional lane sorted just below the padding class (more
+    significant than every key) — the sorted-run-reuse hook: a caller whose
+    rows are ALREADY ordered by a key prefix passes the prefix's run ids
+    (:func:`prefix_run_lane`) here and supplies only the suffix keys,
+    replacing one chained pass per elided prefix lane.
     """
     if ascending is None:
         ascending = [True] * len(key_cols)
@@ -359,12 +366,32 @@ def lexsort_rows_payload(
             if not nulls_last:
                 null_lane = -null_lane
             lanes.append(null_lane)
+    if prefix_lane is not None:
+        lanes.append(prefix_lane)
     lanes.append(pad)  # most significant: padding always last
     iota = jnp.arange(cap, dtype=jnp.int32)
     _, pays = lexsort_with_payload(
         lanes, list(payloads) + [iota], keep_lanes=False
     )
     return pays[-1], pays[:-1]
+
+
+def prefix_run_lane(
+    prefix_cols: Sequence[KeyCol], n: jax.Array, cap: int
+) -> jax.Array:
+    """Run-id lane over rows ALREADY ordered by ``prefix_cols``.
+
+    Equal-prefix rows share an id; ids are non-decreasing over the live
+    prefix (so sorting by this single int32 lane preserves the existing
+    prefix order exactly), and padding rows take an id past every live run.
+    Null == null per :func:`rows_differ` — valid for canonically-ordered
+    prefixes, where null-key runs are contiguous.
+    """
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    live = idx < n
+    boundary = rows_differ(prefix_cols, cap) & live
+    ids = jnp.cumsum(boundary.astype(jnp.int32))
+    return jnp.where(live, ids, jnp.int32(cap + 1))
 
 
 def rows_differ(

@@ -1,0 +1,203 @@
+"""Order-property descriptors: sortedness metadata carried by Tables.
+
+The placement analog already exists — the plan layer's partitioning tuples
+prove a re-shuffle redundant (Exoshuffle-style, PAPERS.md arxiv 2203.05072).
+This module is the same property-driven decomposition applied to ORDER: every
+hot op in cylon_tpu is built on chained stable sort passes (ops/sort.py), and
+the round-5 sliced-join sweep established that traced sort-pass bytes are the
+quantity that prices TPU wall time (BENCH.md). An op that provably
+establishes order records an :class:`Ordering` on its output; downstream
+kernels consume the descriptor to skip their own canonical sorts (groupby
+run-detect instead of the factorize lexsort, join probe without the
+right-side ride sort, set ops in searchsorted space, suffix-only sorts).
+
+Descriptor semantics
+--------------------
+``Ordering(keys, ascending, nulls_last, scope, canonical, lexsort_exact)``
+asserts that, within every shard's live prefix, rows are ordered by
+``keys`` (major first) with the given per-key directions:
+
+- ``scope``: ``"shard"`` = each shard's live rows are ordered;
+  ``"global"`` = additionally, shard i's rows all precede shard i+1's in
+  the total order (a range-partitioned sample sort establishes this).
+- ``canonical``: rows are ordered by the CANONICAL key lanes of
+  ``ops.sort.canonical_row_lanes`` — ascending orderable value lanes,
+  null rows last per key with their value lane zeroed. This is the order
+  factorize/groupby/set-ops emit in and the property run-detect adjacency
+  requires even when null keys are present. Only all-ascending,
+  nulls-last orderings can be canonical.
+- ``lexsort_exact``: re-applying ``Table.sort`` with exactly this
+  (keys, ascending, nulls_last) spec is the identity permutation. True
+  for the output of that very lexsort (stable sorts are idempotent) and
+  for any canonical ordering over mask-free key columns; False when a
+  canonically-ordered table may hold null keys (the lexsort comparator
+  orders null rows by their masked payload, the canonical order by a
+  zeroed lane — re-sorting could legally reorder the null run).
+
+A descriptor is a claim about LIVE rows only; padding rows are outside it.
+Ops that reorder, reroute or rewrite rows must drop the descriptor — the
+default: ``Table`` constructors carry no ordering unless a call site
+explicitly attaches one, so a forgotten propagation degrades to a missed
+optimization, never a wrong answer. ``CYLON_TPU_NO_ORDERING=1`` disables
+every consumer gate (the differential-testing and escape hatch); the
+chosen path is always part of the kernel cache key, so flipping the env
+mid-process recompiles instead of aliasing.
+"""
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+
+class Ordering(NamedTuple):
+    """Validated sortedness descriptor (see module docstring)."""
+
+    keys: Tuple[str, ...]
+    ascending: Tuple[bool, ...]
+    nulls_last: bool = True
+    scope: str = "shard"
+    canonical: bool = False
+    lexsort_exact: bool = False
+
+    def describe(self) -> str:
+        """Compact one-line rendering for ``.explain()`` / repr."""
+        ks = ", ".join(
+            f"{k} {'asc' if a else 'desc'}"
+            for k, a in zip(self.keys, self.ascending)
+        )
+        return f"[{ks}] @{self.scope}"
+
+
+def validate(ordering: Optional[Ordering], column_names) -> Optional[Ordering]:
+    """Check a descriptor against a table's columns; raises on malformed
+    descriptors, returns the descriptor (or None) otherwise."""
+    if ordering is None:
+        return None
+    if not isinstance(ordering, Ordering):
+        raise TypeError(f"ordering must be an Ordering, got {type(ordering)}")
+    if not ordering.keys:
+        raise ValueError("ordering needs at least one key column")
+    if len(ordering.keys) != len(ordering.ascending):
+        raise ValueError("ordering keys/ascending length mismatch")
+    if ordering.scope not in ("shard", "global"):
+        raise ValueError(f"unknown ordering scope {ordering.scope!r}")
+    missing = [k for k in ordering.keys if k not in column_names]
+    if missing:
+        raise ValueError(f"ordering keys not in table: {missing}")
+    if ordering.canonical and (
+        not all(ordering.ascending) or not ordering.nulls_last
+    ):
+        raise ValueError(
+            "canonical orderings are ascending + nulls-last by definition"
+        )
+    return ordering
+
+
+def enabled() -> bool:
+    """Consumer-gate master switch (read per call — the chosen fast path is
+    always part of the kernel cache key, so flips recompile, never alias)."""
+    return os.environ.get("CYLON_TPU_NO_ORDERING", "0") != "1"
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def disabled():
+    """Temporarily disable every order-property consumer gate — the ONE
+    save/set/restore toggle for the differential oracles (tests and
+    ``tools/fuzz_campaign.py --profile ordering``): fast path vs generic
+    path on identical data."""
+    prev = os.environ.get("CYLON_TPU_NO_ORDERING")
+    os.environ["CYLON_TPU_NO_ORDERING"] = "1"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_NO_ORDERING", None)
+        else:
+            os.environ["CYLON_TPU_NO_ORDERING"] = prev
+
+
+def covers_prefix(
+    ordering: Optional[Ordering],
+    names: Sequence[str],
+    need_canonical: bool = True,
+) -> bool:
+    """Does the descriptor prove the rows ordered by ``names`` (major first,
+    all ascending, nulls last)?
+
+    ``need_canonical=True`` additionally demands the canonical null
+    discipline — required whenever the consumer run-detects or compares key
+    runs on columns that may carry validity masks (see module docstring);
+    callers that verified every involved column is mask-free may relax it.
+    """
+    if ordering is None or not enabled():
+        return False
+    k = len(names)
+    if k == 0 or len(ordering.keys) < k:
+        return False
+    if tuple(ordering.keys[:k]) != tuple(names):
+        return False
+    if not all(ordering.ascending[:k]):
+        return False
+    if not ordering.nulls_last:
+        return False
+    if need_canonical and not ordering.canonical:
+        return False
+    return True
+
+
+def matches_sort_spec(
+    ordering: Optional[Ordering],
+    names: Sequence[str],
+    ascending: Sequence[bool],
+    nulls_last: bool = True,
+) -> int:
+    """Length of the longest prefix of the requested sort spec the
+    descriptor already guarantees AS THE LEXSORT WOULD PRODUCE IT
+    (``lexsort_exact`` — identity-safe). 0 = no reuse; ``len(names)`` =
+    the whole sort is a no-op."""
+    if ordering is None or not enabled() or not ordering.lexsort_exact:
+        return 0
+    if ordering.nulls_last != nulls_last:
+        return 0
+    m = 0
+    for i, (n, a) in enumerate(zip(names, ascending)):
+        if i >= len(ordering.keys):
+            break
+        if ordering.keys[i] != n or ordering.ascending[i] != bool(a):
+            break
+        m += 1
+    return m
+
+
+def rename(
+    ordering: Optional[Ordering], mapping: dict
+) -> Optional[Ordering]:
+    """Ordering after a column rename (descriptor follows its columns)."""
+    if ordering is None:
+        return None
+    return ordering._replace(
+        keys=tuple(mapping.get(k, k) for k in ordering.keys)
+    )
+
+
+def truncate_to(
+    ordering: Optional[Ordering], kept_names
+) -> Optional[Ordering]:
+    """Ordering after a projection: the longest key prefix whose columns
+    all survive (rows stay sorted by any prefix of the original keys)."""
+    if ordering is None:
+        return None
+    kept = set(kept_names)
+    m = 0
+    for k in ordering.keys:
+        if k not in kept:
+            break
+        m += 1
+    if m == 0:
+        return None
+    return ordering._replace(
+        keys=ordering.keys[:m], ascending=ordering.ascending[:m]
+    )

@@ -44,6 +44,22 @@ HEADER_ROWS = 1
 DEFAULT_MAX_ROUNDS = 16
 
 
+def ordering_after_shuffle(kind: str):
+    """Order property of a shuffled table (cylon_tpu/ordering.py): always
+    ``None``. A hash/task shuffle reroutes rows by placement; a range
+    shuffle co-locates key ranges but leaves shards internally unordered
+    (the caller's local sort re-establishes — and upgrades to global
+    scope). Crucially, even a single-key range shuffle destroys the
+    WITHIN-shard property across the chunked engine's K rounds: each round
+    lands as one contiguous block per source shard (`compact_received_lanes`
+    front-packs arrival order: source-major, round-major after the
+    table-level concat), so two rounds' key ranges interleave — sortedness
+    must never be claimed to "survive" the exchange, at any K."""
+    if kind not in ("hash", "range", "task"):
+        raise ValueError(f"unknown shuffle kind {kind!r}")
+    return None
+
+
 def bucket_counts(pid: jax.Array, num_partitions: int) -> jax.Array:
     """Rows per target partition on this shard -> [P] int32 (padding pid==P
     is dropped)."""

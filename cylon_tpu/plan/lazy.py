@@ -158,7 +158,13 @@ class LazyFrame:
 
     # -- execution ---------------------------------------------------------
     def explain(self) -> str:
-        """Pre-rewrite plan, post-rewrite plan, and the rules that fired."""
+        """Pre-rewrite plan, post-rewrite plan, and the rules that fired.
+
+        Each node line carries its derived order property (``-- order:
+        [k asc] @shard`` — ``Node.ordering()``, the sortedness analog of
+        partitioning); an ``order_reuse`` firing shows up as a dropped Sort
+        or a ``Join ... emit=key-order`` + ``GroupBy ... [input
+        key-ordered: groupby lexsort elided]`` pair."""
         opt, fired = _rules.optimize(self._plan, self._ctx.world_size)
         lines = ["== Logical plan ==", self._plan.render(), "",
                  "== Optimized plan ==", opt.render(), ""]
@@ -178,7 +184,13 @@ class LazyFrame:
         """Optimize, lower and execute the plan; returns an eager Table."""
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
-        fingerprint = self._plan.fingerprint()
+        from ..ordering import enabled as _ord_enabled
+
+        # the ordering escape hatch changes which rewrites fire, so it is
+        # part of the executable's identity — a mid-process env flip must
+        # re-optimize, never reuse a cached executor built under the other
+        # gate state
+        fingerprint = (self._plan.fingerprint(), _ord_enabled())
 
         def compile_plan():
             with span("plan.optimize"):

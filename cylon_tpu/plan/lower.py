@@ -71,6 +71,7 @@ def detach_scans(root: Node) -> Node:
             stub.table = None
             stub.ordinal = n.ordinal
             stub.schema = n.schema
+            stub.table_ordering = n.ordering()  # frozen compile-time claim
             out: Node = stub
         elif n.children:
             out = n.with_children([walk(c) for c in n.children])
@@ -180,6 +181,10 @@ def _lower_one(node: Node, ex, tables):
         return lt.join(
             rt, left_on=l_keys, right_on=r_keys, how=node.how,
             suffixes=node.suffixes,
+            # order_reuse rewrite: emit grouped-key order so the consumer's
+            # lexsort elides (the eager join stamps the ordering descriptor
+            # and e.g. Table.groupby auto-run-detects off it)
+            emit_order="key" if node.emit_key_order else "left",
         )
     if isinstance(node, FusedJoinGroupBySum):
         lchild, l_shuf = _peel_shuffle(node.children[0], node.l_on)

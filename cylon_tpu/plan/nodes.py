@@ -15,6 +15,9 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
+from .. import ordering as _ord
+from ..ordering import Ordering
+
 SchemaEntry = Tuple[str, int, str]  # (name, Type enum value, physical dtype)
 # ORDERED key tuples: order is part of the placement function's identity
 # (hashing ['a','b'] and ['b','a'] routes differently), so two-table
@@ -54,6 +57,13 @@ class Node:
         """Column sets whose equal tuples are co-located (see module doc)."""
         return []
 
+    def ordering(self) -> Optional[Ordering]:
+        """The node's output order property, derived like partitioning:
+        what the eager op provably establishes/preserves (see
+        cylon_tpu/ordering.py). None = no claim. The ``order_reuse`` rule
+        consumes it, and ``.explain()`` prints it per node."""
+        return None
+
     def _params(self) -> tuple:
         """Node-local fingerprint parameters (no children, no schema —
         schema is derived and scans carry theirs explicitly)."""
@@ -71,7 +81,11 @@ class Node:
         return type(self).__name__
 
     def render(self, indent: int = 0) -> str:
-        lines = ["  " * indent + self.label()]
+        line = "  " * indent + self.label()
+        o = self.ordering()
+        if o is not None:
+            line += f"  -- order: {o.describe()}"
+        lines = [line]
         for c in self.children:
             lines.append(c.render(indent + 1))
         return "\n".join(lines)
@@ -84,6 +98,12 @@ class Scan(Node):
     def __init__(self, table):
         self.table = table
         self.ordinal: Optional[int] = None
+        # detached stubs freeze the descriptor they were compiled under
+        # (lower.detach_scans); live Scans read it from the table at USE
+        # time below — an in-place mutation (__setitem__) clears the
+        # table's descriptor, and a capture here would let the order_reuse
+        # rewrite act on the stale claim
+        self.table_ordering: Optional[Ordering] = None
         self.schema = tuple(
             (n, int(table._columns[n].dtype.type), str(table._columns[n].data.dtype))
             for n in table.column_names
@@ -93,8 +113,21 @@ class Scan(Node):
         assert not kids
         return self
 
+    def ordering(self) -> Optional[Ordering]:
+        if self.table is None:  # detached stub
+            return self.table_ordering
+        return self.table._ordering
+
     def _params(self) -> tuple:
-        return (self.ordinal, self.schema, self.table.world_size)
+        # the ordering descriptor is part of the plan identity: a cached
+        # executor whose rewrites consumed (or ignored) input sortedness
+        # must not be reused for an input with a different order property.
+        # Read LIVE at fingerprint time (collect), same snapshot optimize
+        # sees in the same collect call.
+        return (
+            self.ordinal, self.schema, self.table.world_size,
+            self.ordering(),
+        )
 
     def label(self) -> str:
         return f"Scan [{', '.join(self.names)}]"
@@ -116,6 +149,9 @@ class Project(Node):
     def partitioning(self) -> Partitioning:
         kept = set(self.cols)
         return [s for s in self.children[0].partitioning() if set(s) <= kept]
+
+    def ordering(self) -> Optional[Ordering]:
+        return _ord.truncate_to(self.children[0].ordering(), self.cols)
 
     def _params(self) -> tuple:
         return (self.cols,)
@@ -139,6 +175,9 @@ class Filter(Node):
     def partitioning(self) -> Partitioning:
         return self.children[0].partitioning()
 
+    def ordering(self) -> Optional[Ordering]:
+        return self.children[0].ordering()  # row subset keeps row order
+
     def _params(self) -> tuple:
         return (self.expr.key(),)
 
@@ -161,12 +200,16 @@ class Join(Node):
         how: str = "inner",
         suffixes: Tuple[str, str] = ("_x", "_y"),
         _renames: Optional[Tuple[Dict[str, str], Dict[str, str]]] = None,
+        emit_key_order: bool = False,
     ):
         self.children = (left, right)
         self.l_on = tuple(l_on)
         self.r_on = tuple(r_on)
         self.how = how
         self.suffixes = tuple(suffixes)
+        # set by the order_reuse rewrite: lower with emit_order='key' so the
+        # join's probe kv-sort doubles as the downstream op's key sort
+        self.emit_key_order = bool(emit_key_order)
         if _renames is None:
             lnames, rnames = left.names, right.names
             out = _suffix_names(lnames, rnames, suffixes)
@@ -183,6 +226,7 @@ class Join(Node):
         return Join(
             kids[0], kids[1], self.l_on, self.r_on, self.how, self.suffixes,
             _renames=(self.l_rename, self.r_rename),
+            emit_key_order=self.emit_key_order,
         )
 
     @property
@@ -211,23 +255,50 @@ class Join(Node):
             out.append(self.r_key_out)
         return out
 
+    def ordering(self) -> Optional[Ordering]:
+        if self.emit_key_order and self.how in ("inner", "left"):
+            return Ordering(
+                keys=self.l_key_out,
+                ascending=(True,) * len(self.l_on),
+                nulls_last=True, scope="shard", canonical=True,
+                lexsort_exact=False,
+            )
+        if self.how in ("inner", "left"):
+            # the emit repeats left rows in left order: the left input's
+            # descriptor survives, under the join's output names
+            return _ord.rename(self.children[0].ordering(), self.l_rename)
+        return None
+
     def _params(self) -> tuple:
         return (
             self.l_on, self.r_on, self.how, self.suffixes,
             tuple(sorted(self.l_rename.items())),
             tuple(sorted(self.r_rename.items())),
+            self.emit_key_order,
         )
 
     def label(self) -> str:
         keys = ", ".join(f"{a}={b}" for a, b in zip(self.l_on, self.r_on))
-        return f"Join how={self.how} on [{keys}]"
+        tail = " emit=key-order" if self.emit_key_order else ""
+        return f"Join how={self.how} on [{keys}]{tail}"
 
 
 class GroupBy(Node):
-    def __init__(self, child: Node, keys: Sequence[str], aggs: Sequence[Tuple[str, str]]):
+    def __init__(
+        self,
+        child: Node,
+        keys: Sequence[str],
+        aggs: Sequence[Tuple[str, str]],
+        sorted_input: bool = False,
+    ):
         self.children = (child,)
         self.keys = tuple(keys)
         self.aggs = tuple(aggs)  # [(value column, op name)]
+        # annotation set by the order_reuse rewrite: the child provably
+        # emits key order, so lowering's eager groupby will run-detect
+        # instead of lexsorting (the eager gate re-verifies — the plan
+        # claim is advisory, the kernel choice is the table's)
+        self.sorted_input = bool(sorted_input)
         by_name = {e[0]: e for e in child.schema}
         out = [by_name[k] for k in keys]
         for c, op in self.aggs:
@@ -236,18 +307,30 @@ class GroupBy(Node):
         self.schema = tuple(out)
 
     def with_children(self, kids):
-        return GroupBy(kids[0], self.keys, self.aggs)
+        return GroupBy(kids[0], self.keys, self.aggs, self.sorted_input)
 
     def partitioning(self) -> Partitioning:
         kept = set(self.keys)
         return [s for s in self.children[0].partitioning() if set(s) <= kept]
 
+    def ordering(self) -> Optional[Ordering]:
+        # groups emit in canonical key order (factorize id order)
+        return Ordering(
+            keys=self.keys, ascending=(True,) * len(self.keys),
+            nulls_last=True, scope="shard", canonical=True,
+            lexsort_exact=False,
+        )
+
     def _params(self) -> tuple:
-        return (self.keys, self.aggs)
+        return (self.keys, self.aggs, self.sorted_input)
 
     def label(self) -> str:
         spec = ", ".join(f"{op}({c})" for c, op in self.aggs)
-        return f"GroupBy [{', '.join(self.keys)}] agg [{spec}]"
+        tail = (
+            " [input key-ordered: groupby lexsort elided]"
+            if self.sorted_input else ""
+        )
+        return f"GroupBy [{', '.join(self.keys)}] agg [{spec}]{tail}"
 
 
 class Sort(Node):
@@ -264,6 +347,22 @@ class Sort(Node):
 
     def partitioning(self) -> Partitioning:
         return self.children[0].partitioning()
+
+    def ordering(self) -> Optional[Ordering]:
+        # canonical is a mask-dependent property the plan can't see; the
+        # identity claim (lexsort_exact) is what the sort-elision rule needs
+        child = self.children[0]
+        scope = "shard"
+        if (
+            isinstance(child, Shuffle) and child.kind == "range"
+            and child.keys == (self.by[0],)
+            and child.asc0 == self.ascending[0]
+        ):
+            scope = "global"  # the sample-sort recipe
+        return Ordering(
+            keys=self.by, ascending=self.ascending, nulls_last=True,
+            scope=scope, canonical=False, lexsort_exact=True,
+        )
 
     def _params(self) -> tuple:
         return (self.by, self.ascending)
@@ -396,6 +495,18 @@ class FusedJoinGroupBySum(Node):
                 pair_names[ki] = name
             return [tuple(pair_names)]
         return []
+
+    def ordering(self) -> Optional[Ordering]:
+        # join_sum_by_key_pushdown numbers groups over the merged kv-sort:
+        # canonical key order, keys in join-pair order
+        pair_names = [None] * len(self.l_on)
+        for name, ki in zip(self.out_keys, self.key_order):
+            pair_names[ki] = name
+        return Ordering(
+            keys=tuple(pair_names), ascending=(True,) * len(pair_names),
+            nulls_last=True, scope="shard", canonical=True,
+            lexsort_exact=False,
+        )
 
     def _params(self) -> tuple:
         return (

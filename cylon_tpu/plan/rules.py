@@ -20,7 +20,15 @@ counted into the tracing registry by ``collect()``):
 4. ``fused_join_groupby`` — collapse GroupBy(sum)-over-inner-Join on the
    join key into :class:`~cylon_tpu.plan.nodes.FusedJoinGroupBySum`
    (lowers to ``ops.join.join_sum_by_key_pushdown``);
-5. ``projection_pushdown`` — prune unused columns down to the scans (and
+5. ``order_reuse`` — propagate order properties (``Node.ordering()``, the
+   sortedness analog of partitioning): drop a Sort whose input already has
+   the exact requested order identity-exactly, and rewrite a
+   GroupBy-over-Join on the join keys (the q3 shape the fused rule does
+   not take — non-sum/non-f32 aggregates, multi-agg, left joins) so the
+   join emits GROUPED-KEY order (``Join(emit_key_order=True)`` lowers to
+   ``emit_order='key'``, same kernel cost) and the groupby's factorize
+   lexsort elides into a run-detect;
+6. ``projection_pushdown`` — prune unused columns down to the scans (and
    below the shuffles, where narrower rows mean fewer exchanged lanes).
 """
 from __future__ import annotations
@@ -48,6 +56,7 @@ from .nodes import (
 FILTER_PUSHDOWN = "filter_pushdown"
 SHUFFLE_ELIM = "shuffle_elimination"
 FUSED_JOIN_GROUPBY = "fused_join_groupby"
+ORDER_REUSE = "order_reuse"
 PROJECTION_PUSHDOWN = "projection_pushdown"
 
 
@@ -58,6 +67,7 @@ def optimize(root: Node, world_size: int) -> Tuple[Node, List[str]]:
         root = _physicalize(root)
     root = _eliminate_shuffles(root, fired)
     root = _fuse_join_groupby(root, fired)
+    root = _reuse_order(root, fired)
     root = _prune_columns(root, fired)
     return root, fired
 
@@ -238,7 +248,71 @@ def _fuse_join_groupby(node: Node, fired: List[str]) -> Node:
 
 
 # ----------------------------------------------------------------------
-# 5. projection pushdown (column pruning)
+# 5. order-property propagation / reuse
+# ----------------------------------------------------------------------
+def _reuse_order(node: Node, fired: List[str]) -> Node:
+    """Consume ``Node.ordering()`` claims (runs AFTER shuffle elimination
+    and the fused pushdown, so a planner Shuffle still standing between a
+    producer and consumer correctly blocks reuse — shuffles claim no
+    order)."""
+    kids = [_reuse_order(c, fired) for c in node.children]
+    node = node.with_children(kids) if node.children else node
+    if isinstance(node, Sort):
+        from ..ordering import matches_sort_spec
+
+        child = node.children[0]
+        o = child.ordering()
+        if matches_sort_spec(o, node.by, node.ascending) == len(node.by):
+            # identity-exact: the input IS the sort's output
+            fired.append(ORDER_REUSE)
+            return child
+        if (
+            isinstance(child, Shuffle) and child.kind == "range"
+            and child.keys == (node.by[0],)
+            and child.asc0 == node.ascending[0]
+        ):
+            # the physicalized sample-sort pair: when the shuffle's input
+            # already holds the requested order at GLOBAL scope, both the
+            # range re-partition and the local sort are redundant (the
+            # eager distributed_sort no-op, lifted into the plan)
+            o2 = child.children[0].ordering()
+            if (
+                o2 is not None and o2.scope == "global"
+                and matches_sort_spec(o2, node.by, node.ascending)
+                == len(node.by)
+            ):
+                fired.append(ORDER_REUSE)
+                return child.children[0]
+        return node
+    if isinstance(node, GroupBy) and not node.sorted_input:
+        from ..ordering import enabled
+
+        join = node.children[0]
+        if (
+            enabled()  # the escape hatch gates this rewrite too
+            and isinstance(join, Join)
+            and join.how in ("inner", "left")
+            and not join.emit_key_order
+            and 0 < len(node.keys) <= len(join.l_on)
+            and tuple(node.keys) == join.l_key_out[: len(node.keys)]
+        ):
+            # grouping by (a prefix of) the join's left-side key outputs:
+            # flip the join to the key-order emit (same kernel cost — only
+            # the probe's compaction key changes) and annotate the groupby;
+            # the eager gate run-detects off the emitted descriptor
+            fired.append(ORDER_REUSE)
+            j2 = Join(
+                join.children[0], join.children[1], join.l_on, join.r_on,
+                join.how, join.suffixes,
+                _renames=(join.l_rename, join.r_rename),
+                emit_key_order=True,
+            )
+            return GroupBy(j2, node.keys, node.aggs, sorted_input=True)
+    return node
+
+
+# ----------------------------------------------------------------------
+# 6. projection pushdown (column pruning)
 # ----------------------------------------------------------------------
 def _narrowed(node: Node, req: Set[str], fired: List[str]) -> Node:
     """Recursively prune, then guarantee the output schema is exactly the

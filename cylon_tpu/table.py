@@ -33,6 +33,8 @@ from .column import Column, unify_dictionaries
 from .context import CylonContext
 from .dtypes import DataType, Type
 from .engine import get_kernel, round_cap, shard_caps
+from . import ordering as _ord
+from .ordering import Ordering
 from .ops import groupby as _g
 from .ops import join as _j
 from .ops import partition as _p
@@ -157,12 +159,18 @@ class Table:
         row_counts: np.ndarray,
         shard_cap: int,
         index_name: Optional[str] = None,
+        ordering: Optional[Ordering] = None,
     ):
         self.ctx = ctx
         self._columns: "OrderedDict[str, Column]" = columns
         self._row_counts = np.asarray(row_counts, np.int64)
         self._shard_cap = int(shard_cap)
         self._counts_dev = None
+        # sortedness metadata (cylon_tpu/ordering.py): None unless an op
+        # that provably establishes order attached a validated descriptor —
+        # the conservative default, so a missed propagation is only a
+        # missed optimization
+        self._ordering = _ord.validate(ordering, columns.keys())
         # pandas-style index: None == RangeIndex; else the named column is
         # the index (reference Set_Index/ResetIndex, table.hpp + indexing/)
         self.index_name = index_name if index_name in (columns.keys() | {None}) else None
@@ -200,6 +208,32 @@ class Table:
 
     def __len__(self) -> int:
         return self.row_count
+
+    @property
+    def ordering(self) -> Optional[Ordering]:
+        """The table's order property (sortedness descriptor) or None —
+        see :mod:`cylon_tpu.ordering` for the exact semantics. Set by ops
+        that provably establish order (``sort``/``distributed_sort``,
+        ``groupby``, the key-order join emit, ...), carried by
+        row-subset/rename ops, dropped by anything that reroutes rows."""
+        return self._ordering
+
+    def with_ordering(self, ordering: Optional[Ordering]) -> "Table":
+        """Explicitly (re)declare this table's order property — validated
+        against the schema; the caller vouches for the actual sortedness
+        (the ``pipeline_groupby`` contract generalized)."""
+        t = self._replace()
+        t._ordering = _ord.validate(ordering, self._columns.keys())
+        return t
+
+    def _attach_ordering(self, ordering: Optional[Ordering]) -> "Table":
+        """Internal propagation: attach if still valid for this schema,
+        silently drop otherwise (never raise on a lapsed descriptor)."""
+        if ordering is not None and all(
+            k in self._columns for k in ordering.keys
+        ):
+            self._ordering = ordering
+        return self
 
     def column(self, name: str) -> Column:
         return self._columns[name]
@@ -598,7 +632,10 @@ class Table:
         """Reference Project (table.cpp:831-850)."""
         names = [self.column_names[c] if isinstance(c, int) else c for c in columns]
         cols = OrderedDict((n, self._columns[n]) for n in names)
-        return self._replace(columns=cols)
+        # rows untouched: sortedness survives on the longest key prefix kept
+        return self._replace(columns=cols)._attach_ordering(
+            _ord.truncate_to(self._ordering, names)
+        )
 
     def rename(self, mapping: Union[Dict[str, str], Sequence[str]]) -> "Table":
         if isinstance(mapping, dict):
@@ -606,12 +643,17 @@ class Table:
         else:
             new_names = list(mapping)
         cols = OrderedDict(zip(new_names, self._columns.values()))
-        return self._replace(columns=cols)
+        ren = dict(zip(self.column_names, new_names))
+        return self._replace(columns=cols)._attach_ordering(
+            _ord.rename(self._ordering, ren)
+        )
 
     def drop(self, columns: Sequence[str]) -> "Table":
         drop = set(columns)
         cols = OrderedDict((n, c) for n, c in self._columns.items() if n not in drop)
-        return self._replace(columns=cols)
+        return self._replace(columns=cols)._attach_ordering(
+            _ord.truncate_to(self._ordering, cols.keys())
+        )
 
     def add_prefix(self, prefix: str) -> "Table":
         """Prefix every column name (reference table.pyx:1943-1970).
@@ -671,6 +713,7 @@ class Table:
             self._row_counts = out._row_counts
             self._shard_cap = out._shard_cap
             self._counts_dev = None
+            self._ordering = out._ordering
             # direct mutation bypasses __init__'s dangling-index check and
             # any cached loc index built on the pre-drop rows
             if self.index_name not in self._columns:
@@ -806,9 +849,10 @@ class Table:
         out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
             (m, flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
         )
+        # a row-subset in input order: the sortedness descriptor survives
         return self._rebuild_cols(
             list(zip(names, self._columns.values())), out, self._out_counts(nout), cap_out
-        )
+        )._attach_ordering(self._ordering)
 
     def select(self, predicate) -> "Table":
         """Row filter by a vectorized predicate over a dict of column arrays.
@@ -842,9 +886,23 @@ class Table:
         idx = np.where(idx < 0, idx + n_total, idx)
         if len(idx) and (idx.min() < 0 or idx.max() >= n_total):
             raise IndexError("take index out of range")
-        offs = np.concatenate([[0], np.cumsum(self._row_counts)])
-        src_shard = np.searchsorted(offs[1:], idx, side="right")
-        phys = (src_shard * cap_in + (idx - offs[src_shard])).astype(np.int32)
+        counts = self._row_counts
+        if world == 1 or (
+            len(counts) and counts.max() == counts.min() and counts[0] > 0
+        ):
+            # uniform shards: a global index is already per-shard local
+            # (shard = idx // c, offset = idx - shard * c) — skip the host
+            # searchsorted over the shard offsets (O(n log P) per call on
+            # the hot iloc/limit path)
+            c = max(int(counts[0]), 1) if world > 1 else max(n_total, 1)
+            src_shard = idx // c
+            phys = (src_shard * cap_in + (idx - src_shard * c)).astype(
+                np.int32
+            )
+        else:
+            offs = np.concatenate([[0], np.cumsum(counts)])
+            src_shard = np.searchsorted(offs[1:], idx, side="right")
+            phys = (src_shard * cap_in + (idx - offs[src_shard])).astype(np.int32)
         counts, cap_out = shard_caps(len(idx), world)
         full = np.zeros(world * cap_out, np.int32)
         o = np.concatenate([[0], np.cumsum(counts)])
@@ -873,27 +931,58 @@ class Table:
         order_by: Union[str, int, Sequence[Union[str, int]]],
         ascending: Union[bool, Sequence[bool]] = True,
     ) -> "Table":
-        """Per-shard sort (reference local Sort, table.cpp:291-328)."""
+        """Per-shard sort (reference local Sort, table.cpp:291-328).
+
+        Order-property reuse (cylon_tpu/ordering.py): when the table's
+        ordering descriptor already guarantees the full requested spec
+        identity-exactly, the sort is a no-op; when it guarantees a proper
+        mask-free key PREFIX, only the suffix keys are sorted — the prefix
+        collapses into a single run-id lane (ops.sort.prefix_run_lane),
+        eliding one chained sort pass per prefix lane."""
         names = self._resolve_cols(order_by)
         asc = self._resolve_asc(ascending, len(names))
         all_names = self.column_names
         key_idx = tuple(all_names.index(n) for n in names)
+
+        m = _ord.matches_sort_spec(self._ordering, names, asc)
+        if m == len(names):
+            bump("ordering.sort_elided")
+            # a fresh handle, not `self`: in-place mutation of the "sorted
+            # result" must never write through to the source table
+            return self._replace()._attach_ordering(self._ordering)
+        # the suffix path needs mask-free prefix columns: run adjacency and
+        # run ORDER must agree with the lexsort comparator, which orders
+        # null-key rows by their masked payload (ordering.py module doc)
+        use_prefix = 0 < m < len(names) and all(
+            self._columns[n].valid is None for n in names[:m]
+        )
+        if not use_prefix:
+            m = 0
+
         flat = self._flat_cols()
-        key = ("sort", key_idx, asc, len(flat))
+        key = ("sort", key_idx, asc, len(flat), m)
 
         def build():
             def kern(dp, rep):
                 (cols, counts) = dp
                 n = counts[0]
                 cap = cols[0][0].shape[0]
-                keys = [cols[i] for i in key_idx]
+                keys = [cols[i] for i in key_idx[m:]]
+                prefix_lane = (
+                    _sort_mod.prefix_run_lane(
+                        [cols[i] for i in key_idx[:m]], n, cap
+                    )
+                    if m
+                    else None
+                )
                 # <=32-bit columns RIDE the sort as payload operands (a lane
                 # per pass instead of a random row gather); 64-bit columns
                 # fall back to one packed gather by the order (the int32
                 # lane codec path) — ops/sort split/merge_ride_cols
                 ride, payloads, heavy = _sort_mod.split_ride_cols(cols)
                 order, spays = _sort_mod.lexsort_rows_payload(
-                    keys, n, cap, payloads, ascending=list(asc)
+                    keys, n, cap, payloads, ascending=list(asc[m:]),
+                    prefix_lane=prefix_lane,
                 )
                 heavy_out = (
                     _g_pack.pack_gather(heavy, order)[0] if heavy else []
@@ -902,11 +991,18 @@ class Table:
 
             return kern
 
+        if m:
+            bump("ordering.sort_suffix")
         with span("sort", rows=int(self.row_count)):
             out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
-        return self._rebuild_cols(
+        res = self._rebuild_cols(
             list(zip(all_names, self._columns.values())), out, self._row_counts, self._shard_cap
         )
+        mask_free = all(self._columns[n].valid is None for n in names)
+        return res._attach_ordering(Ordering(
+            keys=tuple(names), ascending=asc, nulls_last=True, scope="shard",
+            canonical=mask_free and all(asc), lexsort_exact=True,
+        ))
 
     def distributed_sort(
         self,
@@ -921,12 +1017,30 @@ class Table:
         (table.hpp:388-393); 0 = defaults."""
         names = self._resolve_cols(order_by)
         asc = self._resolve_asc(ascending, len(names))
+        if (
+            self._ordering is not None
+            and self._ordering.scope == "global"
+            and _ord.matches_sort_spec(self._ordering, names, asc)
+            == len(names)
+        ):
+            # provably already in the requested global order: the re-sort
+            # would reproduce this content in this order (possibly on a
+            # different shard split — the only unobservable difference).
+            # Fresh handle, same buffers (mutation isolation, like sort)
+            bump("ordering.dist_sort_elided")
+            return self._replace()._attach_ordering(self._ordering)
         if self.world_size == 1:
             return self.sort(order_by, ascending)
         shuffled = self._shuffle_impl(
             kind="range", key_names=[names[0]], asc0=asc[0], num_bins=num_bins
         )
-        return shuffled.sort(order_by, ascending)
+        res = shuffled.sort(order_by, ascending)
+        if res._ordering is not None:
+            # range partition on the primary key + full local sort: shard
+            # i's rows all precede shard i+1's (equal primary keys share a
+            # bin), upgrading the descriptor to global scope
+            res._ordering = res._ordering._replace(scope="global")
+        return res
 
     # ------------------------------------------------------------------
     # shuffle (the distributed backbone)
@@ -1098,6 +1212,7 @@ class Table:
         suffixes: Tuple[str, str] = ("_x", "_y"),
         algorithm: str = "sort",
         config: Optional["object"] = None,
+        emit_order: str = "left",
     ) -> "Table":
         """Per-shard (local) equi-join — all 4 types (reference Join,
         table.cpp:428-480; join/hash_join.cpp + sort_join.cpp).
@@ -1109,21 +1224,54 @@ class Table:
         inner only; speculative — duplicate right keys or bucket overflow
         silently rerun the exact sort join). ``config`` takes a JoinConfig
         object (reference join_config.hpp:33-189) and must then be the ONLY
-        join argument."""
+        join argument.
+
+        ``emit_order``: 'left' (default) emits output rows in left-row
+        order (pandas merge order); 'key' (INNER/LEFT only) emits them
+        GROUPED BY the join key straight out of the probe's kv-sort — same
+        kernel cost — and stamps the output's ordering descriptor so a
+        downstream groupby/sort on the key skips its own lexsort (the
+        planner's ``order_reuse`` rewrite lowers to this). Best-effort: a
+        speculative-capacity overflow falls back to left order with no
+        descriptor, never a wrong answer.
+
+        Order-property reuse on inputs: a right table whose ordering
+        descriptor proves it canonically sorted by the join key skips the
+        probe's right-side ride sort entirely."""
         if config is not None:
             if (
                 on is not None or left_on is not None or right_on is not None
                 or how != "inner" or suffixes != ("_x", "_y")
-                or algorithm != "sort"
+                or algorithm != "sort" or emit_order != "left"
             ):
                 raise ValueError(
                     "pass either config= or explicit join arguments, not both"
                 )
             return self.join(other, **config.kwargs())
+        if emit_order not in ("left", "key"):
+            raise ValueError(f"unknown emit_order {emit_order!r}")
         l_names, r_names = self._resolve_join_keys(other, on, left_on, right_on)
+        if emit_order == "key" and how not in ("inner", "left"):
+            raise ValueError(
+                "emit_order='key' needs how='inner'/'left' (the unmatched-"
+                "right append of right/outer joins has no key-ordered emit)"
+            )
         if algorithm == "pallas_pk":
+            if emit_order == "key":
+                raise ValueError(
+                    "emit_order='key' is not supported by algorithm='pallas_pk'"
+                )
             return self._pallas_pk_join(other, l_names, r_names, how, suffixes)
         howi = _j.join_type_id(how)
+        # sorted-run reuse gate, read BEFORE dictionary unification/promotion
+        # (both preserve value order, so the descriptor's claim survives
+        # them; the _replace they perform drops the attribute itself)
+        r_presorted = _ord.covers_prefix(
+            other._ordering, r_names, need_canonical=not all(
+                other._columns[n].valid is None for n in r_names
+            ),
+        )
+        emit_key = emit_order == "key"
         left, right = _unify_dict_pair(self, other, l_names, r_names)
         lflat_k = left._flat_cols(l_names)
         rflat_k = right._flat_cols(r_names)
@@ -1133,6 +1281,7 @@ class Table:
         rk_idx = tuple(right.column_names.index(n) for n in r_names)
         key = (
             "join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
+            r_presorted, emit_key,
         ) + _j.impl_tag()
 
         # Speculative single-dispatch path: fuse probe+count+emit into ONE
@@ -1145,6 +1294,30 @@ class Table:
         src_cols = list(left._columns.values()) + list(right._columns.values())
         cap_l = left.shard_cap
         cap_r = right.shard_cap
+        # output order properties: the key-order emit ESTABLISHES canonical
+        # key order; the default left-order emit of INNER/LEFT preserves the
+        # left input's existing descriptor (rows repeat in left order)
+        l_rename = dict(
+            zip(left.column_names, out_names[: len(left.column_names)])
+        )
+        if howi in (_j.INNER, _j.LEFT):
+            carry_ordering = _ord.rename(self._ordering, l_rename)
+        else:
+            carry_ordering = None
+        key_ordering = None
+        if emit_key:
+            key_ordering = Ordering(
+                keys=tuple(l_rename[n] for n in l_names),
+                ascending=(True,) * len(l_names),
+                nulls_last=True,
+                scope="shard",
+                canonical=True,
+                lexsort_exact=all(
+                    left._columns[n].valid is None for n in l_names
+                ),
+            )
+        if r_presorted:
+            bump("ordering.join_presorted_probe")
         if _SPECULATIVE_JOIN:
             # INNER/LEFT/RIGHT: max(cap_l, cap_r) covers every <=1-match-per-
             # key workload at HALF the emit/gather width of cap_l + cap_r;
@@ -1170,7 +1343,8 @@ class Table:
                     co = dummy.shape[0]
                     out, total, shadow = _j.spec_join(
                         lk, rk, lcols, rcols, nl[0], nr[0], howi, co,
-                        emit_impl,
+                        emit_impl, r_presorted=r_presorted,
+                        emit_key_order=emit_key,
                     )
                     # pack count + f32 overflow shadow into one [2] i32 lane
                     # so the host needs a single fetch
@@ -1197,9 +1371,13 @@ class Table:
                 res = self._rebuild_cols(
                     list(zip(out_names, src_cols)), out, totals, spec_cap
                 )
+                if emit_key:
+                    bump("ordering.join_key_order_emit")
                 # compact when the speculative cap overshot so downstream
                 # ops don't pay for dead padding
-                return res._maybe_compact(totals)
+                return res._maybe_compact(totals)._attach_ordering(
+                    key_ordering if emit_key else carry_ordering
+                )
             # speculation overflowed: remember the observed size so the next
             # join with this signature speculates wide enough immediately
             hints[key] = round_cap(int(totals.max()))
@@ -1211,7 +1389,8 @@ class Table:
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
                 lo, cnt, r_order, r_cnt = _j.probe_arrays(
-                    lk, rk, nl[0], nr[0], cap_l, cap_r, howi
+                    lk, rk, nl[0], nr[0], cap_l, cap_r, howi,
+                    r_presorted=r_presorted,
                 )
                 total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
                 shadow = _j.count_overflow_check(cnt, r_cnt)
@@ -1249,10 +1428,13 @@ class Table:
             (jnp.zeros((cap_out,), jnp.int8),),
         )
         # output schema: left columns then right columns, suffix on collision
-        # (reference join_utils.cpp:28-160 suffix renaming)
+        # (reference join_utils.cpp:28-160 suffix renaming). This exact
+        # two-phase path always emits LEFT order (a key-order request that
+        # overflowed speculation degrades to no descriptor, never an
+        # unsound claim).
         return self._rebuild_cols(
             list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
-        )
+        )._attach_ordering(carry_ordering)
 
     def _pallas_pk_join(
         self,
@@ -1395,6 +1577,11 @@ class Table:
                     "mode='fused' bakes the sort join into the fused "
                     f"program; algorithm={kwargs['algorithm']!r} needs "
                     "mode='eager'"
+                )
+            if kwargs.get("emit_order", "left") != "left":
+                raise ValueError(
+                    "mode='fused' bakes the left-order emit into the fused "
+                    "program; emit_order='key' needs mode='eager'"
                 )
             return self._fused_join(other, **kwargs)
         if mode != "eager":
@@ -1631,7 +1818,16 @@ class Table:
         d, v = out[-1]
         cols_od[out_val] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
         res = Table(self.ctx, cols_od, counts, group_cap)
-        return res._maybe_compact(counts)
+        # groups emit in canonical key order (join_sum_by_key_pushdown
+        # numbers them over the merged kv-sort)
+        return res._maybe_compact(counts)._attach_ordering(Ordering(
+            keys=tuple(out_key_names),
+            ascending=(True,) * len(out_key_names),
+            nulls_last=True, scope="shard", canonical=True,
+            lexsort_exact=all(
+                left._columns[n].valid is None for n in left_on
+            ),
+        ))
 
     def lazy(self) -> "object":
         """Start a lazy query plan over this table: build with
@@ -1682,6 +1878,23 @@ class Table:
         program: the op rides in as a replicated traced scalar
         (setops.setop_emit), not a cache key; union's differing cap_out
         and two-source gather make it its own program."""
+        # sorted-input fast path gate, read BEFORE _setop_pair (whose dict
+        # unification _replace drops the attribute; the remap preserves code
+        # order, so the claim itself survives it). Single mask-free non-f64
+        # column with BOTH inputs sorted ascending: run detection + a sorted
+        # membership probe replace the combined canonical sort entirely
+        # (ops.setops.{setop,union}_emit_sorted).
+        def _sortable(t: "Table") -> bool:
+            if t.column_count != 1:
+                return False
+            c = next(iter(t._columns.values()))
+            if c.valid is not None or c.data.dtype == jnp.float64:
+                return False
+            return _ord.covers_prefix(
+                t._ordering, t.column_names, need_canonical=False
+            )
+
+        sorted_fast = _sortable(self) and _sortable(other)
         a, b = self._setop_pair(other)
         is_union = op == "union"
         if is_union and any(
@@ -1696,7 +1909,10 @@ class Table:
         nc = len(lflat)
 
         cap_out = a.shard_cap + b.shard_cap if is_union else a.shard_cap
-        key = ("setop_union" if is_union else "setop2", nc, cap_out)
+        key = ("setop_union" if is_union else "setop2", nc, cap_out,
+               sorted_fast)
+        if sorted_fast:
+            bump("ordering.setop_sorted_probe")
 
         def build_emit():
             def kern(dp, rep):
@@ -1704,12 +1920,14 @@ class Table:
                 cap_l = lk[0][0].shape[0]
                 cap_r = rk[0][0].shape[0]
                 if is_union:
-                    idx, total, src = _s.union_emit(
+                    emit = _s.union_emit_sorted if sorted_fast else _s.union_emit
+                    idx, total, src = emit(
                         lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out
                     )
                 else:
                     (want_in_r,) = rep
-                    idx, total = _s.setop_emit(
+                    emit = _s.setop_emit_sorted if sorted_fast else _s.setop_emit
+                    idx, total = emit(
                         lk, rk, nl[0], nr[0], cap_l, cap_r, cap_out,
                         want_in_r,
                     )
@@ -1728,7 +1946,11 @@ class Table:
         res = a._rebuild_cols(
             list(zip(a.column_names, a._columns.values())), out, counts, cap_out
         )
-        return res._maybe_compact(counts)
+        res = res._maybe_compact(counts)
+        if not is_union:
+            # subtract/intersect keep a subset of LEFT rows in left order
+            res = res._attach_ordering(self._ordering)
+        return res
 
     def distributed_union(self, other: "Table") -> "Table":
         return self._dist_setop(other, "union")
@@ -1780,7 +2002,19 @@ class Table:
         # cap_out = shard_cap is a static exact upper bound — no count phase,
         # ONE host sync; selective results are compacted afterwards.
         cap_out = self.shard_cap
-        key = ("unique", key_idx, keep, len(flat), cap_out, order_idx)
+        # order-property reuse: input canonically ordered by the dedup keys
+        # -> run-detect + mask compaction instead of the two canonical sorts
+        # (identical output: on sorted input, run starts/ends ARE the
+        # first/last occurrences, emitted in the same ascending row order)
+        sorted_fast = (
+            order_idx < 0
+            and keep in ("first", "last")
+            and _ord.covers_prefix(self._ordering, names)
+        )
+        if sorted_fast:
+            bump("ordering.unique_run_detect")
+        key = ("unique", key_idx, keep, len(flat), cap_out, order_idx,
+               sorted_fast)
 
         def build_emit():
             def kern(dp, rep):
@@ -1788,14 +2022,19 @@ class Table:
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 keys = [cols[i] for i in key_idx]
-                order_lane = None
-                if order_idx >= 0:
-                    from .ops.sort import orderable_key
+                if sorted_fast:
+                    idx, total = _s.unique_emit_sorted(
+                        keys, n, cap, cap_out, keep
+                    )
+                else:
+                    order_lane = None
+                    if order_idx >= 0:
+                        from .ops.sort import orderable_key
 
-                    order_lane = orderable_key(cols[order_idx][0])
-                idx, total = _s.unique_emit(
-                    keys, n, cap, cap_out, keep, order_lane=order_lane
-                )
+                        order_lane = orderable_key(cols[order_idx][0])
+                    idx, total = _s.unique_emit(
+                        keys, n, cap, cap_out, keep, order_lane=order_lane
+                    )
                 out, _ = _g_pack.pack_gather([cols[i] for i in out_idx], idx)
                 return out, _scalar(total)
 
@@ -1807,7 +2046,8 @@ class Table:
             )
             counts = self._out_counts(nout)  # the ONE host sync
         res = self._rebuild_cols(out_pairs, out, counts, cap_out)
-        return res._maybe_compact(counts)
+        # dedup keeps a subset of rows in input order: descriptor survives
+        return res._maybe_compact(counts)._attach_ordering(self._ordering)
 
     def distributed_unique(
         self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
@@ -1843,9 +2083,25 @@ class Table:
         groupby/hash_groupby.cpp). ``agg`` maps value column -> op(s) from
         {sum,count,min,max,mean,var,std,nunique,quantile,median}. Output has
         the key columns (sorted key order) then one column per (col, op)
-        named ``col_op`` (pycylon naming, data/table.pyx:587-648)."""
-        ids_fn = _g.sorted_group_ids if _sorted else _g.group_ids
+        named ``col_op`` (pycylon naming, data/table.pyx:587-648).
+
+        Order-property reuse: when the table's ordering descriptor proves
+        the rows canonically ordered by the group keys (a prior sort on
+        mask-free keys, a key-order join emit, a groupby output...), the
+        factorize lexsort is replaced by the run-detect pass automatically
+        — the ``PipelineGroupBy`` fast path without the caller contract."""
         key_names = self._resolve_cols(by)
+        provably_sorted = _ord.covers_prefix(self._ordering, key_names)
+        if not _sorted and provably_sorted:
+            # canonical prefix order: run adjacency AND emitted group order
+            # match the factorize path exactly (ops.groupby.sorted_group_ids)
+            _sorted = True
+            bump("ordering.groupby_run_detect")
+        # the factorize path emits groups in canonical key order by
+        # construction; the run-detect path does too only when the input
+        # order is provable (a caller-contracted pipeline_groupby is not)
+        out_canonical = (not _sorted) or provably_sorted
+        ids_fn = _g.sorted_group_ids if _sorted else _g.group_ids
         # normalize agg spec -> list of (col, op_id, op_name)
         specs: List[Tuple[str, int, str]] = []
         for col, ops in agg.items():
@@ -1908,7 +2164,17 @@ class Table:
         for cname, d, v in agg_cols:
             cols_od[cname] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
         res = Table(self.ctx, cols_od, counts_np, cap_out)
-        return res._maybe_compact(counts_np)
+        res = res._maybe_compact(counts_np)
+        if out_canonical:
+            res._attach_ordering(Ordering(
+                keys=tuple(key_names),
+                ascending=(True,) * len(key_names),
+                nulls_last=True, scope="shard", canonical=True,
+                lexsort_exact=all(
+                    self._columns[n].valid is None for n in key_names
+                ),
+            ))
+        return res
 
     def distributed_groupby(
         self,
@@ -2207,6 +2473,7 @@ class Table:
         replaces a column; ``t[bool_mask] = scalar`` sets every (numeric)
         cell of the masked rows (data/table.pyx mask-__setitem__)."""
         self._built_index = None  # in-place mutation invalidates loc cache
+        self._ordering = None  # ...and any sortedness claim
         if isinstance(key, str):
             if np.isscalar(value):
                 value = np.full(self.row_count, value)
@@ -2399,12 +2666,12 @@ class Table:
         name = self._resolve_cols(column)[0]
         t = self._replace()
         t.index_name = name
-        return t
+        return t._attach_ordering(self._ordering)
 
     def reset_index(self) -> "Table":
         t = self._replace()
         t.index_name = None
-        return t
+        return t._attach_ordering(self._ordering)
 
     @staticmethod
     def concat(
@@ -2549,6 +2816,7 @@ class Table:
         self._row_counts = np.zeros_like(self._row_counts)
         self._counts_dev = None
         self.index_name = None
+        self._ordering = None
         self._built_index = None  # the loc cache pins host copies otherwise
 
     def build_index(self, kind: str = "hash"):
@@ -2864,8 +3132,12 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 if len(round_tables) == 1
                 else _concat_tables(round_tables)
             )
-            # compact when the uniform bucket sizing overshot
-            results.append(res._maybe_compact(st["new_counts"], factor=2))
+            # compact when the uniform bucket sizing overshot; any input
+            # sortedness is gone — rows arrive source-major per round and
+            # K-round chunks interleave (shuffle.ordering_after_shuffle)
+            res = res._maybe_compact(st["new_counts"], factor=2)
+            res._ordering = _sh.ordering_after_shuffle(st["spec"].kind)
+            results.append(res)
         total_s = max(_time.perf_counter() - t0, 1e-9)
         gauge("shuffle.overlap_efficiency", (t_disp - t0) / total_s)
     return results
@@ -3055,7 +3327,12 @@ def _unify_dict_pair(
         changed = True
     if not changed:
         return a, b
-    return a._replace(columns=new_a), b._replace(columns=new_b)
+    # dictionary remap preserves code order (code order == value order
+    # invariant), so any sortedness descriptor survives the rewrite
+    return (
+        a._replace(columns=new_a)._attach_ordering(a._ordering),
+        b._replace(columns=new_b)._attach_ordering(b._ordering),
+    )
 
 
 def _promote_key_pair(
@@ -3083,7 +3360,12 @@ def _promote_key_pair(
         changed = True
     if not changed:
         return a, b
-    return a._replace(columns=new_a), b._replace(columns=new_b)
+    # numeric widening is monotone: non-strict sortedness survives (equal
+    # promoted values only merge runs, never split them)
+    return (
+        a._replace(columns=new_a)._attach_ordering(a._ordering),
+        b._replace(columns=new_b)._attach_ordering(b._ordering),
+    )
 
 
 def _concat_tables(tables: Sequence["Table"]) -> "Table":

@@ -202,8 +202,15 @@ def shuffle_round_once(seed) -> bool:
     # 64-bit hi/lo split, f64 passthrough when x64 is live)
     import jax as _jax
 
+    # dtype-mix draws dictionary-encoded STRING lanes too (ISSUE 3
+    # satellite): a "str" extra column rides the shuffle's lane codec as
+    # int32 dictionary codes, and with dtype == "string" the join
+    # cross-check below runs the fused single-uint32-key fast path
+    # (ops/join._fast_path_ok) over dictionary keys DISTRIBUTED — the
+    # numeric-only mix never exercised it
     extra_cols = list(rng.choice(
-        ["i64", "bool", "f64"], size=int(rng.integers(0, 3)), replace=False
+        ["i64", "bool", "f64", "str"], size=int(rng.integers(0, 3)),
+        replace=False,
     ))
     params = dict(seed=seed, profile="shuffle", n=n, keyspace=keyspace,
                   world=world, dtype=dtype, null_p=null_p, skew=skew,
@@ -231,6 +238,9 @@ def shuffle_round_once(seed) -> bool:
             df["flag"] = rng.random(n) < 0.5
         elif c == "f64" and _jax.config.jax_enable_x64:
             df["f64"] = rng.normal(size=n)  # float64 passthrough lane
+        elif c == "str":
+            # dictionary-encoded string value column (int32 code lane)
+            df["s"] = rng.choice([f"tag{i}" for i in range(17)], n)
 
     if skew == "empty_shards":
         shards = [{c: df[c].to_numpy() for c in df.columns}] + [
@@ -350,6 +360,101 @@ def plan_round_once(seed) -> bool:
     ok = check(got, want, f"plan/{how}/{tail}", params)
     if not ok:
         print(fired, flush=True)
+    return ok
+
+
+def _ordering_off(fn):
+    """Run ``fn`` with every order-property consumer gate disabled
+    (``cylon_tpu.ordering.disabled()`` — the one shared toggle; the chosen
+    path is part of each kernel cache key, so flipping mid-process
+    recompiles instead of aliasing). The fuzz oracle: fast path vs generic
+    path on the same data."""
+    from cylon_tpu.ordering import disabled
+
+    with disabled():
+        return fn()
+
+
+def ordering_round_once(seed) -> bool:
+    """Order-property oracle round (ISSUE 3): randomize (size, keyspace,
+    dtype, null density, world, keep/agg/how), establish sortedness via
+    ``sort``, and differential-check every sorted-input fast path —
+    groupby run-detect, sort no-op/suffix, unique run-detect, single-column
+    set-op searchsorted probe, key-order join emit, presorted-right probe —
+    against the generic paths with the gates disabled. Also asserts the
+    descriptor lifecycle: set by sort, dropped by the chunked shuffle."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, MAX_N))
+    keyspace = int(rng.integers(1, 60))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.15]))
+    world = int(rng.choice([1, 2, 4]))
+    agg_op = str(rng.choice(["sum", "count", "mean", "min"]))
+    keep = str(rng.choice(["first", "last"]))
+    how = str(rng.choice(["inner", "left"]))
+    params = dict(seed=seed, profile="ordering", n=n, keyspace=keyspace,
+                  dtype=dtype, null_p=null_p, world=world, agg=agg_op,
+                  keep=keep, how=how)
+    ctx = ctx_for(world)
+    ldf = rand_frame(rng, n, keyspace, dtype, null_p, "v")
+    rdf = rand_frame(rng, max(n // 2, 1), keyspace, dtype, null_p, "w")
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    ok = True
+
+    s = lt.sort("k")
+    if s.ordering is None:
+        print(f"MISMATCH ordering_not_set params={params}", flush=True)
+        ok = False
+
+    # groupby run-detect vs factorize
+    got = s.groupby("k", {"v": agg_op}).to_pandas()
+    want = _ordering_off(lambda: s.groupby("k", {"v": agg_op}).to_pandas())
+    ok &= check(got, want, "ordering/groupby", params)
+
+    # sort no-op (idempotence) and suffix-only multi-key sort
+    got = s.sort("k").to_pandas()
+    want = _ordering_off(lambda: s.sort("k").to_pandas())
+    ok &= check(got, want, "ordering/sort_noop", params)
+    got = s.sort(["k", "v"]).to_pandas()
+    want = _ordering_off(lambda: s.sort(["k", "v"]).to_pandas())
+    ok &= check(got, want, "ordering/sort_suffix", params)
+
+    # unique run-detect
+    got = s.unique(["k"], keep=keep).to_pandas()
+    want = _ordering_off(lambda: s.unique(["k"], keep=keep).to_pandas())
+    ok &= check(got, want, "ordering/unique", params)
+
+    # single-column set ops (searchsorted probe when mask-free)
+    lk = lt.project(["k"]).sort("k")
+    rk = rt.project(["k"]).sort("k")
+    for op in ("union", "subtract", "intersect"):
+        got = getattr(lk, op)(rk).to_pandas()
+        want = _ordering_off(lambda: getattr(lk, op)(rk).to_pandas())
+        ok &= check(got, want, f"ordering/{op}", params)
+
+    # key-order join emit vs pandas (content) — and vs the plain emit
+    want = expected_join(ldf, rdf, how)
+    got = lt.distributed_join(rt, on="k", how=how,
+                              emit_order="key").to_pandas()
+    ok &= check(got, want, f"ordering/join_key_order/{how}", params)
+
+    # presorted-right probe (local join: the descriptor survives to the
+    # probe only without an intervening shuffle)
+    ctx1 = ctx_for(1)
+    lt1 = ct.Table.from_pandas(ctx1, ldf)
+    rs1 = ct.Table.from_pandas(ctx1, rdf).sort("k")
+    got = lt1.join(rs1, on="k", how=how).to_pandas()
+    want = _ordering_off(lambda: lt1.join(rs1, on="k", how=how).to_pandas())
+    ok &= check(got, want, f"ordering/join_presorted/{how}", params)
+
+    # invalidation: a (possibly multi-round) chunked shuffle drops the claim
+    if world > 1:
+        shuffled = s.shuffle(["k"], byte_budget=int(rng.choice([512, 1 << 20])))
+        if shuffled.ordering is not None:
+            print(f"MISMATCH ordering_survived_shuffle params={params}",
+                  flush=True)
+            ok = False
     return ok
 
 
@@ -528,19 +633,24 @@ def main():
     ap.add_argument("--max-n", type=int, default=400,
                     help="upper bound on random table sizes (bigger stresses "
                          "respill/overflow/capacity-retry paths)")
-    ap.add_argument("--profile", choices=["default", "skew", "plan", "shuffle"],
+    ap.add_argument("--profile",
+                    choices=["default", "skew", "plan", "shuffle", "ordering"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
                          "'plan': LazyFrame-optimizer-vs-eager oracle rounds; "
                          "'shuffle': chunked-shuffle oracle (random K / byte "
                          "budget / dtype mix / skew vs the eager unchunked "
-                         "result)")
+                         "result); 'ordering': sorted-input fast paths "
+                         "(groupby run-detect, sort no-op/suffix, unique, "
+                         "set-op probe, key-order join) vs the generic paths "
+                         "with CYLON_TPU_NO_ORDERING=1")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
     fn = {"skew": skew_round_once, "plan": plan_round_once,
-          "shuffle": shuffle_round_once}.get(args.profile, round_once)
+          "shuffle": shuffle_round_once,
+          "ordering": ordering_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
