@@ -244,6 +244,49 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     _roofline_recorded(dj_extra, hbm, s, dist_join)
     record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra)
 
+    # config 1b: the same join at ~10% selectivity with the semi-join
+    # sketch filter (ops/sketch.py): both sides prune provably partnerless
+    # rows against the other side's broadcast key sketch before the
+    # payload all_to_all — the coll MB cell is the win, the sketch
+    # collective's own bytes included (benchmarks/semi_filter_bench.py
+    # holds the CI gate and the full selectivity sweep)
+    from benchmarks.semi_filter_bench import make_pair as _semi_pair
+    from cylon_tpu.ops import sketch as _sk_mod
+    from cylon_tpu.utils.tracing import report as _trace_report
+    from cylon_tpu.utils.tracing import reset_trace as _treset
+
+    left_s, right_s = _semi_pair(
+        ct, ctx, np.random.default_rng(7), n_rows, sel=0.10
+    )
+
+    def dist_join_semi():
+        out = left_s.distributed_join(right_s, on="k", how="inner")
+        _sync(out)
+
+    s, c = _bench(dist_join_semi, reps)
+    djs_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
+    _treset()
+    _roofline_recorded(djs_extra, hbm, s, dist_join_semi)
+    # the semi-filter gauges of the recorded call ride the bench row so
+    # regenerated BENCH tables carry them next to the coll MB they explain
+    sf = _trace_report("shuffle.semi_filter.")
+    g = sf.get("shuffle.semi_filter.selectivity", {})
+    if g.get("count"):
+        djs_extra["semi_selectivity"] = round(g["total_s"] / g["count"], 4)
+    djs_extra["sketch_mb"] = round(
+        _trace_report("semi_filter.").get(
+            "semi_filter.sketch_bytes", {}
+        ).get("rows", 0) / 1e6,
+        3,
+    )
+    # the unfiltered coll MB of the identical join, for the narrative
+    with _sk_mod.disabled():
+        off_extra = {}
+        _roofline_recorded(off_extra, hbm, s, dist_join_semi)
+        if "collective_mb" in off_extra:
+            djs_extra["coll_mb_unfiltered"] = off_extra["collective_mb"]
+    record("dist_inner_join_semi", s, c, 2 * n_rows, world, djs_extra)
+
     # fused execution mode: whole shuffle->join chain as ONE XLA program
     # with a single host sync (vs one sync per op phase in eager mode) —
     # the product surface of parallel/pipeline.py. The host_sync counter

@@ -1,0 +1,194 @@
+"""Semi-join sketch filter benchmark + the CI coll-MB regression gate.
+
+Runs the eager distributed inner join over a selectivity sweep (the
+fraction of each side's rows that have a partner on the other side:
+1% / 10% / 50% / 100%) and measures, per selectivity, the traced
+per-shard collective bytes (benchmarks/roofline.py — the ``coll MB``
+quantity BENCH.md established as the predictor of real ICI behavior)
+with the filter ON vs OFF (``CYLON_TPU_NO_SEMI_FILTER=1``). The sketch
+collective's own bytes are part of the ON measurement — the roofline
+walker prices the sketch program's all_gather like any other collective
+— so the reported reduction is net of the filter's cost.
+
+``--smoke`` (the CI ``benchmark-smoke`` job) gates and exits 1 on
+regression:
+  1. at 10% selectivity the filtered join must ship >= GATE (default
+     40%) fewer traced collective bytes than the unfiltered join,
+     sketch bytes included;
+  2. filtered and unfiltered outputs must be identical at EVERY
+     selectivity (sorted row compare);
+  3. the filter must actually have engaged at low selectivity
+     (``shuffle.semi_filter.applied``) and the adaptive gate must have
+     skipped it at 100% (``shuffle.semi_filter.gate_skipped``).
+
+Usage:
+  python benchmarks/semi_filter_bench.py --rows 40000 --smoke
+  python benchmarks/semi_filter_bench.py --rows 1000000   # report only
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import numpy as np
+
+SELECTIVITIES = (0.01, 0.10, 0.50, 1.00)
+
+
+def measure_coll_bytes(op):
+    """(traced collective bytes over one warm call, warm seconds)."""
+    from benchmarks.roofline import analyze
+    from cylon_tpu import engine
+
+    op()  # warm (compile outside the recorded call)
+    engine.record_kernels(True)
+    t0 = time.perf_counter()
+    try:
+        op()
+    finally:
+        dt = time.perf_counter() - t0
+        kernels = engine.recorded_kernels()
+        engine.record_kernels(False)
+    total = 0
+    for fn, args in kernels:
+        total += analyze(fn, *args).collective_bytes
+    return total, dt
+
+
+def make_pair(ct, ctx, rng, n, sel):
+    """~``sel`` of each side's rows have a partner: left keys U[0, K),
+    right keys U[(1-sel)K, (2-sel)K) — the overlap window is sel*K wide on
+    both sides, and K = n/4 keeps window occupancy ~98% so the labeled
+    selectivity is the real match fraction. Each side carries three f32
+    payload columns besides the key (16 B/row in the lane codec) — the
+    quantity the filter shrinks is payload bytes, and a key-only table is
+    the one shape nobody joins in practice."""
+    K = max(n // 4, 8)
+    shift = int((1.0 - sel) * K)
+
+    def cols(lo, hi, prefix):
+        out = {"k": rng.integers(lo, hi, n).astype(np.int32)}
+        for i in range(3):
+            out[f"{prefix}{i}"] = rng.normal(size=n).astype(np.float32)
+        return out
+
+    lt = ct.Table.from_pydict(ctx, cols(0, K, "v"))
+    rt = ct.Table.from_pydict(ctx, cols(shift, shift + K, "w"))
+    return lt, rt
+
+
+def run(rows: int, world: int, smoke: bool, gate: float) -> int:
+    import __graft_entry__ as ge
+
+    devices = ge._force_cpu_mesh(max(world, 1))
+
+    import cylon_tpu as ct
+    from cylon_tpu.ops import sketch as _sk
+    from cylon_tpu.utils.tracing import get_count, report, reset_trace
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+    rng = np.random.default_rng(0)
+    fails = []
+    reduction_at_10 = None
+    for sel in SELECTIVITIES:
+        lt, rt = make_pair(ct, ctx, rng, rows, sel)
+        res = {}
+
+        def joined(key):
+            res[key] = lt.distributed_join(rt, on="k", how="inner")
+
+        reset_trace()
+        on_bytes, on_s = measure_coll_bytes(lambda: joined("on"))
+        rep = report("shuffle.semi_filter.")
+        g = rep.get("shuffle.semi_filter.selectivity", {})
+        measured_sel = (
+            round(g["total_s"] / g["count"], 4) if g.get("count") else None
+        )
+        applied = get_count("shuffle.semi_filter.applied")
+        gate_skipped = get_count("shuffle.semi_filter.gate_skipped")
+        sketch_bytes = report("semi_filter.").get(
+            "semi_filter.sketch_bytes", {}
+        ).get("rows", 0)
+        with _sk.disabled():
+            off_bytes, off_s = measure_coll_bytes(lambda: joined("off"))
+        reduction = 1.0 - on_bytes / max(off_bytes, 1)
+        rec = {
+            "benchmark": "semi_filter_sweep",
+            "rows": 2 * rows,
+            "world": world,
+            "selectivity": sel,
+            "measured_selectivity": measured_sel,
+            "coll_mb_filtered": round(on_bytes / 1e6, 3),
+            "coll_mb_unfiltered": round(off_bytes / 1e6, 3),
+            "coll_mb_reduction_pct": round(100 * reduction, 1),
+            "sketch_bytes": int(sketch_bytes),
+            "filters_applied": applied,
+            "gate_skipped": gate_skipped,
+            "warm_s_filtered": round(on_s, 4),
+            "warm_s_unfiltered": round(off_s, 4),
+        }
+        print(json.dumps(rec), flush=True)
+
+        # differential identity at every selectivity (sorted rows)
+        import pandas.testing as pdt
+
+        cols = ["k_x", "v0", "w0"]
+        pdt.assert_frame_equal(
+            res["on"].to_pandas().sort_values(cols).reset_index(drop=True),
+            res["off"].to_pandas().sort_values(cols).reset_index(drop=True),
+        )
+        if sel == 0.10:
+            reduction_at_10 = reduction
+            if applied < 2:
+                fails.append(
+                    f"filter engaged on {applied}/2 sides at 10% selectivity"
+                )
+        if sel == 1.00 and applied > 0 and gate_skipped == 0:
+            fails.append(
+                "adaptive gate did not skip the filter at 100% selectivity"
+            )
+
+    if not smoke:
+        return 0
+    if reduction_at_10 is None or reduction_at_10 < gate:
+        fails.append(
+            f"coll MB reduced {100 * (reduction_at_10 or 0):.1f}% at 10% "
+            f"selectivity (< gate {100 * gate:.0f}%, sketch bytes counted)"
+        )
+    for f in fails:
+        print(f"SEMI FILTER GATE FAIL: {f}", file=sys.stderr)
+    if not fails:
+        print(
+            f"# semi-filter gate ok: -{100 * reduction_at_10:.1f}% coll MB "
+            "at 10% selectivity (sketch bytes counted), outputs identical "
+            "across the sweep",
+            file=sys.stderr,
+        )
+    return 1 if fails else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate mode: exit 1 on coll-MB regression")
+    ap.add_argument("--gate", type=float,
+                    default=float(os.environ.get("SEMI_FILTER_GATE", 0.40)),
+                    help="minimum fractional coll-MB reduction at 10% "
+                         "selectivity")
+    args = ap.parse_args()
+    sys.exit(run(args.rows, args.world, args.smoke, args.gate))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,41 @@ def shuffle_byte_budget(configured: Optional[object] = None) -> int:
     return DEFAULT_SHUFFLE_BYTE_BUDGET
 
 
+# ----------------------------------------------------------------------
+# semi-join sketch filter (ops/sketch.py; table._shuffle_pair)
+# ----------------------------------------------------------------------
+# Cap on the blocked-Bloom size of ONE semi-join key sketch, in bits.
+# 2 Mi bits = 256 KiB packed uint32 — the bound on the per-shard bytes each
+# side injects into the single sketch collective. The engine sizes the
+# actual sketch from the build side's row count (sketch.BITS_PER_KEY per
+# key) and only grows to this cap; raise it for very large build sides
+# where the default saturates (false positives = missed pruning, never a
+# wrong answer). Override per context via
+# ``ctx.add_config("sketch_bits", str(n))`` or process-wide via
+# CYLON_TPU_SKETCH_BITS.
+DEFAULT_SKETCH_BITS = 1 << 21
+
+# Host-side size gate: build sketches only when the filtered sides'
+# PER-SHARD exchange payload (rows x row_bytes / world — the same basis
+# the traced coll-MB accounting uses, since each shard injects its whole
+# local sketch but only its 1/world row slice) is at least this multiple
+# of the sketch collective's own bytes. Tables below the line skip the
+# sketch entirely — the collective would cost more than perfect pruning
+# could save.
+SEMI_FILTER_MIN_PAYOFF = 2
+
+
+def sketch_bits(configured: Optional[object] = None) -> int:
+    """Resolve the semi-join sketch bit cap: an explicit value wins, then
+    the CYLON_TPU_SKETCH_BITS env var, then the module default."""
+    if configured:
+        return int(configured)
+    env = os.environ.get("CYLON_TPU_SKETCH_BITS", "")
+    if env:
+        return int(env)
+    return DEFAULT_SKETCH_BITS
+
+
 class CommType(enum.IntEnum):
     LOCAL = 0
     TPU = 1

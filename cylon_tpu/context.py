@@ -151,6 +151,15 @@ class CylonContext:
 
         return shuffle_byte_budget(self._config.get("shuffle_byte_budget"))
 
+    @property
+    def sketch_bits(self) -> int:
+        """Effective semi-join sketch bit cap for this context (config KV
+        ``sketch_bits`` > CYLON_TPU_SKETCH_BITS env >
+        config.DEFAULT_SKETCH_BITS)."""
+        from .config import sketch_bits
+
+        return sketch_bits(self._config.get("sketch_bits"))
+
     # -- sequencing (reference GetNextSequence, cylon_context.cpp:106) ------
     def get_next_sequence(self) -> int:
         return next(self._sequence)

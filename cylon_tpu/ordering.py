@@ -93,30 +93,14 @@ def validate(ordering: Optional[Ordering], column_names) -> Optional[Ordering]:
     return ordering
 
 
-def enabled() -> bool:
-    """Consumer-gate master switch (read per call — the chosen fast path is
-    always part of the kernel cache key, so flips recompile, never alias)."""
-    return os.environ.get("CYLON_TPU_NO_ORDERING", "0") != "1"
+# Consumer-gate master switch (read per call — the chosen fast path is
+# always part of the kernel cache key, so flips recompile, never alias)
+# + the save/set/restore differential-oracle toggle for tests and
+# ``tools/fuzz_campaign.py --profile ordering``. Shared machinery with
+# the semi-filter gate (utils/envgate.py).
+from .utils.envgate import env_gate as _env_gate
 
-
-import contextlib as _contextlib
-
-
-@_contextlib.contextmanager
-def disabled():
-    """Temporarily disable every order-property consumer gate — the ONE
-    save/set/restore toggle for the differential oracles (tests and
-    ``tools/fuzz_campaign.py --profile ordering``): fast path vs generic
-    path on identical data."""
-    prev = os.environ.get("CYLON_TPU_NO_ORDERING")
-    os.environ["CYLON_TPU_NO_ORDERING"] = "1"
-    try:
-        yield
-    finally:
-        if prev is None:
-            os.environ.pop("CYLON_TPU_NO_ORDERING", None)
-        else:
-            os.environ["CYLON_TPU_NO_ORDERING"] = prev
+enabled, disabled = _env_gate("CYLON_TPU_NO_ORDERING")
 
 
 def covers_prefix(

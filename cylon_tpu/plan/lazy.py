@@ -184,13 +184,16 @@ class LazyFrame:
         """Optimize, lower and execute the plan; returns an eager Table."""
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
+        from ..ops.sketch import enabled as _semi_enabled
         from ..ordering import enabled as _ord_enabled
 
-        # the ordering escape hatch changes which rewrites fire, so it is
-        # part of the executable's identity — a mid-process env flip must
-        # re-optimize, never reuse a cached executor built under the other
-        # gate state
-        fingerprint = (self._plan.fingerprint(), _ord_enabled())
+        # the ordering and semi-filter escape hatches change which rewrites
+        # fire, so both are part of the executable's identity — a
+        # mid-process env flip must re-optimize, never reuse a cached
+        # executor built under the other gate state
+        fingerprint = (
+            self._plan.fingerprint(), _ord_enabled(), _semi_enabled()
+        )
 
         def compile_plan():
             with span("plan.optimize"):

@@ -96,20 +96,29 @@ def _peel_shuffle(child: Node, keys: Sequence[str]):
     return child, False
 
 
-def _prepare_join_inputs(lt, rt, l_keys, r_keys, l_shuf: bool, r_shuf: bool):
+# plan-side semi_filter annotation -> table._shuffle_pair sides
+_SEMI_SIDES = {"both": "both", "left": "a", "right": "b"}
+
+
+def _prepare_join_inputs(
+    lt, rt, l_keys, r_keys, l_shuf: bool, r_shuf: bool, semi=None
+):
     """The join-input invariant in ONE place (used by Join and the fused
     node): unify dictionaries and promote key dtypes BEFORE hashing, then
     replay the peeled planner Shuffles on the prepared pair. When BOTH
     sides re-partition, one chunked-engine call shuffles the pair with
     interleaved round dispatch (table._shuffle_pair) — the lazy path picks
-    up the same overlap and byte-budget plumbing as the eager join."""
+    up the same overlap, byte-budget, and semi-join sketch-filter plumbing
+    as the eager join (``semi`` = the node's semi_filter annotation)."""
     from ..table import _promote_key_pair, _shuffle_pair, _unify_dict_pair
 
     lt, rt = _unify_dict_pair(lt, rt, l_keys, r_keys)
     lt, rt = _promote_key_pair(lt, rt, l_keys, r_keys)
     if lt.world_size > 1:
         if l_shuf and r_shuf:
-            lt, rt = _shuffle_pair(lt, l_keys, rt, r_keys)
+            lt, rt = _shuffle_pair(
+                lt, l_keys, rt, r_keys, semi=_SEMI_SIDES.get(semi)
+            )
         elif l_shuf:
             lt = lt._shuffle_impl(kind="hash", key_names=l_keys)
         elif r_shuf:
@@ -176,7 +185,7 @@ def _lower_one(node: Node, ex, tables):
         rt = rt.rename({n: node.r_rename[n] for n in rt.column_names})
         l_keys, r_keys = list(node.l_key_out), list(node.r_key_out)
         lt, rt = _prepare_join_inputs(
-            lt, rt, l_keys, r_keys, l_shuf, r_shuf
+            lt, rt, l_keys, r_keys, l_shuf, r_shuf, semi=node.semi_filter
         )
         return lt.join(
             rt, left_on=l_keys, right_on=r_keys, how=node.how,
@@ -191,7 +200,8 @@ def _lower_one(node: Node, ex, tables):
         rchild, r_shuf = _peel_shuffle(node.children[1], node.r_on)
         l_on, r_on = list(node.l_on), list(node.r_on)
         lt, rt = _prepare_join_inputs(
-            ex(lchild), ex(rchild), l_on, r_on, l_shuf, r_shuf
+            ex(lchild), ex(rchild), l_on, r_on, l_shuf, r_shuf,
+            semi=node.semi_filter,
         )
         # kernel emits key columns in join-pair order; name them so that
         # projecting to node.names restores the groupby key order

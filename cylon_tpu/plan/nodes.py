@@ -201,6 +201,7 @@ class Join(Node):
         suffixes: Tuple[str, str] = ("_x", "_y"),
         _renames: Optional[Tuple[Dict[str, str], Dict[str, str]]] = None,
         emit_key_order: bool = False,
+        semi_filter: Optional[str] = None,
     ):
         self.children = (left, right)
         self.l_on = tuple(l_on)
@@ -210,6 +211,10 @@ class Join(Node):
         # set by the order_reuse rewrite: lower with emit_order='key' so the
         # join's probe kv-sort doubles as the downstream op's key sort
         self.emit_key_order = bool(emit_key_order)
+        # set by the semi_filter rewrite: which input sides' shuffles may
+        # prune against the other side's key sketch ('both'/'left'/'right';
+        # None = ineligible or disabled) — see ops/sketch.join_filter_sides
+        self.semi_filter = semi_filter
         if _renames is None:
             lnames, rnames = left.names, right.names
             out = _suffix_names(lnames, rnames, suffixes)
@@ -227,6 +232,7 @@ class Join(Node):
             kids[0], kids[1], self.l_on, self.r_on, self.how, self.suffixes,
             _renames=(self.l_rename, self.r_rename),
             emit_key_order=self.emit_key_order,
+            semi_filter=self.semi_filter,
         )
 
     @property
@@ -270,16 +276,21 @@ class Join(Node):
         return None
 
     def _params(self) -> tuple:
+        # semi_filter is part of the plan identity: a cached executor that
+        # lowers the filtered pair exchange must not serve an annotation-
+        # free (or differently-sided) plan
         return (
             self.l_on, self.r_on, self.how, self.suffixes,
             tuple(sorted(self.l_rename.items())),
             tuple(sorted(self.r_rename.items())),
-            self.emit_key_order,
+            self.emit_key_order, self.semi_filter,
         )
 
     def label(self) -> str:
         keys = ", ".join(f"{a}={b}" for a, b in zip(self.l_on, self.r_on))
         tail = " emit=key-order" if self.emit_key_order else ""
+        if self.semi_filter:
+            tail += f" semi-filter={self.semi_filter}"
         return f"Join how={self.how} on [{keys}]{tail}"
 
 
@@ -461,6 +472,7 @@ class FusedJoinGroupBySum(Node):
         key_order: Sequence[int],   # join-key-pair index for each out key
         out_val: str,
         val_dtype: Tuple[int, str],
+        semi_filter: Optional[str] = None,
     ):
         self.children = (left, right)
         self.l_on = tuple(l_on)
@@ -469,6 +481,9 @@ class FusedJoinGroupBySum(Node):
         self.out_keys = tuple(out_keys)
         self.key_order = tuple(key_order)
         self.out_val = out_val
+        # the fused node IS an inner join: the semi_filter rewrite may mark
+        # both input shuffles prunable, exactly like Join
+        self.semi_filter = semi_filter
         lby = {e[0]: e for e in left.schema}
         entries = []
         for name, ki in zip(self.out_keys, self.key_order):
@@ -481,7 +496,7 @@ class FusedJoinGroupBySum(Node):
         return FusedJoinGroupBySum(
             kids[0], kids[1], self.l_on, self.r_on, self.val_col,
             self.out_keys, self.key_order, self.out_val,
-            self.schema[-1][1:],
+            self.schema[-1][1:], semi_filter=self.semi_filter,
         )
 
     def partitioning(self) -> Partitioning:
@@ -511,14 +526,15 @@ class FusedJoinGroupBySum(Node):
     def _params(self) -> tuple:
         return (
             self.l_on, self.r_on, self.val_col, self.out_keys,
-            self.key_order, self.out_val,
+            self.key_order, self.out_val, self.semi_filter,
         )
 
     def label(self) -> str:
         keys = ", ".join(f"{a}={b}" for a, b in zip(self.l_on, self.r_on))
+        tail = f" semi-filter={self.semi_filter}" if self.semi_filter else ""
         return (
             f"FusedJoinGroupBySum on [{keys}] sum({self.val_col}) "
-            "-> join_sum_by_key_pushdown"
+            f"-> join_sum_by_key_pushdown{tail}"
         )
 
 

@@ -28,7 +28,16 @@ counted into the tracing registry by ``collect()``):
    join emits GROUPED-KEY order (``Join(emit_key_order=True)`` lowers to
    ``emit_order='key'``, same kernel cost) and the groupby's factorize
    lexsort elides into a run-detect;
-6. ``projection_pushdown`` — prune unused columns down to the scans (and
+6. ``semi_filter`` — annotate Join / FusedJoinGroupBySum nodes whose input
+   Shuffles both still stand with their semi-join filter eligibility by
+   join type (inner: both sides; left: right side only; right: left side
+   only; outer: never — false-positive-only pruning must not touch rows
+   that emit unconditionally). Lowering threads the annotation into the
+   pair shuffle (``table._shuffle_pair(semi=...)``), where each eligible
+   side's rows are probed against the OTHER side's broadcast key sketch
+   (ops/sketch.py) before they are packed; printed by ``.explain()`` and
+   part of the plan fingerprint. CYLON_TPU_NO_SEMI_FILTER=1 disables;
+7. ``projection_pushdown`` — prune unused columns down to the scans (and
    below the shuffles, where narrower rows mean fewer exchanged lanes).
 """
 from __future__ import annotations
@@ -57,6 +66,7 @@ FILTER_PUSHDOWN = "filter_pushdown"
 SHUFFLE_ELIM = "shuffle_elimination"
 FUSED_JOIN_GROUPBY = "fused_join_groupby"
 ORDER_REUSE = "order_reuse"
+SEMI_FILTER = "semi_filter"
 PROJECTION_PUSHDOWN = "projection_pushdown"
 
 
@@ -68,6 +78,8 @@ def optimize(root: Node, world_size: int) -> Tuple[Node, List[str]]:
     root = _eliminate_shuffles(root, fired)
     root = _fuse_join_groupby(root, fired)
     root = _reuse_order(root, fired)
+    if world_size > 1:
+        root = _annotate_semi_filter(root, fired)
     root = _prune_columns(root, fired)
     return root, fired
 
@@ -312,7 +324,55 @@ def _reuse_order(node: Node, fired: List[str]) -> Node:
 
 
 # ----------------------------------------------------------------------
-# 6. projection pushdown (column pruning)
+# 6. semi-join sketch filter annotation
+# ----------------------------------------------------------------------
+def _both_shuffled(node: Node, l_on, r_on) -> bool:
+    """The pair-exchange precondition: BOTH inputs are (still) hash
+    Shuffles on their side's join keys — lowering then routes the pair
+    through ``_shuffle_pair``, the only place the sketch exchange can
+    overlap the pack dispatch. An elided shuffle means that side's rows
+    never repack, so there is no exchange for the filter to shrink."""
+    left, right = node.children
+    return (
+        isinstance(left, Shuffle) and left.kind == "hash"
+        and set(left.keys) == set(l_on)
+        and isinstance(right, Shuffle) and right.kind == "hash"
+        and set(right.keys) == set(r_on)
+    )
+
+
+def _annotate_semi_filter(node: Node, fired: List[str]) -> Node:
+    """Mark Join / FusedJoinGroupBySum nodes whose pair shuffle may prune
+    rows against the other side's key sketch (ops/sketch.py). Annotation
+    only — the eager engine re-checks soundness (hash-class pairing, size
+    payoff) and measures selectivity at run time; the plan records the
+    join-type eligibility so ``.explain()`` shows it and the fingerprint
+    distinguishes filtered from unfiltered executors."""
+    from ..ops.sketch import enabled, join_filter_sides
+
+    kids = [_annotate_semi_filter(c, fired) for c in node.children]
+    node = node.with_children(kids) if node.children else node
+    if not enabled():
+        return node
+    # Join/Fused nodes always have children, so `node` is already the
+    # fresh with_children copy above — safe to stamp the attribute
+    if isinstance(node, Join) and node.semi_filter is None:
+        sides = join_filter_sides(node.how)
+        if sides is not None and _both_shuffled(node, node.l_on, node.r_on):
+            fired.append(SEMI_FILTER)
+            # table-side names: 'both' | the single filtered input side
+            node.semi_filter = {"both": "both", "a": "left", "b": "right"}[
+                sides
+            ]
+    elif isinstance(node, FusedJoinGroupBySum) and node.semi_filter is None:
+        if _both_shuffled(node, node.l_on, node.r_on):
+            fired.append(SEMI_FILTER)
+            node.semi_filter = "both"  # the fused node is an inner join
+    return node
+
+
+# ----------------------------------------------------------------------
+# 7. projection pushdown (column pruning)
 # ----------------------------------------------------------------------
 def _narrowed(node: Node, req: Set[str], fired: List[str]) -> Node:
     """Recursively prune, then guarantee the output schema is exactly the

@@ -40,6 +40,7 @@ from .ops import join as _j
 from .ops import partition as _p
 from .ops import setops as _s
 from .ops import gather as _g_pack
+from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
 from .parallel import shuffle as _sh
 from .utils.tracing import bump, gauge, span
@@ -1598,8 +1599,14 @@ class Table:
         left, right = _promote_key_pair(left, right, l_names, r_names)
         # one engine call for both sides: the two shuffles' rounds interleave
         # in the dispatch queue (pack of one hides behind the collective of
-        # the other) instead of serializing table-by-table
-        ls, rs = _shuffle_pair(left, l_names, right, r_names)
+        # the other) instead of serializing table-by-table. The semi-join
+        # sketch filter prunes provably partnerless rows before the payload
+        # exchange, gated by join type (inner: both sides; left/right: the
+        # other side only; outer: off — ops/sketch.join_filter_sides)
+        ls, rs = _shuffle_pair(
+            left, l_names, right, r_names,
+            semi=_sketch.join_filter_sides(kwargs.get("how", "inner")),
+        )
         return ls.join(rs, **kwargs)
 
     def _fused_join(
@@ -1968,7 +1975,14 @@ class Table:
         if self.world_size == 1:
             return getattr(self, op)(other)
         a, b = self._setop_pair(other)
-        asf, bsf = _shuffle_pair(a, a.column_names, b, b.column_names)
+        # intersect/subtract are natural semi-join consumers: rows provably
+        # absent from the side that decides their fate never ship (set-op
+        # equality treats null == null — the sketches' null-as-value mode
+        # matches, ops/sketch.py module doc)
+        asf, bsf = _shuffle_pair(
+            a, a.column_names, b, b.column_names,
+            semi=_sketch.setop_filter_sides(op),
+        )
         return getattr(asf, op)(bsf)
 
     # ------------------------------------------------------------------
@@ -2884,7 +2898,14 @@ class Table:
 # ----------------------------------------------------------------------
 
 class _ShuffleSpec(NamedTuple):
-    """One table's shuffle request for :func:`_shuffle_many`."""
+    """One table's shuffle request for :func:`_shuffle_many`.
+
+    The ``sketch`` fields carry the semi-join filter (ops/sketch.py): when
+    ``sketch`` is a combined global sketch array (built by
+    :func:`_pair_sketches`), the count and pack kernels probe the shuffle
+    key columns against row ``probe_row`` of its per-shard [S, L] view and
+    rows that provably have no partner on the other side are never packed
+    — the payload collective ships only the survivors."""
 
     table: "Table"
     kind: str
@@ -2893,6 +2914,9 @@ class _ShuffleSpec(NamedTuple):
     num_bins: int = 0
     task_map: Optional[np.ndarray] = None
     byte_budget: Optional[int] = None
+    sketch: Optional[jax.Array] = None
+    probe_row: int = 0
+    use_range: bool = False
 
 
 def _shuffle_state(spec: "_ShuffleSpec") -> dict:
@@ -2935,10 +2959,21 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         tuple(np.asarray(task_map).tolist()) if task_map is not None else None
     )
     plan_sig = tuple(_g_pack.lane_plan(flat))
+    semi = spec.sketch is not None
+
+    def probe_ok(cols, sk_view):
+        """Per-row semi-filter survival against the OTHER side's combined
+        sketch (row ``probe_row`` of the per-shard [S, L] view)."""
+        keys = [cols[i] for i in key_idx]
+        return _sketch.probe(keys, sk_view[spec.probe_row], spec.use_range)
+
     # the lane plan is part of the kernel identity: the pack/compact
     # builders bake the passthrough layout in, so same-arity tables with
-    # different dtypes must not alias to one cache entry
-    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key)
+    # different dtypes must not alias to one cache entry; the semi-filter
+    # probe changes both kernels' bodies, so its statics join the key
+    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key) + (
+        ("semi", spec.probe_row, spec.use_range) if semi else ()
+    )
     has_lanes = any(
         tag is not None or has_valid for tag, _nl, has_valid in plan_sig
     )
@@ -2946,6 +2981,21 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
 
     def build_count():
         def kern(dp, rep):
+            if semi:
+                # stacked [2, P]: row 0 = unfiltered counts, row 1 = the
+                # counts with the semi filter applied — the host reads the
+                # pair in its ONE existing count fetch, measures the exact
+                # selectivity, and gates the pack phase on it
+                (cols, kcols, counts, sk) = dp
+                n = counts[0]
+                pid = compute_pid(cols, kcols, n)
+                pid_f = jnp.where(probe_ok(cols, sk), pid, world)
+                return jnp.stack(
+                    [
+                        _sh.bucket_counts(pid, world),
+                        _sh.bucket_counts(pid_f, world),
+                    ]
+                )
             (cols, kcols, counts) = dp
             n = counts[0]
             pid = compute_pid(cols, kcols, n)
@@ -2955,11 +3005,22 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
 
     def build_pack():
         def kern(dp, rep):
-            (cols, kcols, counts) = dp
-            (dummy, rnd) = rep
+            if semi:
+                (cols, kcols, counts, sk) = dp
+                (dummy, rnd, usef) = rep
+                n = counts[0]
+                pid = compute_pid(cols, kcols, n)
+                # the adaptive gate's decision rides in as a traced scalar
+                # so ONE compiled pack program serves both outcomes
+                pid = jnp.where(
+                    (usef != 0) & ~probe_ok(cols, sk), world, pid
+                )
+            else:
+                (cols, kcols, counts) = dp
+                (dummy, rnd) = rep
+                n = counts[0]
+                pid = compute_pid(cols, kcols, n)
             bc = dummy.shape[0]
-            n = counts[0]
-            pid = compute_pid(cols, kcols, n)
             cnt = _sh.bucket_counts(pid, world)
             dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
             rc = _sh.round_counts(cnt, bc, rnd)
@@ -3048,26 +3109,70 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     rows_total = sum(int(st["t"].row_count) for st in states)
 
     # phase 0: counts — dispatch every table's count kernel before fetching
-    # any, so a pair's two count programs overlap on the device
+    # any, so a pair's two count programs overlap on the device. Semi-
+    # filtered tables' count kernels consume the (already dispatched)
+    # sketch collective and return both the unfiltered and the filtered
+    # counts, so the adaptive gate rides the one existing fetch.
     for st in states:
+        spec = st["spec"]
+        dp = (st["flat"], st["khash"], st["t"].counts_dev)
+        if spec.sketch is not None:
+            dp = dp + (spec.sketch,)
         with span("shuffle.count", rows=int(st["t"].row_count)):
             st["counts_fut"] = get_kernel(
                 st["ctx"], st["key"] + ("count",), st["build_count"]
-            )((st["flat"], st["khash"], st["t"].counts_dev), ())
+            )(dp, ())
     for st in states:
         bump("host_sync")
-        st["send_counts"] = _fetch(st["counts_fut"]).reshape(
-            st["world"], st["world"]
-        )  # [src, dst]
-        st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
+        spec = st["spec"]
+        if spec.sketch is not None:
+            got = _fetch(st["counts_fut"]).reshape(
+                st["world"], 2, st["world"]
+            )
+            st["counts_pair"] = (got[:, 0, :], got[:, 1, :])
+            st["send_counts"] = got[:, 0, :]  # provisional; gated below
+        else:
+            st["use_filter"] = False
+            st["send_counts"] = _fetch(st["counts_fut"]).reshape(
+                st["world"], st["world"]
+            )  # [src, dst]
 
-    # phase 1: round plan from the byte budget
+    # phase 1: round plan from the byte budget. The semi-filter APPLY
+    # decision is plan-aware: shipped bytes are rounds x P x bucket_cap x
+    # row_bytes regardless of how full the buffers are (capacities round
+    # to powers of two), so the filter is used only when the filtered
+    # counts yield a strictly cheaper round plan — a prune that does not
+    # cross a capacity boundary would cost probe work for zero byte win.
     for st in states:
-        budget = st["spec"].byte_budget or st["ctx"].shuffle_byte_budget
+        budget = int(st["spec"].byte_budget or st["ctx"].shuffle_byte_budget)
         row_bytes = _sh.exchange_row_bytes(st["flat"])
-        st["bucket_cap"], st["n_rounds"] = _sh.plan_rounds(
-            st["send_counts"], row_bytes, st["world"], int(budget)
-        )
+        if st["spec"].sketch is not None:
+            unfiltered, filtered = st["counts_pair"]
+            tot_u, tot_f = int(unfiltered.sum()), int(filtered.sum())
+            gauge(
+                "shuffle.semi_filter.selectivity", tot_f / max(tot_u, 1)
+            )
+            cap_u, k_u = _sh.plan_rounds(
+                unfiltered, row_bytes, st["world"], budget
+            )
+            cap_f, k_f = _sh.plan_rounds(
+                filtered, row_bytes, st["world"], budget
+            )
+            st["use_filter"] = cap_f * k_f < cap_u * k_u
+            if st["use_filter"]:
+                bump("shuffle.semi_filter.applied")
+                bump("shuffle.semi_filter.pruned_rows", rows=tot_u - tot_f)
+                st["send_counts"] = filtered
+                st["bucket_cap"], st["n_rounds"] = cap_f, k_f
+            else:
+                bump("shuffle.semi_filter.gate_skipped")
+                st["send_counts"] = unfiltered
+                st["bucket_cap"], st["n_rounds"] = cap_u, k_u
+        else:
+            st["bucket_cap"], st["n_rounds"] = _sh.plan_rounds(
+                st["send_counts"], row_bytes, st["world"], budget
+            )
+        st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
         bump("shuffle.rounds", rows=st["n_rounds"])
         st["rounds_out"] = []
 
@@ -3085,10 +3190,16 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                     jnp.zeros((st["bucket_cap"],), jnp.int8),
                     jnp.asarray(r, jnp.int32),
                 )
+                dp = (st["flat"], st["khash"], st["t"].counts_dev)
+                if st["spec"].sketch is not None:
+                    dp = dp + (st["spec"].sketch,)
+                    rep = rep + (
+                        jnp.asarray(1 if st["use_filter"] else 0, jnp.int32),
+                    )
                 with span("shuffle.round.pack"):
                     head, pts = get_kernel(
                         ctx, st["key"] + ("pack",), st["build_pack"]
-                    )((st["flat"], st["khash"], st["t"].counts_dev), rep)
+                    )(dp, rep)
                 with span("shuffle.round.collective"):
                     head, pts = get_kernel(
                         ctx,
@@ -3143,22 +3254,132 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     return results
 
 
+def _pair_sketches(
+    a: "Table",
+    a_keys: Sequence[str],
+    b: "Table",
+    b_keys: Sequence[str],
+    sides: str,
+) -> Optional[dict]:
+    """Build the combined semi-join key sketches for a shuffle pair
+    (ops/sketch.py): each side named in ``sides`` ('both'/'a'/'b' = which
+    tables get FILTERED) needs the OTHER side's sketch, so the build list
+    is the probe targets. Every needed local sketch rides ONE collective
+    (sketch.combine_pair's all_gather) and the dispatch happens here —
+    before any count/pack kernel — so the exchange overlaps the pair's
+    count programs and the first pack dispatch.
+
+    Returns None when the filter is provably not worth it or not sound:
+    (1) a paired key column's hashing family differs across the sides
+    (the local op may equate values the sketches hash apart), or (2) the
+    filtered payload is too small to repay the sketch collective's own
+    bytes (config.SEMI_FILTER_MIN_PAYOFF). The min/max range words engage
+    only when both first keys share an exact monotone-uint32 encoding
+    class (dictionary CODES qualify — the post-unification codes, not the
+    value hashes, are what gets probed)."""
+    ctx = a.ctx
+    world = ctx.world_size
+    for an, bn in zip(a_keys, b_keys):
+        ca, cb = a._columns[an], b._columns[bn]
+        if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
+            return None
+        ha = _sketch.hash_class(ca.data.dtype)
+        hb = _sketch.hash_class(cb.data.dtype)
+        if ha is None or ha != hb:
+            return None
+    ra = _sketch.range_class(a._columns[a_keys[0]].data.dtype)
+    rb = _sketch.range_class(b._columns[b_keys[0]].data.dtype)
+    use_range = ra is not None and ra == rb
+    build = []
+    if sides in ("both", "b"):
+        build.append(("a", a, tuple(a_keys)))  # a's sketch: b probes it
+    if sides in ("both", "a"):
+        build.append(("b", b, tuple(b_keys)))  # b's sketch: a probes it
+    if not build:
+        return None
+    bits = max(
+        _sketch.sketch_bits_for(t.row_count, ctx.sketch_bits)
+        for _, t, _k in build
+    )
+    wire = len(build) * _sketch.sketch_len(bits) * 4
+    # per-shard basis on both sides of the inequality: each shard ships
+    # rows/world of payload but injects the WHOLE local sketch
+    prunable = 0
+    if sides in ("both", "a"):
+        prunable += a.row_count * _sh.exchange_row_bytes(a._flat_cols())
+    if sides in ("both", "b"):
+        prunable += b.row_count * _sh.exchange_row_bytes(b._flat_cols())
+    prunable //= max(world, 1)
+    from .config import SEMI_FILTER_MIN_PAYOFF
+
+    if prunable < SEMI_FILTER_MIN_PAYOFF * wire:
+        return None
+    kflats = [tuple(t._flat_cols(list(keys))) for _, t, keys in build]
+    sig = tuple(
+        tuple((str(d.dtype), v is not None) for d, v in kf) for kf in kflats
+    )
+    key = ("semi_sketch", sig, bits, use_range)
+    ax = ctx.axis_name
+
+    def builder():
+        def kern(dp, rep):
+            locals_ = [
+                _sketch.build_local(list(kc), counts[0], bits, use_range)
+                for kc, counts in dp
+            ]
+            return _sketch.combine_pair(jnp.stack(locals_), ax, world)
+
+        return kern
+
+    dp = tuple(
+        (kf, t.counts_dev) for (_n, t, _k), kf in zip(build, kflats)
+    )
+    with span("shuffle.semi_filter.sketch", rows=wire):
+        gsk = get_kernel(ctx, key, builder)(dp, ())
+    bump("semi_filter.sketch_bytes", rows=wire)
+    row_of = {name: i for i, (name, _t, _k) in enumerate(build)}
+    probe = {}
+    if sides in ("both", "a"):
+        probe["a"] = row_of["b"]
+    if sides in ("both", "b"):
+        probe["b"] = row_of["a"]
+    return dict(sketch=gsk, probe=probe, use_range=use_range)
+
+
 def _shuffle_pair(
     a: "Table",
     a_keys: Sequence[str],
     b: "Table",
     b_keys: Sequence[str],
     byte_budget: Optional[int] = None,
+    semi: Optional[str] = None,
 ) -> Tuple["Table", "Table"]:
     """Hash-shuffle two tables with INTERLEAVED round dispatch (one engine
     call): the pair path of distributed joins and set ops, where table B's
-    pack/compact hides behind table A's collective."""
-    out = _shuffle_many(
-        [
-            _ShuffleSpec(a, "hash", tuple(a_keys), byte_budget=byte_budget),
-            _ShuffleSpec(b, "hash", tuple(b_keys), byte_budget=byte_budget),
-        ]
-    )
+    pack/compact hides behind table A's collective.
+
+    ``semi`` ('both'/'a'/'b', see ops/sketch.join_filter_sides) engages the
+    semi-join sketch filter: the named sides' rows are probed against the
+    other side's broadcast key sketch inside the count/pack kernels and
+    provably partnerless rows never enter the payload exchange. False
+    positives only ship extra rows, so output equals the unfiltered
+    shuffle's (CYLON_TPU_NO_SEMI_FILTER=1 disables for differentials)."""
+    sa = _ShuffleSpec(a, "hash", tuple(a_keys), byte_budget=byte_budget)
+    sb = _ShuffleSpec(b, "hash", tuple(b_keys), byte_budget=byte_budget)
+    if semi is not None and a.world_size > 1 and _sketch.enabled():
+        got = _pair_sketches(a, a_keys, b, b_keys, semi)
+        if got is not None:
+            if "a" in got["probe"]:
+                sa = sa._replace(
+                    sketch=got["sketch"], probe_row=got["probe"]["a"],
+                    use_range=got["use_range"],
+                )
+            if "b" in got["probe"]:
+                sb = sb._replace(
+                    sketch=got["sketch"], probe_row=got["probe"]["b"],
+                    use_range=got["use_range"],
+                )
+    out = _shuffle_many([sa, sb])
     return out[0], out[1]
 
 

@@ -1,0 +1,39 @@
+"""Shared env-var kill-switch machinery for optimization gates.
+
+Several subsystems ship a ``CYLON_TPU_NO_<X>=1`` escape hatch whose OFF
+path doubles as the differential-testing oracle (ordering fast paths,
+the semi-join sketch filter). :func:`env_gate` builds the
+``enabled()`` / ``disabled()`` pair once so the save/set/restore toggle
+has exactly one implementation.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+def env_gate(var: str):
+    """(enabled, disabled) pair for a ``VAR=1``-disables gate.
+
+    ``enabled()`` reads the env per call — gate flips between calls take
+    effect immediately (consumers key compiled kernels on the chosen
+    path, so flips recompile, never alias). ``disabled()`` is a
+    reentrant save/set/restore context manager: the differential-oracle
+    toggle for tests and fuzz profiles."""
+
+    def enabled() -> bool:
+        return os.environ.get(var, "0") != "1"
+
+    @contextlib.contextmanager
+    def disabled():
+        prev = os.environ.get(var)
+        os.environ[var] = "1"
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+
+    return enabled, disabled

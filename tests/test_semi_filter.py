@@ -1,0 +1,466 @@
+"""Semi-join sketch filtering (ISSUE 4 tentpole).
+
+Pins the tentpole's observable contracts:
+
+- DIFFERENTIAL IDENTITY: filtered and unfiltered (CYLON_TPU_NO_SEMI_FILTER=1)
+  distributed joins and set ops produce identical output across
+  inner/left/right/outer and intersect/subtract/union at world in {1, 4, 8}
+  — including null keys (the audit: this engine's joins follow pandas
+  merge, null == null MATCHES, so nulls are sketched as values and must
+  never be pruned against a side that may hold a null) and
+  dictionary-encoded string keys (probed on post-unification CODES).
+- the sketch has NO FALSE NEGATIVES (unit level), the range words prune
+  disjoint key ranges even when the Bloom saturates, and outer joins
+  provably never build a sketch.
+- collective accounting: a filtered distributed join traces exactly
+  2 payload all_to_alls + 1 sketch all_gather, with the sketch's bytes
+  bounded by the CYLON_TPU_SKETCH_BITS knob.
+- the adaptive gate skips the filter when measured selectivity says it
+  will not pay, and the host size gate skips tiny tables entirely.
+- the plan layer annotates eligible Joins (explain + fingerprint), and the
+  dictionary fast-path precondition (single mask-free int32 code column)
+  survives the filtered shuffle.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.ops import sketch as _sk
+from cylon_tpu.utils.tracing import get_count, reset_trace
+
+
+def _ctx(devices, world):
+    return ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+
+
+def _norm(df, cols=None):
+    cols = list(df.columns) if cols is None else cols
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype == object:
+            out[c] = out[c].map(lambda v: "\x00null" if v is None else str(v))
+    out = out.fillna("\x00null").astype(str)
+    return out.sort_values(cols, kind="mergesort").reset_index(drop=True)
+
+
+def _assert_same(a, b):
+    da, db = _norm(a.to_pandas()), _norm(b.to_pandas())
+    assert list(da.columns) == list(db.columns)
+    pd.testing.assert_frame_equal(da, db)
+
+
+def _selective_pair(ctx, rng, n, dtype="int32", null_p=0.0):
+    """~10%-overlap keyspaces: left in [0, K), right in [0.9K, 1.9K)."""
+    K = 6 * n
+    lk = rng.integers(0, K, n)
+    rk = rng.integers(int(0.9 * K), int(1.9 * K), n)
+    if dtype == "str":
+        lk = np.array([f"s{v:07d}" for v in lk], dtype=object)
+        rk = np.array([f"s{v:07d}" for v in rk], dtype=object)
+    else:
+        lk = lk.astype(dtype)
+        rk = rk.astype(dtype)
+    if null_p:
+        lk = lk.astype(object)
+        rk = rk.astype(object)
+        lk[rng.random(n) < null_p] = None
+        rk[rng.random(n) < null_p] = None
+    # three payload columns per side: the size gate correctly skips
+    # key-plus-one-value tables this small (per-shard payload < 2x sketch
+    # bytes), and realistic join inputs carry payload anyway
+    lt = ct.Table.from_pandas(ctx, pd.DataFrame(
+        {"k": lk, "v": rng.normal(size=n).astype(np.float32),
+         "v1": rng.normal(size=n).astype(np.float32),
+         "v2": rng.normal(size=n).astype(np.float32)}
+    ))
+    rt = ct.Table.from_pandas(ctx, pd.DataFrame(
+        {"k": rk, "w": rng.normal(size=n).astype(np.float32),
+         "w1": rng.normal(size=n).astype(np.float32),
+         "w2": rng.normal(size=n).astype(np.float32)}
+    ))
+    return lt, rt
+
+
+# ----------------------------------------------------------------------
+# unit level: no false negatives; range pruning
+# ----------------------------------------------------------------------
+def test_sketch_no_false_negatives(rng):
+    import jax.numpy as jnp
+
+    keys = rng.integers(-50_000, 50_000, 4000).astype(np.int32)
+    cols = [(jnp.asarray(keys), None)]
+    n = jnp.asarray(len(keys), jnp.int32)
+    local = _sk.build_local(cols, n, bits=65536, use_range=True)
+    hits = np.asarray(_sk.probe(cols, local, use_range=True))
+    # every inserted key must survive its own sketch
+    assert bool(hits.all())
+
+
+def test_sketch_range_prunes_saturated_bloom(rng):
+    """Disjoint key ranges stay pruned by the min/max words even when the
+    Bloom is totally saturated (tiny bits, many keys)."""
+    import jax.numpy as jnp
+
+    build = rng.integers(0, 1_000_000, 50_000).astype(np.int32)
+    local = _sk.build_local(
+        [(jnp.asarray(build), None)], jnp.asarray(len(build), jnp.int32),
+        bits=4096, use_range=True,
+    )
+    probe_keys = rng.integers(2_000_000, 3_000_000, 1000).astype(np.int32)
+    hits = np.asarray(_sk.probe(
+        [(jnp.asarray(probe_keys), None)], local, use_range=True
+    ))
+    assert not hits.any(), "range words must prune disjoint key ranges"
+
+
+def test_sketch_empty_build_side_prunes_everything(rng):
+    import jax.numpy as jnp
+
+    empty = jnp.zeros((64,), jnp.int32)
+    local = _sk.build_local(
+        [(empty, None)], jnp.asarray(0, jnp.int32), bits=4096, use_range=True
+    )
+    keys = rng.integers(0, 100, 500).astype(np.int32)
+    hits = np.asarray(_sk.probe(
+        [(jnp.asarray(keys), None)], local, use_range=True
+    ))
+    assert not hits.any()
+
+
+# ----------------------------------------------------------------------
+# differential identity: joins
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_join_differential(world_ctx, rng, how):
+    ctx = world_ctx
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    got = lt.distributed_join(rt, on="k", how=how)
+    with _sk.disabled():
+        want = lt.distributed_join(rt, on="k", how=how)
+    _assert_same(got, want)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_join_differential_null_keys(devices, rng, how):
+    """The null-key audit (pinned): this engine's joins MATCH null keys
+    (pandas merge semantics — null x null pairs emit), so the sketch
+    treats null as a value; a filter that dropped null-key rows would
+    delete real output rows, which this differential would catch."""
+    for world in (4, 8):
+        ctx = _ctx(devices, world)
+        lt, rt = _selective_pair(ctx, rng, 3000, null_p=0.1)
+        got = lt.distributed_join(rt, on="k", how=how)
+        with _sk.disabled():
+            want = lt.distributed_join(rt, on="k", how=how)
+        # null x null matches must be present in BOTH outputs
+        assert got.row_count > 3000 * 3000 * 0.005  # ~ (0.1 * 3000)^2
+        _assert_same(got, want)
+
+
+def test_null_keys_pruned_against_null_free_side(devices, rng):
+    """Null keys have no partner when the OTHER side holds no nulls: the
+    sketch prunes them (nulls-last sentinel + null-as-zero hash identity)
+    and the output is still identical."""
+    ctx = _ctx(devices, 8)
+    n = 4000
+    lk = rng.integers(0, 24000, n).astype(object)
+    lk[rng.random(n) < 0.3] = None
+    lt = ct.Table.from_pandas(ctx, pd.DataFrame(
+        {"k": lk, "v": rng.normal(size=n).astype(np.float32),
+         "v1": rng.normal(size=n).astype(np.float32)}
+    ))
+    rt = ct.Table.from_pandas(ctx, pd.DataFrame(
+        {"k": rng.integers(22000, 46000, n).astype(object),
+         "w": rng.normal(size=n).astype(np.float32),
+         "w1": rng.normal(size=n).astype(np.float32)}
+    ))
+    reset_trace()
+    got = lt.distributed_join(rt, on="k", how="inner")
+    pruned = get_count("shuffle.semi_filter.pruned_rows")
+    with _sk.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    _assert_same(got, want)
+    assert pruned > 0
+
+
+def test_join_differential_dict_string_keys(devices, rng):
+    """Dictionary-encoded string keys filter on post-unification CODES:
+    the two sides' dictionaries differ until _unify_dict_pair, after which
+    equal strings share a code — the differential pins that the code-level
+    sketch never prunes a real string match."""
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000, dtype="str")
+    assert lt.column("k").dtype.is_dictionary
+    reset_trace()
+    got = lt.distributed_join(rt, on="k", how="inner")
+    assert get_count("shuffle.semi_filter.applied") >= 1
+    with _sk.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    _assert_same(got, want)
+
+
+def test_dict_fast_path_survives_filtered_shuffle(devices, rng):
+    """The join's fused uint32 string fast path needs a single MASK-FREE
+    int32 code column; the filtered shuffle must not manufacture a
+    validity mask on it (the lane-plan compact keeps mask-free columns
+    mask-free)."""
+    from cylon_tpu.ops.join import _fast_path_ok
+    from cylon_tpu.table import _shuffle_pair, _unify_dict_pair
+
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000, dtype="str")
+    a, b = _unify_dict_pair(lt, rt, ["k"], ["k"])
+    reset_trace()
+    asf, bsf = _shuffle_pair(a, ["k"], b, ["k"], semi="both")
+    assert get_count("shuffle.semi_filter.applied") == 2
+    for t in (asf, bsf):
+        c = t.column("k")
+        assert c.dtype.is_dictionary
+        assert _fast_path_ok([(c.data, c.valid)]), (
+            "filtered shuffle broke the uint32 fast-path precondition"
+        )
+
+
+# ----------------------------------------------------------------------
+# differential identity: set ops
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("op", ["intersect", "subtract", "union"])
+def test_setop_differential(world_ctx, rng, op):
+    ctx = world_ctx
+    n = 4000
+    la = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 12000, n).astype(np.int32),
+        "g": rng.integers(0, 3, n).astype(np.int32),
+        "x": (rng.integers(0, 40, n) * 0.25).astype(np.float32),
+    })
+    lb = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(10000, 22000, n).astype(np.int32),
+        "g": rng.integers(0, 3, n).astype(np.int32),
+        "x": (rng.integers(0, 40, n) * 0.25).astype(np.float32),
+    })
+    got = getattr(la, f"distributed_{op}")(lb)
+    with _sk.disabled():
+        want = getattr(la, f"distributed_{op}")(lb)
+    _assert_same(got, want)
+
+
+def test_setop_differential_null_rows(devices, rng):
+    """Set-op equality treats null == null: a right-side null row must keep
+    left null rows alive through the subtract filter."""
+    ctx = _ctx(devices, 4)
+    n = 3000
+    lk = rng.integers(0, 9000, n).astype(object)
+    lk[rng.random(n) < 0.2] = None
+    rk = rng.integers(8000, 17000, n).astype(object)
+    rk[rng.random(n) < 0.2] = None
+    la = ct.Table.from_pandas(ctx, pd.DataFrame({"k": lk}))
+    lb = ct.Table.from_pandas(ctx, pd.DataFrame({"k": rk}))
+    for op in ("intersect", "subtract"):
+        got = getattr(la, f"distributed_{op}")(lb)
+        with _sk.disabled():
+            want = getattr(la, f"distributed_{op}")(lb)
+        _assert_same(got, want)
+    # intersect keeps exactly one null row (nulls present on both sides)
+    vals = la.distributed_intersect(lb).to_pandas()["k"]
+    assert vals.isna().sum() == 1
+
+
+def test_union_never_filters(devices, rng):
+    """Union emits every distinct row of both sides — nothing may be
+    pruned, so no sketch is ever built."""
+    ctx = _ctx(devices, 8)
+    la = ct.Table.from_pydict(
+        ctx, {"k": rng.integers(0, 50000, 4000).astype(np.int32)}
+    )
+    lb = ct.Table.from_pydict(
+        ctx, {"k": rng.integers(45000, 95000, 4000).astype(np.int32)}
+    )
+    reset_trace()
+    la.distributed_union(lb)
+    assert get_count("semi_filter.sketch_bytes") == 0
+
+
+# ----------------------------------------------------------------------
+# gating
+# ----------------------------------------------------------------------
+def test_outer_join_provably_skips(devices, rng):
+    assert _sk.join_filter_sides("outer") is None
+    assert _sk.join_filter_sides("fullouter") is None
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    reset_trace()
+    lt.distributed_join(rt, on="k", how="outer")
+    assert get_count("semi_filter.sketch_bytes") == 0
+    assert get_count("shuffle.semi_filter.applied") == 0
+
+
+def test_adaptive_gate_skips_unselective_filter(devices, rng):
+    """Fully-overlapping keyspaces: the count phase measures ~1.0
+    selectivity and the gate packs unfiltered — same output."""
+    ctx = _ctx(devices, 8)
+    n = 6000
+    lt = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+        "v1": rng.normal(size=n).astype(np.float32),
+        "v2": rng.normal(size=n).astype(np.float32),
+    })
+    rt = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "w": rng.normal(size=n).astype(np.float32),
+        "w1": rng.normal(size=n).astype(np.float32),
+        "w2": rng.normal(size=n).astype(np.float32),
+    })
+    reset_trace()
+    got = lt.distributed_join(rt, on="k", how="inner")
+    assert get_count("shuffle.semi_filter.gate_skipped") == 2
+    assert get_count("shuffle.semi_filter.applied") == 0
+    with _sk.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    _assert_same(got, want)
+
+
+def test_size_gate_skips_tiny_tables(devices, rng):
+    """Tables whose exchange payload cannot repay the sketch collective
+    never build one (config.SEMI_FILTER_MIN_PAYOFF)."""
+    ctx = _ctx(devices, 8)
+    lt = ct.Table.from_pydict(
+        ctx, {"k": rng.integers(0, 100, 64).astype(np.int32)}
+    )
+    rt = ct.Table.from_pydict(
+        ctx, {"k": rng.integers(900, 1000, 64).astype(np.int32)}
+    )
+    reset_trace()
+    lt.distributed_join(rt, on="k", how="inner")
+    assert get_count("semi_filter.sketch_bytes") == 0
+
+
+def test_world_one_no_filter(devices, rng):
+    ctx = _ctx(devices, 1)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    reset_trace()
+    got = lt.distributed_join(rt, on="k", how="inner")
+    assert get_count("semi_filter.sketch_bytes") == 0
+    with _sk.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    _assert_same(got, want)
+
+
+# ----------------------------------------------------------------------
+# collective accounting + knobs
+# ----------------------------------------------------------------------
+def test_filtered_join_collectives_and_sketch_bytes(devices, rng, monkeypatch):
+    """A filtered distributed join traces exactly 2 payload all_to_alls +
+    1 sketch all_gather, and the sketch program's collective bytes respect
+    the CYLON_TPU_SKETCH_BITS cap."""
+    from benchmarks.roofline import traced_collectives
+
+    monkeypatch.setenv("CYLON_TPU_SKETCH_BITS", "32768")
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    colls, per_bytes = traced_collectives(
+        lambda: lt.distributed_join(rt, on="k", how="inner")
+    )
+    assert colls == 3, f"expected 2 payload + 1 sketch collectives, got {colls}"
+    cap_bytes = 2 * _sk.sketch_len(32768) * 4
+    assert min(per_bytes) <= cap_bytes, (per_bytes, cap_bytes)
+
+
+def test_sketch_bytes_counter_and_knob(devices, rng, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SKETCH_BITS", "16384")
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    reset_trace()
+    lt.distributed_join(rt, on="k", how="inner")
+    from cylon_tpu.utils.tracing import report
+
+    wire = report("semi_filter.")["semi_filter.sketch_bytes"]["rows"]
+    assert wire == 2 * _sk.sketch_len(16384) * 4
+
+
+def test_selectivity_gauge_recorded(devices, rng):
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    reset_trace()
+    lt.distributed_join(rt, on="k", how="inner")
+    from cylon_tpu.utils.tracing import report
+
+    g = report("shuffle.semi_filter.")["shuffle.semi_filter.selectivity"]
+    assert g["count"] == 2  # one sample per filtered side
+    mean_sel = g["total_s"] / g["count"]
+    assert 0.0 < mean_sel < 0.5  # ~10% true selectivity + bloom FP
+
+
+# ----------------------------------------------------------------------
+# plan layer
+# ----------------------------------------------------------------------
+def test_plan_annotates_and_lowers_semi_filter(devices, rng):
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    lf = lt.lazy().join(rt.lazy(), on="k", how="inner")
+    exp = lf.explain()
+    assert "semi-filter=both" in exp
+    reset_trace()
+    got = lf.collect()
+    assert get_count("shuffle.semi_filter.applied") == 2
+    with _sk.disabled():
+        exp_off = lt.lazy().join(rt.lazy(), on="k", how="inner").explain()
+        assert "semi-filter" not in exp_off
+        want = lt.lazy().join(rt.lazy(), on="k", how="inner").collect()
+    _assert_same(got, want)
+
+
+def test_plan_left_join_annotates_right_side(devices, rng):
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    exp = lt.lazy().join(rt.lazy(), on="k", how="left").explain()
+    assert "semi-filter=right" in exp
+    exp_outer = lt.lazy().join(rt.lazy(), on="k", how="outer").explain()
+    assert "semi-filter" not in exp_outer
+
+
+def test_plan_cache_keyed_by_semi_gate(devices, rng):
+    """A cached executor compiled WITH the filter must not serve a collect
+    under CYLON_TPU_NO_SEMI_FILTER=1 (the gate state is part of the plan
+    fingerprint, like the ordering escape hatch)."""
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+
+    def plan():
+        return lt.lazy().join(rt.lazy(), on="k", how="inner")
+
+    got = plan().collect()
+    reset_trace()
+    with _sk.disabled():
+        want = plan().collect()
+        assert get_count("shuffle.semi_filter.applied") == 0
+        assert get_count("semi_filter.sketch_bytes") == 0
+    _assert_same(got, want)
+
+
+def test_fused_join_groupby_plan_filters(devices, rng, monkeypatch):
+    # projection pushdown narrows the fused pair to k+v / k-only rows —
+    # too narrow to repay a row-count-sized sketch (the size gate would
+    # skip), so cap the sketch small via the knob, the user-facing lever
+    # for exactly this shape
+    monkeypatch.setenv("CYLON_TPU_SKETCH_BITS", "8192")
+    ctx = _ctx(devices, 8)
+    lt, rt = _selective_pair(ctx, rng, 4000)
+    lf = (
+        lt.lazy().join(rt.lazy(), on="k", how="inner")
+        .groupby(["k_x"], {"v": "sum"})
+    )
+    exp = lf.explain()
+    assert "FusedJoinGroupBySum" in exp and "semi-filter=both" in exp
+    reset_trace()
+    got = lf.collect()
+    assert get_count("shuffle.semi_filter.applied") == 2
+    with _sk.disabled():
+        want = (
+            lt.lazy().join(rt.lazy(), on="k", how="inner")
+            .groupby(["k_x"], {"v": "sum"}).collect()
+        )
+    _assert_same(got, want)

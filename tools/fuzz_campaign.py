@@ -626,6 +626,82 @@ def round_once(seed) -> bool:
     return ok
 
 
+def semi_round_once(seed) -> bool:
+    """Semi-join sketch filter oracle round (ISSUE 4): randomize
+    (sizes, keyspace overlap fraction, dtype, null density, sketch bits,
+    world) and run distributed joins + set ops twice — filter enabled vs
+    the CYLON_TPU_NO_SEMI_FILTER=1 oracle — demanding EXACT sorted-output
+    equality. The bloom's false positives and the range words' pruning
+    must never change a row; null keys (which MATCH in this engine, pandas
+    merge semantics) and dictionary string keys ride the same rounds."""
+    from cylon_tpu.ops.sketch import disabled as _semi_off
+    from cylon_tpu.utils.tracing import get_count, reset_trace
+
+    rng = np.random.default_rng(seed)
+    n_l = int(rng.integers(200, max(8 * MAX_N, 240)))
+    n_r = int(rng.integers(200, max(8 * MAX_N, 240)))
+    overlap = float(rng.choice([0.0, 0.05, 0.3, 1.0]))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.15]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    bits = int(rng.choice([4096, 8192, 16384]))
+    params = dict(seed=seed, profile="semi", n_l=n_l, n_r=n_r,
+                  overlap=overlap, dtype=dtype, null_p=null_p, world=world,
+                  bits=bits)
+    ctx = ctx_for(world)
+
+    def frame(n, lo_frac, vname):
+        """Keys drawn from a window starting at lo_frac of the combined
+        keyspace; overlap controls how much the two windows share."""
+        K = max((n_l + n_r) // 2, 8)
+        lo = int(lo_frac * K)
+        keys = rng.integers(lo, lo + K, n)
+        if dtype == "int64":
+            k = (keys.astype(np.int64) * 3).astype(object)
+        elif dtype == "float32":
+            k = keys.astype(np.float32).astype(object)
+        elif dtype == "string":
+            k = np.array([f"s{v:07d}" for v in keys], dtype=object)
+        else:
+            k = keys.astype(np.int32).astype(object)
+        if null_p:
+            k[rng.random(n) < null_p] = None
+        return pd.DataFrame({
+            "k": k,
+            vname: rng.normal(size=n).astype(np.float32),
+            vname + "2": rng.normal(size=n).astype(np.float32),
+        })
+
+    ldf = frame(n_l, 0.0, "v")
+    rdf = frame(n_r, 1.0 - overlap, "w")
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+
+    prev_bits = os.environ.get("CYLON_TPU_SKETCH_BITS")
+    os.environ["CYLON_TPU_SKETCH_BITS"] = str(bits)
+    ok = True
+    try:
+        reset_trace()
+        for how in ("inner", "left", "right"):
+            got = lt.distributed_join(rt, on="k", how=how).to_pandas()
+            with _semi_off():
+                want = lt.distributed_join(rt, on="k", how=how).to_pandas()
+            ok &= check(got, want, f"semi/join/{how}", params)
+        la, lb = lt.project(["k", "v"]), rt.rename(["k", "v", "v2"]).project(["k", "v"])
+        for op in ("intersect", "subtract", "union"):
+            got = getattr(la, f"distributed_{op}")(lb).to_pandas()
+            with _semi_off():
+                want = getattr(la, f"distributed_{op}")(lb).to_pandas()
+            ok &= check(got, want, f"semi/{op}", params)
+        params["filters_applied"] = get_count("shuffle.semi_filter.applied")
+    finally:
+        if prev_bits is None:
+            os.environ.pop("CYLON_TPU_SKETCH_BITS", None)
+        else:
+            os.environ["CYLON_TPU_SKETCH_BITS"] = prev_bits
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -634,7 +710,8 @@ def main():
                     help="upper bound on random table sizes (bigger stresses "
                          "respill/overflow/capacity-retry paths)")
     ap.add_argument("--profile",
-                    choices=["default", "skew", "plan", "shuffle", "ordering"],
+                    choices=["default", "skew", "plan", "shuffle",
+                             "ordering", "semi"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -644,13 +721,17 @@ def main():
                          "result); 'ordering': sorted-input fast paths "
                          "(groupby run-detect, sort no-op/suffix, unique, "
                          "set-op probe, key-order join) vs the generic paths "
-                         "with CYLON_TPU_NO_ORDERING=1")
+                         "with CYLON_TPU_NO_ORDERING=1; 'semi': semi-join "
+                         "sketch filter (random selectivity / dtype / "
+                         "sketch bits / world) vs the "
+                         "CYLON_TPU_NO_SEMI_FILTER=1 oracle")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
     fn = {"skew": skew_round_once, "plan": plan_round_once,
           "shuffle": shuffle_round_once,
-          "ordering": ordering_round_once}.get(args.profile, round_once)
+          "ordering": ordering_round_once,
+          "semi": semi_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
