@@ -1,0 +1,230 @@
+"""Lane-packing benchmark + the CI sort-GB / coll-MB regression gate.
+
+Measures two shapes against the ``CYLON_TPU_NO_LANE_PACK=1`` oracle on
+identical inputs:
+
+  multikey_sort   a 3-key local sort whose keys span ~12 / ~16 / ~20 bits
+                  — the ISSUE 5 headline shape: the fused planner packs
+                  pad + 3 value lanes into ONE uint64 sort word (two
+                  uint32 words without X64), so the chained 4-pass
+                  lexsort runs as 1 (2) passes and traced sort-pass
+                  bytes drop proportionally.
+  multikey_join   a distributed inner join + groupby-SUM on the same two
+                  narrow keys — the fused factorize probe plus the
+                  WIRE-NARROWED shuffle (validity 1 bit/row, values at
+                  measured width): `coll MB` must not regress and
+                  normally shrinks.
+
+``--smoke`` (the CI ``benchmark-smoke`` job) gates and exits 1 on
+regression:
+  1. the multikey sort's traced sort-pass bytes must be >= GATE (default
+     25%) below the oracle's, with strictly fewer sort ops;
+  2. the join pipeline's traced collective bytes must not exceed the
+     oracle's (wire narrowing may only shrink the exchange);
+  3. the packing counters (``lane_pack.sort_fused``,
+     ``lane_pack.wire.applied``) must actually have fired, with
+     identical outputs.
+
+Usage:
+  python benchmarks/lane_pack_bench.py --rows 50000 --smoke
+  python benchmarks/lane_pack_bench.py --rows 1000000   # report only
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def measure(op):
+    """(Report totals, warm seconds) over every recorded kernel dispatch
+    of one warm call (the ordering_bench discipline)."""
+    from benchmarks.roofline import Report, analyze
+    from cylon_tpu import engine
+
+    op()  # warm (compile outside the recorded call)
+    engine.record_kernels(True)
+    t0 = time.perf_counter()
+    try:
+        op()
+    finally:
+        dt = time.perf_counter() - t0
+        kernels = engine.recorded_kernels()
+        engine.record_kernels(False)
+    total = Report()
+    for fn, args in kernels:
+        rep = analyze(fn, *args)
+        total.sort_count += rep.sort_count
+        total.sort_bytes_per_pass += rep.sort_bytes_per_pass
+        total.sort_pass_bytes += rep.sort_pass_bytes
+        total.collective_bytes += rep.collective_bytes
+        total.collective_count += rep.collective_count
+    return total, dt
+
+
+def make_sort_table(ct, ctx, rng, n):
+    return ct.Table.from_pydict(ctx, {
+        "a": rng.integers(0, 4000, n).astype(np.int32),      # ~12 bits
+        "b": rng.integers(0, 60000, n).astype(np.int32),     # ~16 bits
+        "c": rng.integers(0, 1000000, n).astype(np.int32),   # ~20 bits
+        "v": rng.normal(size=n).astype(np.float32),
+    })
+
+
+def make_join_pair(ct, ctx, rng, n):
+    def side(vname):
+        return ct.Table.from_pydict(ctx, {
+            "k1": rng.integers(0, 4000, n).astype(np.int32),
+            "k2": rng.integers(0, 60000, n).astype(np.int32),
+            vname: rng.normal(size=n).astype(np.float32),
+        })
+
+    return side("v"), side("w")
+
+
+def run(rows: int, world: int, smoke: bool, gate: float) -> int:
+    import __graft_entry__ as ge
+
+    devices = ge._force_cpu_mesh(max(world, 1))
+
+    import cylon_tpu as ct
+    from cylon_tpu.ops import stats as stmod
+    from cylon_tpu.utils.tracing import get_count, reset_trace
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+    rng = np.random.default_rng(0)
+    n = rows
+
+    # ---- shape 1: the multi-key narrow-lane sort ----
+    t = make_sort_table(ct, ctx, rng, n)
+    res = {}
+
+    def msort_packed():
+        res["sort_p"] = t.sort(["a", "b", "c"])
+
+    def msort_oracle():
+        res["sort_o"] = t.sort(["a", "b", "c"])
+
+    reset_trace()
+    sp, tsp = measure(msort_packed)
+    fused = get_count("lane_pack.sort_fused")
+    with stmod.disabled():
+        so, tso = measure(msort_oracle)
+
+    # ---- shape 2: multi-key join + groupby (wire narrowing on the pair
+    # shuffle + fused factorize probe) ----
+    lt, rt = make_join_pair(ct, ctx, rng, n)
+    res2 = {}
+
+    def q3_packed():
+        res2["p"] = lt.distributed_join(
+            rt, on=["k1", "k2"], how="inner"
+        ).distributed_groupby(["k1_x", "k2_x"], {"v": "sum"})
+
+    def q3_oracle():
+        res2["o"] = lt.distributed_join(
+            rt, on=["k1", "k2"], how="inner"
+        ).distributed_groupby(["k1_x", "k2_x"], {"v": "sum"})
+
+    reset_trace()
+    jp, tjp = measure(q3_packed)
+    wire_applied = get_count("lane_pack.wire.applied")
+    with stmod.disabled():
+        jo, tjo = measure(q3_oracle)
+
+    sort_reduction = (
+        1.0 - sp.sort_pass_bytes / so.sort_pass_bytes
+        if so.sort_pass_bytes else 0.0
+    )
+    rec = {
+        "benchmark": "lane_pack",
+        "rows": n,
+        "world": world,
+        "sort_oracle_sorts": so.sort_count,
+        "sort_packed_sorts": sp.sort_count,
+        "sort_oracle_gb": round(so.sort_pass_bytes / 1e9, 4),
+        "sort_packed_gb": round(sp.sort_pass_bytes / 1e9, 4),
+        "sort_gb_reduction_pct": round(100 * sort_reduction, 1),
+        "join_oracle_coll_mb": round(jo.collective_bytes / 1e6, 3),
+        "join_packed_coll_mb": round(jp.collective_bytes / 1e6, 3),
+        "join_oracle_sort_gb": round(jo.sort_pass_bytes / 1e9, 4),
+        "join_packed_sort_gb": round(jp.sort_pass_bytes / 1e9, 4),
+        "sort_fusions": fused,
+        "wire_applied": wire_applied,
+        "packed_warm_s": round(tsp + tjp, 4),
+        "oracle_warm_s": round(tso + tjo, 4),
+    }
+    print(json.dumps(rec), flush=True)
+
+    import pandas.testing as pdt
+
+    pdt.assert_frame_equal(
+        res["sort_p"].to_pandas(), res["sort_o"].to_pandas()
+    )
+    keys = ["k1_x", "k2_x"]
+    pdt.assert_frame_equal(
+        res2["p"].to_pandas().sort_values(keys).reset_index(drop=True),
+        res2["o"].to_pandas().sort_values(keys).reset_index(drop=True),
+    )
+
+    if not smoke:
+        return 0
+    fail = []
+    if sp.sort_count >= so.sort_count:
+        fail.append(
+            f"packed sort ran {sp.sort_count} sorts, oracle {so.sort_count}"
+            " (must be strictly fewer)"
+        )
+    if sort_reduction < gate:
+        fail.append(
+            f"sort-pass bytes reduced {100 * sort_reduction:.1f}% "
+            f"(< gate {100 * gate:.0f}%)"
+        )
+    if jp.collective_bytes > jo.collective_bytes:
+        fail.append(
+            f"join collective bytes REGRESSED: {jo.collective_bytes} -> "
+            f"{jp.collective_bytes}"
+        )
+    if fused < 1:
+        fail.append("lane_pack.sort_fused never fired")
+    if world > 1 and wire_applied < 1:
+        fail.append("lane_pack.wire.applied never fired")
+    for f in fail:
+        print(f"LANE PACK GATE FAIL: {f}", file=sys.stderr)
+    if not fail:
+        print(
+            f"# lane-pack gate ok: {so.sort_count}->{sp.sort_count} sorts, "
+            f"-{100 * sort_reduction:.1f}% sort-pass bytes, coll MB "
+            f"{jo.collective_bytes / 1e6:.2f}->{jp.collective_bytes / 1e6:.2f}",
+            file=sys.stderr,
+        )
+    return 1 if fail else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=50_000)
+    ap.add_argument("--world", type=int, default=4,
+                    help="mesh size (virtual CPU devices); >1 exercises "
+                         "the wire-narrowed pair shuffle too")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate mode: exit 1 on sort-GB / coll-MB "
+                         "regression")
+    ap.add_argument("--gate", type=float,
+                    default=float(os.environ.get("LANE_PACK_GATE", 0.25)),
+                    help="minimum fractional sort-pass-byte reduction on "
+                         "the multikey sort shape")
+    args = ap.parse_args()
+    sys.exit(run(args.rows, args.world, args.smoke, args.gate))
+
+
+if __name__ == "__main__":
+    main()

@@ -414,6 +414,30 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     _roofline_recorded(ds_extra, hbm, s, dsort)
     record("dist_sort", s, c, n_rows, world, ds_extra)
 
+    # config 3b: the 3-key narrow-lane local sort (ISSUE 5 lane packing):
+    # the packed row vs the kill-switch row is the measured sort-word
+    # fusion win in the sort GB column (keys span ~12/~16/~20 bits ->
+    # pad + 3 value lanes fuse into ONE uint64 sort word;
+    # benchmarks/lane_pack_bench.py holds the CI gate)
+    from benchmarks.lane_pack_bench import make_sort_table
+    from cylon_tpu.ops import stats as _lp_gate
+
+    mt = make_sort_table(ct, ctx, np.random.default_rng(9), n_rows)
+
+    def msort():
+        out = mt.sort(["a", "b", "c"])
+        _sync(out)
+
+    s, c = _bench(msort, reps)
+    mp_extra = {}
+    _roofline_recorded(mp_extra, hbm, s, msort)
+    record("multikey_sort_packed", s, c, n_rows, world, mp_extra)
+    with _lp_gate.disabled():
+        s, c = _bench(msort, reps)
+        mn_extra = {}
+        _roofline_recorded(mn_extra, hbm, s, msort)
+        record("multikey_sort_nopack", s, c, n_rows, world, mn_extra)
+
     # config 4: set ops (shuffle on all columns + sorted dedup) — identical
     # schemas required, so pair ``left`` with a second (k, v) table
     left2, _ = make_tables(ct, ctx, n_rows, keyspace=n_rows, seed=1)

@@ -14,7 +14,7 @@ into a [cap, L] matrix, gathered by row index, and unpacked losslessly.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -135,6 +135,240 @@ def unpack_cols(plan, out_lanes, handle_passthrough, make_valid):
             v = make_valid(None)
         out.append((data, v))
     return out, pos
+
+
+# ----------------------------------------------------------------------
+# bit-width-adaptive WIRE codec (ops/stats.py range stats drive it)
+#
+# The plain lane codec above ships every value as full int32 lanes (and
+# every validity mask as a whole lane). For the shuffle exchange that
+# width is pure wire cost: a column whose measured range fits 12 bits
+# ships 12 bits, a validity mask ships 1 bit/row, a bool 1 bit — rebased
+# by a GLOBAL per-column base (both sides of the collective must agree,
+# so the base comes from host-folded global stats and rides the kernels
+# as a tiny replicated operand, never baked in as a recompiling
+# constant). Only BIT-LOSSLESS encodings participate (int families +
+# bool + dictionary codes; floats canonicalize -0.0/NaN and ride plain).
+# ----------------------------------------------------------------------
+
+class WireField(NamedTuple):
+    """One bit-field of the wire layout, in column-major field order.
+
+    ``kind``: 'enc' (stats-rebased orderable encoding), 'lane' (one plain
+    32-bit lane of an un-narrowed column), 'valid' (1-bit validity).
+    ``off``: for 'lane', the lane index within the column's plain codec
+    lanes. ``cls``: the encoding class of an 'enc' field."""
+
+    col: int
+    kind: str
+    off: int
+    bits: int
+    cls: str
+
+
+class WirePlan(NamedTuple):
+    """Static wire-narrowing plan: hashable (quantized widths only, no
+    data-dependent bounds), part of the pack/compact kernel cache keys.
+    ``plan`` is the logical :func:`lane_plan` it narrows."""
+
+    plan: tuple
+    fields: Tuple[WireField, ...]
+    n_words: int
+    n_plain: int
+
+
+def wire_plan(cols_plan, stats_list) -> Optional[WirePlan]:
+    """Build the wire layout for a column set.
+
+    ``stats_list``: per column ``(enc_class, field_bits)`` from measured
+    global range stats, or None (unknown). Columns with lossless narrow
+    encodings use 'enc' fields (bool needs no stats — it is statically 1
+    bit with base 0); everything else keeps its plain 32-bit lanes as
+    'lane' fields; f64 stays passthrough; every validity mask narrows to
+    a 1-bit field unconditionally. Returns None when there is nothing to
+    pack or packing does not strictly reduce the word count."""
+    from .stats import wire_narrowable
+
+    fields: List[WireField] = []
+    n_plain = 0
+    for ci, (tag, nl, has_valid) in enumerate(cols_plan):
+        if tag is not None:
+            n_plain += nl
+            st = stats_list[ci]
+            if tag == "bool":
+                fields.append(WireField(ci, "enc", 0, 1, "bool"))
+            elif st is not None and wire_narrowable(st[0]):
+                fields.append(WireField(ci, "enc", 0, int(st[1]), st[0]))
+            else:
+                for j in range(nl):
+                    fields.append(WireField(ci, "lane", j, 32, ""))
+        if has_valid:
+            n_plain += 1
+            fields.append(WireField(ci, "valid", 0, 1, ""))
+    if not fields:
+        return None
+    total = sum(f.bits for f in fields)
+    n_words = max(-(-total // 32), 1)
+    if n_words >= n_plain:
+        return None
+    return WirePlan(tuple(cols_plan), tuple(fields), n_words, n_plain)
+
+
+def static_wire_plan(cols: Sequence[KeyCol]) -> Optional[WirePlan]:
+    """Stats-free wire plan: only the STATIC narrowings (bool data and
+    validity masks to 1 bit/row) — no bases needed, safe inside a single
+    compiled program with no host stats step (the fused pipeline)."""
+    from .stats import enabled
+
+    if not enabled():
+        return None
+    plan = lane_plan(cols)
+    return wire_plan(plan, [None] * len(plan))
+
+
+def wire_row_bytes(wplan: WirePlan) -> int:
+    """Bytes one row occupies in a wire-narrowed exchange buffer: 4 per
+    packed word + 8 per f64 passthrough column (the narrowed counterpart
+    of :func:`cylon_tpu.parallel.shuffle.exchange_row_bytes`)."""
+    total = 4 * wplan.n_words
+    total += sum(8 for tag, _nl, _hv in wplan.plan if tag is None)
+    return max(total, 1)
+
+
+def wire_bases(wplan: WirePlan, stats_by_col: dict) -> np.ndarray:
+    """[n_enc, 2] uint32 (hi, lo) base words for the plan's 'enc' fields,
+    in field order — the tiny replicated operand both the pack and the
+    compact kernel rebase with. 'bool' fields (and absent stats) use
+    base 0."""
+    rows = []
+    for f in wplan.fields:
+        if f.kind != "enc":
+            continue
+        st = stats_by_col.get(f.col)
+        lo = 0 if (f.cls == "bool" or st is None) else int(st.lo)
+        rows.append(((lo >> 32) & 0xFFFFFFFF, lo & 0xFFFFFFFF))
+    return np.asarray(rows, np.uint32).reshape(-1, 2)
+
+
+def _enc_base(bases: Optional[jax.Array], ei: int, wide: bool):
+    """Base scalar for 'enc' field ``ei``: uint64 when the field's
+    encoding is 64-bit, else uint32. ``bases=None`` means every enc field
+    is static-base-0 (the stats-free plan)."""
+    if bases is None:
+        return jnp.uint64(0) if wide else jnp.uint32(0)
+    hi = bases[ei, 0]
+    lo = bases[ei, 1]
+    if wide:
+        return (hi.astype(jnp.uint64) << jnp.uint64(32)) | lo.astype(
+            jnp.uint64
+        )
+    return lo
+
+
+def wire_pack_cols(
+    cols: Sequence[KeyCol], wplan: WirePlan, bases: Optional[jax.Array]
+):
+    """Encode every column into the plan's bit-packed word lanes.
+
+    Returns (word lanes [cap] int32 each, passthrough {col -> f64 data}).
+    'enc' fields clamp to their width: live values always fit when the
+    stats were sound bounds (masked values were measured too — they ride
+    the wire like any payload), and unwritten buffer slots never ship
+    live rows, so the clamp is a corruption firewall, not a data path."""
+    from .stats import assemble_words, encode_enc, layout_words
+
+    field_vals: List[jax.Array] = []
+    bits_list: List[int] = []
+    passthrough: Dict[int, jax.Array] = {}
+    ei = 0
+    for f in wplan.fields:
+        data, valid = cols[f.col]
+        if f.kind == "enc":
+            enc = encode_enc(data, f.cls)
+            wide = enc.dtype == jnp.uint64
+            base = _enc_base(bases, ei, wide)
+            ei += 1
+            if f.bits == 0:
+                v = jnp.zeros(data.shape, jnp.uint32)
+            else:
+                from .stats import mask_of
+
+                maxf = mask_of(min(f.bits, 64 if wide else 32), enc.dtype)
+                v = jnp.minimum(enc - base, maxf)
+        elif f.kind == "lane":
+            lane = _to_lanes(data)[0][f.off]
+            v = jax.lax.bitcast_convert_type(lane, jnp.uint32)
+        else:  # valid
+            v = valid.astype(jnp.uint32)
+        field_vals.append(v)
+        bits_list.append(f.bits)
+    for ci, (tag, _nl, _hv) in enumerate(wplan.plan):
+        if tag is None:
+            passthrough[ci] = cols[ci][0]
+    words = assemble_words(field_vals, layout_words(bits_list, False))
+    return [
+        jax.lax.bitcast_convert_type(w, jnp.int32) for w in words
+    ], passthrough
+
+
+def wire_unpack_cols(
+    word_lanes: Sequence[jax.Array],
+    wplan: WirePlan,
+    bases: Optional[jax.Array],
+    handle_passthrough,
+    make_valid,
+):
+    """Decode :func:`wire_pack_cols` word lanes back into columns —
+    the wire counterpart of :func:`unpack_cols` (same callback contract)."""
+    from .stats import decode_enc, extract_fields, layout_words
+
+    bits_list = [f.bits for f in wplan.fields]
+    words = [
+        jax.lax.bitcast_convert_type(w, jnp.uint32) for w in word_lanes
+    ]
+    vals = extract_fields(words, layout_words(bits_list, False), bits_list)
+    # regroup fields by column (fields are column-major by construction),
+    # carrying each enc field's POSITIONAL base-slot index
+    per_col: Dict[int, list] = {}
+    ei = 0
+    for f, v in zip(wplan.fields, vals):
+        slot = -1
+        if f.kind == "enc":
+            slot = ei
+            ei += 1
+        per_col.setdefault(f.col, []).append((f, v, slot))
+    out: List[KeyCol] = []
+    for ci, (tag, nl, has_valid) in enumerate(wplan.plan):
+        entries = per_col.get(ci, [])
+        data = None
+        vlane = None
+        lane_frags: List[jax.Array] = []
+        for f, v, slot in entries:
+            if f.kind == "enc":
+                # widen by CLASS, not by field width: a 64-bit column whose
+                # measured span fits 32 bits extracts a uint32 field but
+                # still rebases against a full 64-bit base
+                from .stats import is64
+
+                wide = is64(f.cls)
+                base = _enc_base(bases, slot, wide)
+                if wide:
+                    v = v.astype(jnp.uint64)
+                data = decode_enc(v + base, f.cls, np.dtype(tag))
+            elif f.kind == "lane":
+                lane_frags.append(
+                    jax.lax.bitcast_convert_type(
+                        v.astype(jnp.uint32), jnp.int32
+                    )
+                )
+            else:
+                vlane = v.astype(jnp.int32)
+        if tag is None:
+            data = handle_passthrough(ci)
+        elif data is None:
+            data = _from_lanes(lane_frags, tag)
+        out.append((data, make_valid(vlane) if has_valid else make_valid(None)))
+    return out
 
 
 def pack_gather(

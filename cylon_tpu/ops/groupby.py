@@ -46,10 +46,14 @@ def agg_op_id(name) -> int:
 
 
 def group_ids(
-    key_cols: Sequence[KeyCol], n: jax.Array, cap: int
+    key_cols: Sequence[KeyCol], n: jax.Array, cap: int, fuse=None
 ) -> Tuple[jax.Array, jax.Array]:
-    """(ids [cap] int32 with padding -> cap, num_groups scalar)."""
-    return factorize(key_cols, n, cap)
+    """(ids [cap] int32 with padding -> cap, num_groups scalar).
+
+    ``fuse``: stats-driven sort-word fusion plan for the factorize lanes
+    (ops/sort.FusePlan; Table.groupby derives it from the key columns'
+    range stats) — identical ids in fewer chained sort passes."""
+    return factorize(key_cols, n, cap, fuse=fuse)
 
 
 def sorted_group_ids(

@@ -280,9 +280,14 @@ def _canonical_ids(
     nr: jax.Array,
     cap_l: int,
     cap_r: int,
+    fuse=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Canonical comparable key ids for both tables, one integer dtype,
-    padding rows holding a value that sorts >= every live id."""
+    padding rows holding a value that sorts >= every live id.
+
+    ``fuse``: stats-driven sort-word fusion plan for the factorize lanes
+    (Table.join derives it from both sides' merged range stats); the
+    single-uint32-key fast path is already one lane and ignores it."""
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     idx_r = jnp.arange(cap_r, dtype=jnp.int32)
     # promote key dtypes to a common type first: orderable_key lanes are only
@@ -312,7 +317,7 @@ def _canonical_ids(
         r_ids = jnp.where(idx_r < nr, rk, MAXU)
     else:
         l_ids, r_ids, _ = factorize_two(
-            l_key_cols, r_key_cols, nl, nr, cap_l, cap_r
+            l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, fuse=fuse
         )
         big = jnp.int32(cap_l + cap_r)  # sorts after every live dense id
         l_ids = jnp.where(idx_l < nl, l_ids, big)
@@ -328,9 +333,10 @@ def _probe(
     cap_l: int,
     cap_r: int,
     need_rcnt: bool = True,
+    fuse=None,
 ) -> _Probe:
     l_ids, r_ids = _canonical_ids(
-        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, fuse=fuse
     )
     r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
     lo, cnt, r_cnt = _merged_counts(
@@ -341,7 +347,7 @@ def _probe(
 
 def probe_arrays(
     l_key_cols, r_key_cols, nl, nr, cap_l: int, cap_r: int,
-    how: int = FULL_OUTER, r_presorted: bool = False,
+    how: int = FULL_OUTER, r_presorted: bool = False, key_fuse=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Phase-1 kernel surface: returns the static-shaped probe state
     (lo, cnt, r_order, r_cnt) so the emit phase need not recompute the sorts.
@@ -354,7 +360,7 @@ def probe_arrays(
     the sorted-run-reuse fast path."""
     if r_presorted:
         l_ids, r_ids = _canonical_ids(
-            l_key_cols, r_key_cols, nl, nr, cap_l, cap_r
+            l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, fuse=key_fuse
         )
         r_order = jnp.arange(cap_r, dtype=jnp.int32)
         lo, cnt, r_cnt = _merged_counts(
@@ -364,7 +370,7 @@ def probe_arrays(
         return (lo, cnt, r_order, r_cnt)
     p = _probe(
         l_key_cols, r_key_cols, nl, nr, cap_l, cap_r,
-        need_rcnt=how in (RIGHT, FULL_OUTER),
+        need_rcnt=how in (RIGHT, FULL_OUTER), fuse=key_fuse,
     )
     return (p.lo, p.cnt, p.r_order, p.r_cnt)
 
@@ -727,9 +733,13 @@ def spec_join(
     emit_impl: str = "gather",
     r_presorted: bool = False,
     emit_key_order: bool = False,
+    key_fuse=None,
 ) -> Tuple[list, jax.Array, jax.Array]:
     """Single-dispatch speculative join: probe + count + emit + gather in one
     program with the minimal pass count.
+
+    ``key_fuse``: stats-driven sort-word fusion plan for the multi-key /
+    masked factorize lanes (see _canonical_ids).
 
     On the INNER/LEFT path the right payload RIDES the key sort — one stable
     multi-operand ``lax.sort`` keyed by the canonical right ids yields the
@@ -754,7 +764,9 @@ def spec_join(
     cap_r = r_key_cols[0][0].shape[0]
     need_rcnt = how in (RIGHT, FULL_OUTER)
     emit_key_order = emit_key_order and how in (INNER, LEFT)
-    l_ids, r_ids = _canonical_ids(l_key_cols, r_key_cols, nl, nr, cap_l, cap_r)
+    l_ids, r_ids = _canonical_ids(
+        l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, fuse=key_fuse
+    )
     if how in (INNER, LEFT):
         # <=32-bit right columns ride the key sort as payload operands; any
         # 64-bit columns are gathered by the carried order through the int32

@@ -151,29 +151,31 @@ def hash_class(np_dtype) -> Optional[str]:
 
 
 def range_class(np_dtype) -> Optional[str]:
-    """Monotone-uint32 encoding family used by the range words, or None when
-    the dtype has no sound 32-bit monotone lane (float64's orderable lane is
-    a float). Both sides of a pair must share the EXACT class: equal values
-    of different widths/signedness encode differently."""
-    dt = np.dtype(np_dtype)
-    if dt == np.bool_:
-        return "bool"
-    if dt == np.float64:
-        return None
-    if np.issubdtype(dt, np.floating):
-        return "f32"
-    if np.issubdtype(dt, np.signedinteger):
-        return "i64hi" if dt.itemsize == 8 else "i32"
-    if np.issubdtype(dt, np.unsignedinteger):
-        return "u64hi" if dt.itemsize == 8 else "u32"
-    return None
+    """Monotone-uint32 encoding family used by the range words, or None
+    when the dtype has no sound 32-bit monotone lane (float64's orderable
+    lane is a float). Both sides of a pair must share the EXACT class:
+    equal values of different widths/signedness encode differently.
+
+    The classifier is SHARED with the lane-packing stats facility
+    (:func:`cylon_tpu.ops.stats.enc_class`) so range gating and sort-word
+    fusion / wire narrowing can never disagree on an encoding family; the
+    64-bit families get a distinct ``...hi`` name here because the range
+    lane coarsens them to the orderable hi word."""
+    from .stats import enc_class
+
+    cls = enc_class(np_dtype)
+    if cls in ("i64", "u64"):
+        return cls + "hi"
+    return cls
 
 
 def _range_enc(key: KeyCol) -> jax.Array:
     """Monotone uint32 encoding of the FIRST key column (range_class must be
-    non-None). 64-bit integers coarsen to the orderable hi word — a
-    non-strict monotone map, so range pruning stays sound. Nulls encode as
-    the nulls-last sentinel on BOTH sides (null == null — module doc)."""
+    non-None). The value encoding is the shared orderable family
+    (ops/stats.encode_enc == ops/sort.orderable_key); 64-bit integers
+    coarsen to the orderable hi word — a non-strict monotone map, so range
+    pruning stays sound. Nulls encode as the nulls-last sentinel on BOTH
+    sides (null == null — module doc)."""
     data, valid = key
     enc = orderable_key(data)
     if enc.dtype == jnp.uint64:

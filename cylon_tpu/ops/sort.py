@@ -12,7 +12,7 @@ live < null < padding ordering so padding can never interleave with data.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -178,6 +178,150 @@ def lexsort_indices(lanes: Sequence[jax.Array], cap: int) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# bit-width-adaptive sort-word fusion (ops/stats.py range stats drive it)
+#
+# Every chained pass streams one lane; a 12-bit dictionary code, a 16-bit
+# int key and a 1-bit null flag each occupy a full word today. The fusion
+# planner bit-packs multiple narrow orderable_key lanes (rebased by their
+# in-kernel minimum — the range STATS only fix the static field widths,
+# so data drift never corrupts, it just recompiles on a quantized-bits
+# change) into the fewest physical sort words. Order-preserving by
+# construction: orderable encodings are monotone, rebasing by a uniform
+# per-column scalar preserves order, and msb-first field concatenation
+# makes word-lexicographic order equal lane-lexicographic order.
+# ---------------------------------------------------------------------------
+
+class FusePlan(NamedTuple):
+    """Static sort-word fusion plan — part of every consuming kernel's
+    cache key (hashable; carries QUANTIZED widths, never raw bounds).
+
+    ``fields``: msb-first ``(kind, key_pos, bits, ascending)`` with kind in
+    {'pad', 'prefix', 'null', 'value'}. ``allow64``: whether the layout
+    may use one uint64 word (only when the WHOLE plan fits a single word —
+    a 64-bit word may be a sort KEY but must never ride another pass as a
+    variadic-sort operand, which the TPU X64 rewriter has no audited
+    lowering for). ``n_words`` / ``n_plain``: fused vs unfused lane
+    counts (the gate: fusion engages only when strictly fewer)."""
+
+    fields: Tuple[Tuple[str, int, int, bool], ...]
+    allow64: bool
+    n_words: int
+    n_plain: int
+
+
+def plan_lane_fusion(
+    key_specs: Sequence[Optional[Tuple[str, int, bool, bool]]],
+    pad_bits: int,
+    prefix_bits: int,
+    allow64: bool,
+) -> Optional["FusePlan"]:
+    """Build a :class:`FusePlan` for key columns with measured range stats.
+
+    ``key_specs``: per key ``(enc_class, field_bits, has_valid, ascending)``
+    or None when the key has no usable stats (unknown range, f64, 64-bit
+    without X64). ``pad_bits``: width of the most-significant padding/live
+    class field (2 for the lexsort row-class, 1 for the canonical live
+    flag). ``prefix_bits``: width of the sorted-run-reuse prefix lane (0 =
+    absent). Returns None when any key is unplannable, when a float key
+    sorts DESCENDING (the unpacked path pins NaN last in both directions;
+    a rebased descending float field cannot), or when fusion would not
+    strictly reduce the pass count.
+    """
+    from .stats import layout_words
+
+    if any(s is None for s in key_specs) or not key_specs:
+        return None
+    fields: list = [("pad", -1, pad_bits, True)]
+    if prefix_bits:
+        fields.append(("prefix", -1, prefix_bits, True))
+    n_plain = 1 + (1 if prefix_bits else 0)
+    for pos, (cls, bits, has_valid, asc) in enumerate(key_specs):
+        if cls == "f32" and not asc:
+            return None  # NaN-last pinning has no rebased-field encoding
+        if bits > 32 and not allow64:
+            return None
+        if has_valid:
+            fields.append(("null", pos, 1, True))
+            n_plain += 1
+        fields.append(("value", pos, bits, bool(asc)))
+        n_plain += 1
+    bits_list = [b for _k, _p, b, _a in fields]
+    # a 64-bit word is legal only as THE single sort word (key-only, never
+    # a variadic operand of another pass) — see FusePlan docstring
+    layout = layout_words(bits_list, allow64)
+    use64 = allow64 and len(layout) == 1
+    if not use64:
+        layout = layout_words(bits_list, False)
+    n_words = len(layout)
+    if n_words >= n_plain:
+        return None
+    return FusePlan(tuple(fields), use64, n_words, n_plain)
+
+
+def fused_key_words(
+    plan: "FusePlan",
+    key_cols: Sequence[KeyCol],
+    live: jax.Array,
+    nulls_last: bool = True,
+    prefix_lane: Optional[jax.Array] = None,
+    zero_null_values: bool = False,
+) -> list:
+    """The fused sort words (msb-first) for one plan.
+
+    Each value field is the key's orderable encoding REBASED by its
+    in-kernel live-row minimum and clamped to the field width: stats only
+    chose the static width, so live values always fit whenever the stats
+    were sound bounds, and padding-row garbage clamps instead of
+    corrupting neighboring fields (padding order is don't-care — the pad
+    field dominates). Null-masked rows' PAYLOAD values are measured into
+    the stats too, so with ``zero_null_values=False`` (lexsort semantics:
+    null rows order by their masked payload) the field is exact;
+    ``zero_null_values=True`` reproduces canonical_row_lanes' zeroed
+    value-under-null (null == null runs)."""
+    fields = []
+    bits_list = []
+    for kind, pos, bits, asc in plan.fields:
+        if kind == "pad":
+            v = jnp.where(
+                live, jnp.uint32(0), np.uint32((1 << bits) - 1)
+            )
+        elif kind == "prefix":
+            v = jnp.clip(
+                prefix_lane, 0, (1 << bits) - 1
+            ).astype(jnp.uint32)
+        elif kind == "null":
+            _data, valid = key_cols[pos]
+            flag = ~valid if nulls_last else valid
+            v = flag.astype(jnp.uint32)
+        else:  # value
+            data, valid = key_cols[pos]
+            enc = orderable_key(data)
+            fdt = enc.dtype
+            if bits == 0:
+                v = jnp.zeros(data.shape, jnp.uint32)
+            else:
+                from .stats import mask_of
+
+                wide = fdt == jnp.uint64
+                maxf = mask_of(min(bits, 64 if wide else 32), fdt)
+                enc_max = mask_of(64 if wide else 32, fdt)
+                if asc:
+                    base = jnp.min(jnp.where(live, enc, enc_max))
+                    v = jnp.minimum(enc - base, maxf)
+                else:
+                    zero = np.uint64(0) if wide else np.uint32(0)
+                    top = jnp.max(jnp.where(live, enc, zero))
+                    v = jnp.minimum(top - enc, maxf)
+            if zero_null_values and valid is not None:
+                v = jnp.where(valid, v, jnp.zeros_like(v))
+        fields.append(v)
+        bits_list.append(bits)
+    from .stats import assemble_words, layout_words
+
+    return assemble_words(fields, layout_words(bits_list, plan.allow64))
+
+
+# ---------------------------------------------------------------------------
 # run (equal-key segment) scans over a sorted order — shared by the join
 # probe (ops/join._merged_counts) and the set algebra (ops/setops): ONE
 # implementation of the subtle prefix-scan idioms.
@@ -212,14 +356,24 @@ def run_count_from(new_run: jax.Array, flag: jax.Array) -> jax.Array:
 
 
 def canonical_row_lanes(
-    cols: Sequence[KeyCol], live: jax.Array
+    cols: Sequence[KeyCol], live: jax.Array, fuse: Optional["FusePlan"] = None
 ) -> list:
     """Canonical key lanes for one combined row ordering, most significant
     first: [padding-last class, per column: (null lane, value lane)].
 
     Value lanes are zeroed under null so that a run of nulls is ONE run
     regardless of the masked payload (rows_differ semantics: null == null).
-    Shared by the set algebra and factorize."""
+    Shared by the set algebra and factorize.
+
+    ``fuse``: a stats-driven :class:`FusePlan` (pad_bits=1 — the live
+    flag) bit-packs the whole lane stack into fewer physical words; sorted
+    ORDER and run boundaries of live rows are identical by construction
+    (monotone rebased fields, value zeroed under null), so factorize ids
+    come out exactly equal to the unfused path's."""
+    if fuse is not None:
+        return fused_key_words(
+            fuse, cols, live, nulls_last=True, zero_null_values=True
+        )
     lanes: list = [(~live).astype(jnp.uint8)]
     for data, valid in cols:
         vlane = orderable_key(data)
@@ -339,6 +493,7 @@ def lexsort_rows_payload(
     ascending: Optional[Sequence[bool]] = None,
     nulls_last: bool = True,
     prefix_lane: Optional[jax.Array] = None,
+    fuse: Optional["FusePlan"] = None,
 ) -> Tuple[jax.Array, list]:
     """:func:`lexsort_rows` with ``payloads`` riding the sort passes.
 
@@ -352,9 +507,29 @@ def lexsort_rows_payload(
     rows are ALREADY ordered by a key prefix passes the prefix's run ids
     (:func:`prefix_run_lane`) here and supplies only the suffix keys,
     replacing one chained pass per elided prefix lane.
+
+    ``fuse``: a stats-driven :class:`FusePlan` over exactly
+    (pad_bits=2, prefix, key_cols in order) — the whole lane stack
+    bit-packs into ``fuse.n_words`` physical sort words, so an N-lane
+    chained lexsort runs as n_words passes. The resulting permutation is
+    identical on live rows (null rows still order by their masked payload
+    — the stats measured those values too); only the don't-care padding
+    permutation may differ.
     """
     if ascending is None:
         ascending = [True] * len(key_cols)
+    if fuse is not None:
+        words = fused_key_words(
+            fuse, list(key_cols),
+            jnp.arange(cap, dtype=jnp.int32) < n,
+            nulls_last=nulls_last, prefix_lane=prefix_lane,
+        )
+        lanes = list(reversed(words))  # least-significant first
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        _, pays = lexsort_with_payload(
+            lanes, list(payloads) + [iota], keep_lanes=False
+        )
+        return pays[-1], pays[:-1]
     lanes = []  # least-significant first (lexsort convention)
     pad = row_class(n, cap, None)
     for (data, valid), asc in zip(

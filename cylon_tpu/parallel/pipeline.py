@@ -57,7 +57,16 @@ def _shuffle_rounds(
     lives ONCE here. The per-round receive counts ride the payload
     collective's header lanes (shuffle.exchange_columns_fused), so each
     round is ONE all_to_all instead of a count exchange + a payload
-    exchange — half the collectives per fused shuffle."""
+    exchange — half the collectives per fused shuffle.
+
+    Wire narrowing: a fully fused program has no host stats step, so only
+    the STATIC narrowings engage here — validity masks and bool data pack
+    to 1 bit/row (gather.static_wire_plan); value lanes ride full width.
+    The eager chunked engine (table._shuffle_many) does the stats-driven
+    narrowing."""
+    from ..ops.gather import static_wire_plan
+
+    wire = static_wire_plan(st.cols)
     rounds = 1 + respill
     parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
     masks = []
@@ -67,7 +76,7 @@ def _shuffle_rounds(
         dest, leftover = dest_fn(r)
         got, recv_counts = _sh.exchange_columns_fused(
             st.cols, dest, _sh.round_counts(cnt, bucket_cap, r),
-            world, bucket_cap, axis_name,
+            world, bucket_cap, axis_name, wire=wire,
         )
         for ci, dv in enumerate(got):
             parts[ci].append(dv)

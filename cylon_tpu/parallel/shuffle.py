@@ -29,7 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.gather import lane_plan, pack_cols, pack_gather, unpack_cols
+from ..ops.gather import (
+    lane_plan,
+    pack_cols,
+    pack_gather,
+    unpack_cols,
+    wire_pack_cols,
+    wire_unpack_cols,
+)
 
 Cols = Sequence[Tuple[jax.Array, Optional[jax.Array]]]
 
@@ -413,6 +420,8 @@ def exchange_columns_fused(
     num_partitions: int,
     bucket_cap: int,
     axis_name: str,
+    wire=None,
+    bases: Optional[jax.Array] = None,
 ) -> Tuple[List[Tuple[jax.Array, Optional[jax.Array]]], jax.Array]:
     """:func:`exchange_columns` with the COUNT EXCHANGE FUSED into the
     payload collective: the per-destination round send counts ride the
@@ -420,11 +429,21 @@ def exchange_columns_fused(
     table AND the counts (vs a dedicated count collective per round — this
     is what takes a distributed join from 4 collectives to 2).
 
+    ``wire``: an optional :class:`~cylon_tpu.ops.gather.WirePlan` — the
+    exchanged lanes are then the plan's bit-packed words (validity masks
+    at 1 bit/row, values at their measured width) instead of full int32
+    lanes; ``bases`` carries the global rebase words (None = every
+    narrowed field is static-base, the stats-free plan).
+
     Returns (received cols, recv_counts [P]). Tables with no int32 lanes at
     all (pure f64, no validity masks) fall back to a dedicated tiny count
     exchange — there is no lane buffer for the header to ride.
     """
-    plan, lanes, passthrough = pack_cols(cols)
+    if wire is not None:
+        lanes, passthrough = wire_pack_cols(cols, wire, bases)
+        plan = list(wire.plan)
+    else:
+        plan, lanes, passthrough = pack_cols(cols)
     out_lanes: List[jax.Array] = []
     if lanes:
         buf = pack_lane_buffer(lanes, dest, counts_round, num_partitions, bucket_cap)
@@ -433,14 +452,19 @@ def exchange_columns_fused(
         out_lanes = [data[:, j] for j in range(data.shape[1])]
     else:
         recv_counts = exchange_counts(counts_round, axis_name)
-    out, _ = unpack_cols(
-        plan,
-        out_lanes,
-        lambda ci: exchange_column(
+
+    def handle_pt(ci):
+        return exchange_column(
             passthrough[ci], dest, num_partitions, bucket_cap, axis_name
-        ),
-        lambda lane: None if lane is None else lane.astype(jnp.bool_),
-    )
+        )
+
+    def make_valid(lane):
+        return None if lane is None else lane.astype(jnp.bool_)
+
+    if wire is not None:
+        out = wire_unpack_cols(out_lanes, wire, bases, handle_pt, make_valid)
+    else:
+        out, _ = unpack_cols(plan, out_lanes, handle_pt, make_valid)
     return out, recv_counts
 
 
@@ -478,6 +502,30 @@ def compact_received_lanes(
         lambda lane: None if lane is None else lane.astype(jnp.bool_),
     )
     return out
+
+
+def compact_received_wire(
+    wire,
+    bases: Optional[jax.Array],
+    lane_rows: jax.Array,
+    pt_cols: dict,
+    mask: jax.Array,
+) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
+    """:func:`compact_received_lanes` for a wire-narrowed exchange: the
+    received rows ARE packed words, so the liveness sort + gather runs on
+    the narrow [rows, n_words] matrix and the bit-unpack happens once, on
+    the compacted rows."""
+    order = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    g = lane_rows[order]
+    word_lanes = [g[:, j] for j in range(g.shape[1])]
+    sorted_pt = {ci: d[order] for ci, d in pt_cols.items()}
+    return wire_unpack_cols(
+        word_lanes,
+        wire,
+        bases,
+        lambda ci: sorted_pt[ci],
+        lambda lane: None if lane is None else lane.astype(jnp.bool_),
+    )
 
 
 def compact_received(

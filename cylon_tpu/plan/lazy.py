@@ -185,14 +185,17 @@ class LazyFrame:
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
         from ..ops.sketch import enabled as _semi_enabled
+        from ..ops.stats import enabled as _pack_enabled
         from ..ordering import enabled as _ord_enabled
 
-        # the ordering and semi-filter escape hatches change which rewrites
-        # fire, so both are part of the executable's identity — a
-        # mid-process env flip must re-optimize, never reuse a cached
-        # executor built under the other gate state
+        # the ordering, semi-filter and lane-packing escape hatches change
+        # which rewrites fire / which kernels the lowered ops pick, so all
+        # three are part of the executable's identity — a mid-process env
+        # flip must re-optimize, never reuse a cached executor built under
+        # the other gate state
         fingerprint = (
-            self._plan.fingerprint(), _ord_enabled(), _semi_enabled()
+            self._plan.fingerprint(), _ord_enabled(), _semi_enabled(),
+            _pack_enabled(),
         )
 
         def compile_plan():

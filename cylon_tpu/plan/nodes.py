@@ -64,6 +64,16 @@ class Node:
         consumes it, and ``.explain()`` prints it per node."""
         return None
 
+    def col_stats(self) -> Dict[str, object]:
+        """Known column range stats (ops/stats.ColStat) of this node's
+        output, derived like partitioning/ordering: Scans read their
+        table's measured bounds, row-subset/rename nodes carry them
+        (bounds are conservative over any subset), value-rewriting nodes
+        drop them. Advisory only — the eager kernels re-derive their own
+        packing gates from live tables; ``.explain()`` prints the
+        quantized widths per node."""
+        return {}
+
     def _params(self) -> tuple:
         """Node-local fingerprint parameters (no children, no schema —
         schema is derived and scans carry theirs explicitly)."""
@@ -85,6 +95,14 @@ class Node:
         o = self.ordering()
         if o is not None:
             line += f"  -- order: {o.describe()}"
+        stats = self.col_stats()
+        if stats:
+            from ..ops.stats import field_bits
+
+            widths = ", ".join(
+                f"{n}:{field_bits(v)}b" for n, v in sorted(stats.items())
+            )
+            line += f"  -- stats: {widths}"
         lines = [line]
         for c in self.children:
             lines.append(c.render(indent + 1))
@@ -102,8 +120,10 @@ class Scan(Node):
         # (lower.detach_scans); live Scans read it from the table at USE
         # time below — an in-place mutation (__setitem__) clears the
         # table's descriptor, and a capture here would let the order_reuse
-        # rewrite act on the stale claim
+        # rewrite act on the stale claim. Range stats follow the same
+        # live-read / frozen-stub discipline.
         self.table_ordering: Optional[Ordering] = None
+        self.table_stats: Dict[str, object] = {}
         self.schema = tuple(
             (n, int(table._columns[n].dtype.type), str(table._columns[n].data.dtype))
             for n in table.column_names
@@ -117,6 +137,11 @@ class Scan(Node):
         if self.table is None:  # detached stub
             return self.table_ordering
         return self.table._ordering
+
+    def col_stats(self) -> Dict[str, object]:
+        if self.table is None:  # detached stub
+            return dict(self.table_stats)
+        return dict(self.table._stats)
 
     def _params(self) -> tuple:
         # the ordering descriptor is part of the plan identity: a cached
@@ -153,6 +178,13 @@ class Project(Node):
     def ordering(self) -> Optional[Ordering]:
         return _ord.truncate_to(self.children[0].ordering(), self.cols)
 
+    def col_stats(self) -> Dict[str, object]:
+        kept = set(self.cols)
+        return {
+            n: v for n, v in self.children[0].col_stats().items()
+            if n in kept
+        }
+
     def _params(self) -> tuple:
         return (self.cols,)
 
@@ -177,6 +209,10 @@ class Filter(Node):
 
     def ordering(self) -> Optional[Ordering]:
         return self.children[0].ordering()  # row subset keeps row order
+
+    def col_stats(self) -> Dict[str, object]:
+        # a row subset only shrinks ranges: the bounds stay conservative
+        return self.children[0].col_stats()
 
     def _params(self) -> tuple:
         return (self.expr.key(),)
@@ -275,6 +311,17 @@ class Join(Node):
             return _ord.rename(self.children[0].ordering(), self.l_rename)
         return None
 
+    def col_stats(self) -> Dict[str, object]:
+        # every output VALUE comes from an input row (outer rows add
+        # nulls, not values), so each side's bounds survive under the
+        # join's output names
+        out: Dict[str, object] = {}
+        for n, v in self.children[0].col_stats().items():
+            out[self.l_rename.get(n, n)] = v
+        for n, v in self.children[1].col_stats().items():
+            out[self.r_rename.get(n, n)] = v
+        return out
+
     def _params(self) -> tuple:
         # semi_filter is part of the plan identity: a cached executor that
         # lowers the filtered pair exchange must not serve an annotation-
@@ -332,6 +379,13 @@ class GroupBy(Node):
             lexsort_exact=False,
         )
 
+    def col_stats(self) -> Dict[str, object]:
+        kept = set(self.keys)
+        return {
+            n: v for n, v in self.children[0].col_stats().items()
+            if n in kept
+        }
+
     def _params(self) -> tuple:
         return (self.keys, self.aggs, self.sorted_input)
 
@@ -375,6 +429,9 @@ class Sort(Node):
             scope=scope, canonical=False, lexsort_exact=True,
         )
 
+    def col_stats(self) -> Dict[str, object]:
+        return self.children[0].col_stats()  # a permutation of the rows
+
     def _params(self) -> tuple:
         return (self.by, self.ascending)
 
@@ -402,6 +459,9 @@ class Shuffle(Node):
         if self.kind == "hash":
             return [self.keys]
         return []  # range partitions co-locate ranges, not equal tuples
+
+    def col_stats(self) -> Dict[str, object]:
+        return self.children[0].col_stats()  # rows reroute, values don't
 
     def _params(self) -> tuple:
         return (self.keys, self.kind, self.asc0)

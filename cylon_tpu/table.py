@@ -42,6 +42,7 @@ from .ops import setops as _s
 from .ops import gather as _g_pack
 from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
+from .ops import stats as _st
 from .parallel import shuffle as _sh
 from .utils.tracing import bump, gauge, span
 
@@ -172,6 +173,12 @@ class Table:
         # the conservative default, so a missed propagation is only a
         # missed optimization
         self._ordering = _ord.validate(ordering, columns.keys())
+        # column range stats (ops/stats.py): name -> ColStat bounds of the
+        # orderable encoding over live rows. Same conservative default as
+        # ordering: empty unless a kernel that touched the data attached
+        # bounds (shuffle count pass, ensure_stats) — a missed propagation
+        # only costs a lane-packing opportunity, never correctness
+        self._stats: Dict[str, "_st.ColStat"] = {}
         # pandas-style index: None == RangeIndex; else the named column is
         # the index (reference Set_Index/ResetIndex, table.hpp + indexing/)
         self.index_name = index_name if index_name in (columns.keys() | {None}) else None
@@ -235,6 +242,116 @@ class Table:
         ):
             self._ordering = ordering
         return self
+
+    @property
+    def column_stats(self) -> Dict[str, "_st.ColStat"]:
+        """The table's known column range stats (ops/stats.py): name ->
+        conservative [lo, hi] bounds of the column's orderable encoding
+        over live rows. May be empty — use :meth:`ensure_stats` to
+        measure on demand."""
+        return dict(self._stats)
+
+    def _attach_stats(
+        self, stats: Optional[Dict[str, "_st.ColStat"]],
+        rename: Optional[Dict[str, str]] = None,
+    ) -> "Table":
+        """Internal propagation: carry conservative range bounds onto this
+        table for every column that still exists with the same encoding
+        class (row-subset/permutation/rename ops — bounds stay sound).
+        Never raises; a lapsed entry is silently dropped."""
+        if not stats:
+            return self
+        out = {}
+        for name, stat in stats.items():
+            if stat is None:
+                continue
+            name = (rename or {}).get(name, name)
+            col = self._columns.get(name)
+            if col is None:
+                continue
+            if _st.enc_class(col.data.dtype) != stat.cls:
+                continue
+            out[name] = stat
+        if out:
+            self._stats = {**self._stats, **out}
+        return self
+
+    def _fusion_specs(
+        self, names: Sequence[str], ascending: Optional[Sequence[bool]] = None
+    ) -> Optional[list]:
+        """Per-key ``(enc_class, field_bits, has_valid, ascending)`` specs
+        for :func:`cylon_tpu.ops.sort.plan_lane_fusion`, or None when any
+        key lacks measurable stats — the ONE copy of the
+        ensure_stats -> spec sequence shared by sort and groupby (the join
+        builds its own from the pair's MERGED stats)."""
+        stats = self.ensure_stats(names)
+        specs = []
+        for i, kn in enumerate(names):
+            stat = stats.get(kn)
+            if stat is None:
+                return None
+            specs.append((
+                stat.cls, _st.field_bits(stat),
+                self._columns[kn].valid is not None,
+                bool(ascending[i]) if ascending is not None else True,
+            ))
+        return specs or None
+
+    def ensure_stats(
+        self, names: Sequence[str]
+    ) -> Dict[str, Optional["_st.ColStat"]]:
+        """Column range stats for ``names``, measured on demand and cached
+        on this table (the ``Ordering``-style descriptor lifecycle: cleared
+        by in-place mutation, absent on fresh handles). Columns with no
+        packable encoding (f64, 64-bit without X64) map to None. One cheap
+        elementwise kernel + one tiny fetch covers every missing column;
+        tables that came through a shuffle already carry bounds (the count
+        pass measured them) and pay nothing here. Returns {} when the
+        CYLON_TPU_NO_LANE_PACK kill switch is on."""
+        if not _st.enabled():
+            return {}
+        out: Dict[str, Optional["_st.ColStat"]] = {}
+        missing = []
+        for n in names:
+            col = self._columns[n]
+            cls = _st.enc_class(col.data.dtype)
+            if cls is None:
+                out[n] = None
+                continue
+            got = self._stats.get(n)
+            if got is not None and got.cls == cls:
+                out[n] = got
+            else:
+                missing.append((n, cls))
+        if missing:
+            flat = tuple(
+                (self._columns[n].data, self._columns[n].valid)
+                for n, _c in missing
+            )
+            key = ("col_stats", tuple(str(d.dtype) for d, _v in flat))
+
+            def build():
+                def kern(dp, rep):
+                    (cols, counts) = dp
+                    n0 = counts[0]
+                    return jnp.concatenate(
+                        [_st.stat_words(c, n0) for c in cols]
+                    )
+
+                return kern
+
+            with span("stats.measure", rows=int(self.row_count)):
+                got = get_kernel(self.ctx, key, build)(
+                    (flat, self.counts_dev), ()
+                )
+                bump("host_sync")
+                bump("lane_pack.stats_kernel")
+                w = _fetch(got).reshape(self.world_size, len(missing), 4)
+            for i, (n, cls) in enumerate(missing):
+                stat = _st.fold_stat_words(w[:, i, :], cls)
+                self._stats[n] = stat
+                out[n] = stat
+        return out
 
     def column(self, name: str) -> Column:
         return self._columns[name]
@@ -636,7 +753,7 @@ class Table:
         # rows untouched: sortedness survives on the longest key prefix kept
         return self._replace(columns=cols)._attach_ordering(
             _ord.truncate_to(self._ordering, names)
-        )
+        )._attach_stats(self._stats)
 
     def rename(self, mapping: Union[Dict[str, str], Sequence[str]]) -> "Table":
         if isinstance(mapping, dict):
@@ -647,14 +764,14 @@ class Table:
         ren = dict(zip(self.column_names, new_names))
         return self._replace(columns=cols)._attach_ordering(
             _ord.rename(self._ordering, ren)
-        )
+        )._attach_stats(self._stats, rename=ren)
 
     def drop(self, columns: Sequence[str]) -> "Table":
         drop = set(columns)
         cols = OrderedDict((n, c) for n, c in self._columns.items() if n not in drop)
         return self._replace(columns=cols)._attach_ordering(
             _ord.truncate_to(self._ordering, cols.keys())
-        )
+        )._attach_stats(self._stats)
 
     def add_prefix(self, prefix: str) -> "Table":
         """Prefix every column name (reference table.pyx:1943-1970).
@@ -715,6 +832,7 @@ class Table:
             self._shard_cap = out._shard_cap
             self._counts_dev = None
             self._ordering = out._ordering
+            self._stats = dict(out._stats)
             # direct mutation bypasses __init__'s dangling-index check and
             # any cached loc index built on the pre-drop rows
             if self.index_name not in self._columns:
@@ -851,9 +969,10 @@ class Table:
             (m, flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
         )
         # a row-subset in input order: the sortedness descriptor survives
+        # (and range bounds stay conservative over any subset)
         return self._rebuild_cols(
             list(zip(names, self._columns.values())), out, self._out_counts(nout), cap_out
-        )._attach_ordering(self._ordering)
+        )._attach_ordering(self._ordering)._attach_stats(self._stats)
 
     def select(self, predicate) -> "Table":
         """Row filter by a vectorized predicate over a dict of column arrays.
@@ -961,7 +1080,24 @@ class Table:
             m = 0
 
         flat = self._flat_cols()
-        key = ("sort", key_idx, asc, len(flat), m)
+        # bit-width-adaptive sort-word fusion (ops/stats.py + ops/sort.py):
+        # measured key ranges bit-pack the suffix key lanes (+ null flags,
+        # prefix run lane and padding class) into the fewest physical sort
+        # words — a 3-key lexsort whose keys fit 12+16+20 bits runs as ONE
+        # fused pass. The QUANTIZED plan (never the raw bounds) is part of
+        # the kernel cache key; CYLON_TPU_NO_LANE_PACK=1 disables.
+        fuse = None
+        if _st.enabled():
+            specs = self._fusion_specs(names[m:], asc[m:])
+            if specs:
+                fuse = _sort_mod.plan_lane_fusion(
+                    specs, pad_bits=2,
+                    prefix_bits=(
+                        (self._shard_cap + 1).bit_length() if m else 0
+                    ),
+                    allow64=bool(jax.config.jax_enable_x64),
+                )
+        key = ("sort", key_idx, asc, len(flat), m, fuse)
 
         def build():
             def kern(dp, rep):
@@ -983,7 +1119,7 @@ class Table:
                 ride, payloads, heavy = _sort_mod.split_ride_cols(cols)
                 order, spays = _sort_mod.lexsort_rows_payload(
                     keys, n, cap, payloads, ascending=list(asc[m:]),
-                    prefix_lane=prefix_lane,
+                    prefix_lane=prefix_lane, fuse=fuse,
                 )
                 heavy_out = (
                     _g_pack.pack_gather(heavy, order)[0] if heavy else []
@@ -994,11 +1130,14 @@ class Table:
 
         if m:
             bump("ordering.sort_suffix")
+        if fuse is not None:
+            bump("lane_pack.sort_fused",
+                 rows=fuse.n_plain - fuse.n_words)
         with span("sort", rows=int(self.row_count)):
             out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
         res = self._rebuild_cols(
             list(zip(all_names, self._columns.values())), out, self._row_counts, self._shard_cap
-        )
+        )._attach_stats(self._stats)
         mask_free = all(self._columns[n].valid is None for n in names)
         return res._attach_ordering(Ordering(
             keys=tuple(names), ascending=asc, nulls_last=True, scope="shard",
@@ -1274,6 +1413,15 @@ class Table:
         )
         emit_key = emit_order == "key"
         left, right = _unify_dict_pair(self, other, l_names, r_names)
+        # factorize-lane fusion (ops/stats.py): the multi-key / masked
+        # probe's joint factorize bit-packs both sides' canonical key
+        # lanes into fewer merged-sort passes, driven by the pair's MERGED
+        # range stats (the single-uint32-key fast path is already one lane
+        # and skips the stats kernel entirely)
+        join_fuse = _plan_join_fusion(left, l_names, right, r_names)
+        if join_fuse is not None:
+            bump("lane_pack.join_fused",
+                 rows=join_fuse.n_plain - join_fuse.n_words)
         lflat_k = left._flat_cols(l_names)
         rflat_k = right._flat_cols(r_names)
         lflat = left._flat_cols()
@@ -1282,7 +1430,7 @@ class Table:
         rk_idx = tuple(right.column_names.index(n) for n in r_names)
         key = (
             "join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
-            r_presorted, emit_key,
+            r_presorted, emit_key, join_fuse,
         ) + _j.impl_tag()
 
         # Speculative single-dispatch path: fuse probe+count+emit into ONE
@@ -1345,7 +1493,7 @@ class Table:
                     out, total, shadow = _j.spec_join(
                         lk, rk, lcols, rcols, nl[0], nr[0], howi, co,
                         emit_impl, r_presorted=r_presorted,
-                        emit_key_order=emit_key,
+                        emit_key_order=emit_key, key_fuse=join_fuse,
                     )
                     # pack count + f32 overflow shadow into one [2] i32 lane
                     # so the host needs a single fetch
@@ -1391,7 +1539,7 @@ class Table:
                 cap_r = rk[0][0].shape[0]
                 lo, cnt, r_order, r_cnt = _j.probe_arrays(
                     lk, rk, nl[0], nr[0], cap_l, cap_r, howi,
-                    r_presorted=r_presorted,
+                    r_presorted=r_presorted, key_fuse=join_fuse,
                 )
                 total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
                 shadow = _j.count_overflow_check(cnt, r_cnt)
@@ -1685,6 +1833,7 @@ class Table:
             key = (
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
                 bucket_cap, join_cap, respill, num_slices,
+                _st.enabled(),
             ) + _j.impl_tag()
             cache = ctx.__dict__.setdefault("_jit_cache", {})
             step = cache.get(key)
@@ -1956,7 +2105,9 @@ class Table:
         res = res._maybe_compact(counts)
         if not is_union:
             # subtract/intersect keep a subset of LEFT rows in left order
-            res = res._attach_ordering(self._ordering)
+            res = res._attach_ordering(self._ordering)._attach_stats(
+                a._stats
+            )
         return res
 
     def distributed_union(self, other: "Table") -> "Table":
@@ -2061,7 +2212,10 @@ class Table:
             counts = self._out_counts(nout)  # the ONE host sync
         res = self._rebuild_cols(out_pairs, out, counts, cap_out)
         # dedup keeps a subset of rows in input order: descriptor survives
-        return res._maybe_compact(counts)._attach_ordering(self._ordering)
+        # (range bounds likewise)
+        return res._maybe_compact(counts)._attach_ordering(
+            self._ordering
+        )._attach_stats(self._stats)
 
     def distributed_unique(
         self, columns: Optional[Sequence[Union[str, int]]] = None, keep: str = "first"
@@ -2115,7 +2269,25 @@ class Table:
         # construction; the run-detect path does too only when the input
         # order is provable (a caller-contracted pipeline_groupby is not)
         out_canonical = (not _sorted) or provably_sorted
-        ids_fn = _g.sorted_group_ids if _sorted else _g.group_ids
+        # canonical-lane fusion (ops/stats.py): the factorize lexsort's
+        # [live, (null, value)*] lane stack bit-packs into fewer chained
+        # passes when the key ranges are measured — identical group ids
+        # (ops/sort.canonical_row_lanes). Quantized plan in the cache key.
+        gb_fuse = None
+        if not _sorted and _st.enabled():
+            gspecs = self._fusion_specs(key_names)
+            if gspecs:
+                gb_fuse = _sort_mod.plan_lane_fusion(
+                    gspecs, pad_bits=1, prefix_bits=0,
+                    allow64=bool(jax.config.jax_enable_x64),
+                )
+        if gb_fuse is not None:
+            bump("lane_pack.groupby_fused",
+                 rows=gb_fuse.n_plain - gb_fuse.n_words)
+        ids_fn = (
+            _g.sorted_group_ids if _sorted
+            else partial(_g.group_ids, fuse=gb_fuse)
+        )
         # normalize agg spec -> list of (col, op_id, op_name)
         specs: List[Tuple[str, int, str]] = []
         for col, ops in agg.items():
@@ -2135,7 +2307,7 @@ class Table:
         cap_out = self.shard_cap
         key = (
             "groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat),
-            _sorted, cap_out,
+            _sorted, cap_out, gb_fuse,
         )
 
         def build_emit():
@@ -2178,7 +2350,9 @@ class Table:
         for cname, d, v in agg_cols:
             cols_od[cname] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
         res = Table(self.ctx, cols_od, counts_np, cap_out)
-        res = res._maybe_compact(counts_np)
+        res = res._maybe_compact(counts_np)._attach_stats(
+            {n: self._stats.get(n) for n in key_names}
+        )
         if out_canonical:
             res._attach_ordering(Ordering(
                 keys=tuple(key_names),
@@ -2488,6 +2662,7 @@ class Table:
         cell of the masked rows (data/table.pyx mask-__setitem__)."""
         self._built_index = None  # in-place mutation invalidates loc cache
         self._ordering = None  # ...and any sortedness claim
+        self._stats = {}  # ...and any range-stats claim (lane packing)
         if isinstance(key, str):
             if np.isscalar(value):
                 value = np.full(self.row_count, value)
@@ -2831,6 +3006,7 @@ class Table:
         self._counts_dev = None
         self.index_name = None
         self._ordering = None
+        self._stats = {}
         self._built_index = None  # the loc cache pins host copies otherwise
 
     def build_index(self, kind: str = "hash"):
@@ -2960,6 +3136,16 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     )
     plan_sig = tuple(_g_pack.lane_plan(flat))
     semi = spec.sketch is not None
+    # range-stats measurement rides the count pass (ops/stats.py): the
+    # count kernel touches every row anyway, so every statable column's
+    # orderable min/max comes back in the ONE existing count fetch — the
+    # wire-narrowing plan and downstream consumers (sort/groupby/join
+    # fusion on the shuffle output) get global bounds for free
+    stats_on = _st.enabled()
+    stat_cols = tuple(
+        ci for ci, (d, _v) in enumerate(flat)
+        if stats_on and _st.enc_class(d.dtype) is not None
+    )
 
     def probe_ok(cols, sk_view):
         """Per-row semi-filter survival against the OTHER side's combined
@@ -2970,8 +3156,9 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     # the lane plan is part of the kernel identity: the pack/compact
     # builders bake the passthrough layout in, so same-arity tables with
     # different dtypes must not alias to one cache entry; the semi-filter
-    # probe changes both kernels' bodies, so its statics join the key
-    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key) + (
+    # probe changes both kernels' bodies, so its statics join the key,
+    # and so do the stats columns the count pass measures
+    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key, stat_cols) + (
         ("semi", spec.probe_row, spec.use_range) if semi else ()
     )
     has_lanes = any(
@@ -2982,32 +3169,42 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     def build_count():
         def kern(dp, rep):
             if semi:
-                # stacked [2, P]: row 0 = unfiltered counts, row 1 = the
-                # counts with the semi filter applied — the host reads the
-                # pair in its ONE existing count fetch, measures the exact
-                # selectivity, and gates the pack phase on it
+                # flat [2P + 4S]: unfiltered counts ++ filtered counts ++
+                # per-statable-column range words — the host reads counts,
+                # exact selectivity AND global column bounds in its ONE
+                # existing count fetch
                 (cols, kcols, counts, sk) = dp
                 n = counts[0]
                 pid = compute_pid(cols, kcols, n)
                 pid_f = jnp.where(probe_ok(cols, sk), pid, world)
-                return jnp.stack(
-                    [
-                        _sh.bucket_counts(pid, world),
-                        _sh.bucket_counts(pid_f, world),
-                    ]
-                )
-            (cols, kcols, counts) = dp
-            n = counts[0]
-            pid = compute_pid(cols, kcols, n)
-            return _sh.bucket_counts(pid, world)
+                parts = [
+                    _sh.bucket_counts(pid, world),
+                    _sh.bucket_counts(pid_f, world),
+                ]
+            else:
+                (cols, kcols, counts) = dp
+                n = counts[0]
+                pid = compute_pid(cols, kcols, n)
+                parts = [_sh.bucket_counts(pid, world)]
+            parts += [_st.stat_words(cols[ci], n) for ci in stat_cols]
+            return jnp.concatenate(parts)
 
         return kern
 
     def build_pack():
+        # late-bound wire state: the stats-driven wire plan is decided on
+        # the host AFTER the count fetch (st["wire"]/st["bases"]); the
+        # dispatch key appends st["wire"], so each decision compiles its
+        # own program and the builders read the decided state at build time
         def kern(dp, rep):
+            wire = st["wire"]
             if semi:
                 (cols, kcols, counts, sk) = dp
-                (dummy, rnd, usef) = rep
+                if wire is not None:
+                    (dummy, rnd, usef, bases) = rep
+                else:
+                    (dummy, rnd, usef) = rep
+                    bases = None
                 n = counts[0]
                 pid = compute_pid(cols, kcols, n)
                 # the adaptive gate's decision rides in as a traced scalar
@@ -3017,14 +3214,27 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 )
             else:
                 (cols, kcols, counts) = dp
-                (dummy, rnd) = rep
+                if wire is not None:
+                    (dummy, rnd, bases) = rep
+                else:
+                    (dummy, rnd) = rep
+                    bases = None
                 n = counts[0]
                 pid = compute_pid(cols, kcols, n)
             bc = dummy.shape[0]
             cnt = _sh.bucket_counts(pid, world)
             dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
             rc = _sh.round_counts(cnt, bc, rnd)
-            _plan, lanes, passthrough = _g_pack.pack_cols(list(cols))
+            if wire is not None:
+                # bit-width-adaptive wire narrowing: lanes are the packed
+                # words of the stats-driven wire plan (validity at 1
+                # bit/row, values at measured width, global rebase words
+                # riding in as the tiny replicated `bases` operand)
+                lanes, passthrough = _g_pack.wire_pack_cols(
+                    list(cols), wire, bases
+                )
+            else:
+                _plan, lanes, passthrough = _g_pack.pack_cols(list(cols))
             if lanes:
                 # the fused count/payload exchange: this round's per-
                 # destination send counts ride the lane buffer's header row
@@ -3053,6 +3263,7 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
 
     def build_compact():
         def kern(dp, rep):
+            wire = st["wire"]
             (head, pts) = dp
             if has_lanes:
                 lane_rows, recv_counts = _sh.split_header(head, world)
@@ -3062,19 +3273,27 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 bc = pts[0].shape[0] // world
             mask, total = _sh.received_row_mask(recv_counts, world, bc)
             pt_cols = dict(zip(pt_order, pts))
-            out = _sh.compact_received_lanes(
-                list(plan_sig), lane_rows, pt_cols, mask
-            )
+            if wire is not None:
+                (bases,) = rep
+                out = _sh.compact_received_wire(
+                    wire, bases, lane_rows, pt_cols, mask
+                )
+            else:
+                out = _sh.compact_received_lanes(
+                    list(plan_sig), lane_rows, pt_cols, mask
+                )
             return out, _scalar(total)
 
         return kern
 
-    return dict(
+    st = dict(
         spec=spec, t=t, ctx=ctx, world=world, flat=flat, khash=khash,
         key=key, plan_sig=plan_sig, has_lanes=has_lanes, n_pt=len(pt_order),
+        stat_cols=stat_cols, wire=None, bases=None,
         build_count=build_count, build_pack=build_pack,
         build_coll=build_coll, build_compact=build_compact,
     )
+    return st
 
 
 def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
@@ -3125,17 +3344,32 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     for st in states:
         bump("host_sync")
         spec = st["spec"]
+        w = st["world"]
+        S = len(st["stat_cols"])
+        per = (2 * w if spec.sketch is not None else w) + 4 * S
+        got = _fetch(st["counts_fut"]).reshape(w, per)
         if spec.sketch is not None:
-            got = _fetch(st["counts_fut"]).reshape(
-                st["world"], 2, st["world"]
-            )
-            st["counts_pair"] = (got[:, 0, :], got[:, 1, :])
-            st["send_counts"] = got[:, 0, :]  # provisional; gated below
+            st["counts_pair"] = (got[:, :w], got[:, w : 2 * w])
+            st["send_counts"] = got[:, :w]  # provisional; gated below
+            base = 2 * w
         else:
             st["use_filter"] = False
-            st["send_counts"] = _fetch(st["counts_fut"]).reshape(
-                st["world"], st["world"]
-            )  # [src, dst]
+            st["send_counts"] = got[:, :w]  # [src, dst]
+            base = w
+        # global column range stats measured by the count pass: fold the
+        # per-shard words, cache on the INPUT table (later local ops on it
+        # skip the stats kernel) and remember them for the wire plan and
+        # the output table (the shuffle permutes rows, bounds survive)
+        st["col_stats"] = {}
+        if S:
+            names = st["t"].column_names
+            sw = got[:, base:].reshape(w, S, 4)
+            for i, ci in enumerate(st["stat_cols"]):
+                cls = _st.enc_class(st["flat"][ci][0].dtype)
+                st["col_stats"][ci] = _st.fold_stat_words(sw[:, i, :], cls)
+            st["t"]._attach_stats(
+                {names[ci]: v for ci, v in st["col_stats"].items()}
+            )
 
     # phase 1: round plan from the byte budget. The semi-filter APPLY
     # decision is plan-aware: shipped bytes are rounds x P x bucket_cap x
@@ -3172,6 +3406,39 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             st["bucket_cap"], st["n_rounds"] = _sh.plan_rounds(
                 st["send_counts"], row_bytes, st["world"], budget
             )
+        # bit-width-adaptive wire narrowing, gated plan-aware like the
+        # semi filter: capacities quantize to powers of two, so the
+        # narrowed codec is used only when it yields a strictly cheaper
+        # round plan (total exchanged bytes) than the plain int32 lanes
+        if st["col_stats"]:
+            stats_list = [None] * len(st["plan_sig"])
+            for ci, stat in st["col_stats"].items():
+                stats_list[ci] = (stat.cls, _st.field_bits(stat))
+            wplan = _g_pack.wire_plan(list(st["plan_sig"]), stats_list)
+            if wplan is not None:
+                rb_w = _g_pack.wire_row_bytes(wplan)
+                cap_w, k_w = _sh.plan_rounds(
+                    st["send_counts"], rb_w, st["world"], budget
+                )
+                total_wire = k_w * cap_w * rb_w
+                total_plain = st["n_rounds"] * st["bucket_cap"] * row_bytes
+                if total_wire < total_plain:
+                    st["wire"] = wplan
+                    st["bases"] = jnp.asarray(
+                        _g_pack.wire_bases(wplan, st["col_stats"])
+                    )
+                    st["bucket_cap"], st["n_rounds"] = cap_w, k_w
+                    bump("lane_pack.wire.applied")
+                    bump(
+                        "lane_pack.wire.bytes_saved",
+                        rows=(total_plain - total_wire) * st["world"],
+                    )
+                    gauge(
+                        "lane_pack.wire.row_bytes_ratio",
+                        rb_w / max(row_bytes, 1),
+                    )
+                else:
+                    bump("lane_pack.wire.gate_skipped")
         st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
         bump("shuffle.rounds", rows=st["n_rounds"])
         st["rounds_out"] = []
@@ -3196,9 +3463,12 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                     rep = rep + (
                         jnp.asarray(1 if st["use_filter"] else 0, jnp.int32),
                     )
+                if st["wire"] is not None:
+                    rep = rep + (st["bases"],)
                 with span("shuffle.round.pack"):
                     head, pts = get_kernel(
-                        ctx, st["key"] + ("pack",), st["build_pack"]
+                        ctx, st["key"] + ("pack", st["wire"]),
+                        st["build_pack"],
                     )(dp, rep)
                 with span("shuffle.round.collective"):
                     head, pts = get_kernel(
@@ -3209,9 +3479,13 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 with span("shuffle.round.compact"):
                     out, nout = get_kernel(
                         ctx,
-                        ("shuffle_compact", st["plan_sig"], st["has_lanes"]),
+                        ("shuffle_compact", st["plan_sig"],
+                         st["has_lanes"], st["wire"]),
                         st["build_compact"],
-                    )((head, pts), ())
+                    )(
+                        (head, pts),
+                        (st["bases"],) if st["wire"] is not None else (),
+                    )
                 st["rounds_out"].append((out, nout))
         t_disp = _time.perf_counter()
 
@@ -3248,6 +3522,11 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             # K-round chunks interleave (shuffle.ordering_after_shuffle)
             res = res._maybe_compact(st["new_counts"], factor=2)
             res._ordering = _sh.ordering_after_shuffle(st["spec"].kind)
+            if st["col_stats"]:
+                names = t.column_names
+                res._attach_stats(
+                    {names[ci]: v for ci, v in st["col_stats"].items()}
+                )
             results.append(res)
         total_s = max(_time.perf_counter() - t0, 1e-9)
         gauge("shuffle.overlap_efficiency", (t_disp - t0) / total_s)
@@ -3490,6 +3769,51 @@ def unify_encoded_shards(shards: List["OrderedDict[str, Tuple]"]) -> None:
             s[name] = (codes, valid, dtype, union)
 
 
+def _plan_join_fusion(left: "Table", l_names, right: "Table", r_names):
+    """Sort-word fusion plan for a join pair's factorize lanes, or None.
+
+    Declines when: lane packing is off; the pair takes ops/join's
+    single-uint32-key fast path (already one lane — skip the stats
+    kernel); any key pair's physical dtypes differ (each side's stats
+    describe a different encoding); or any key lacks measurable stats.
+    The merged (both-sides) bounds size each value field, so every live
+    key of either table fits its field."""
+    if not _st.enabled():
+        return None
+    if len(l_names) == 1:
+        ca = left._columns[l_names[0]]
+        cb = right._columns[r_names[0]]
+        if (
+            ca.valid is None and cb.valid is None
+            and np.dtype(ca.data.dtype).itemsize <= 4
+            and np.dtype(cb.data.dtype).itemsize <= 4
+            and ca.data.dtype != jnp.float64
+            and cb.data.dtype != jnp.float64
+        ):
+            return None  # the uint32 fast path needs no factorize
+    lstats = left.ensure_stats(l_names)
+    rstats = right.ensure_stats(r_names)
+    specs = []
+    for ln, rn in zip(l_names, r_names):
+        ca, cb = left._columns[ln], right._columns[rn]
+        if ca.data.dtype != cb.data.dtype:
+            return None
+        a, b = lstats.get(ln), rstats.get(rn)
+        if a is None or b is None:
+            return None
+        merged = a.merge(b)
+        if merged is None:
+            return None
+        specs.append((
+            merged.cls, _st.field_bits(merged),
+            ca.valid is not None or cb.valid is not None, True,
+        ))
+    return _sort_mod.plan_lane_fusion(
+        specs, pad_bits=1, prefix_bits=0,
+        allow64=bool(jax.config.jax_enable_x64),
+    )
+
+
 def _check_join_count(totals: np.ndarray, shadows: np.ndarray) -> None:
     """Reject joins whose per-shard output count wrapped int32 (see
     ops.join.count_overflow_check)."""
@@ -3549,10 +3873,17 @@ def _unify_dict_pair(
     if not changed:
         return a, b
     # dictionary remap preserves code order (code order == value order
-    # invariant), so any sortedness descriptor survives the rewrite
+    # invariant), so any sortedness descriptor survives the rewrite; range
+    # stats survive only on columns whose CODES were not rewritten
+    changed_a = {n for n in a_cols if new_a[n] is not a._columns[n]}
+    changed_b = {n for n in b_cols if new_b[n] is not b._columns[n]}
     return (
-        a._replace(columns=new_a)._attach_ordering(a._ordering),
-        b._replace(columns=new_b)._attach_ordering(b._ordering),
+        a._replace(columns=new_a)._attach_ordering(a._ordering)._attach_stats(
+            {n: v for n, v in a._stats.items() if n not in changed_a}
+        ),
+        b._replace(columns=new_b)._attach_ordering(b._ordering)._attach_stats(
+            {n: v for n, v in b._stats.items() if n not in changed_b}
+        ),
     )
 
 
@@ -3582,10 +3913,18 @@ def _promote_key_pair(
     if not changed:
         return a, b
     # numeric widening is monotone: non-strict sortedness survives (equal
-    # promoted values only merge runs, never split them)
+    # promoted values only merge runs, never split them). Range stats are
+    # carried through _attach_stats, which drops any column whose encoding
+    # class changed under the promotion (the enc_class re-check).
     return (
-        a._replace(columns=new_a)._attach_ordering(a._ordering),
-        b._replace(columns=new_b)._attach_ordering(b._ordering),
+        a._replace(columns=new_a)._attach_ordering(a._ordering)._attach_stats(
+            {n: v for n, v in a._stats.items()
+             if new_a[n] is a._columns[n]}
+        ),
+        b._replace(columns=new_b)._attach_ordering(b._ordering)._attach_stats(
+            {n: v for n, v in b._stats.items()
+             if new_b[n] is b._columns[n]}
+        ),
     )
 
 

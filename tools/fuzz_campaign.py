@@ -702,6 +702,112 @@ def semi_round_once(seed) -> bool:
     return ok
 
 
+def _packing_off(fn):
+    """Run ``fn`` with lane packing disabled (sort-word fusion, canonical
+    fusion, wire narrowing, stats establishment all off) — the
+    CYLON_TPU_NO_LANE_PACK=1 differential oracle."""
+    from cylon_tpu.ops.stats import disabled
+
+    with disabled():
+        return fn()
+
+
+def _rand_key_col(rng, n, spec, null_p):
+    """One random key column of a given (dtype, bit-width) spec as an
+    object array (None = null)."""
+    kind, bits = spec
+    lo = -(1 << (bits - 1)) if kind.startswith("i") else 0
+    hi = (1 << bits) - 1 + lo
+    if kind == "bool":
+        k = rng.integers(0, 2, n).astype(bool).astype(object)
+    elif kind == "str":
+        k = rng.choice([f"s{i}" for i in range(min(max(1 << bits, 2), 4096))], n).astype(object)
+    elif kind == "f32":
+        k = rng.integers(lo, max(hi, lo + 1), n).astype(np.float32).astype(object)
+    elif kind == "f64":
+        k = rng.integers(lo, max(hi, lo + 1), n).astype(np.float64).astype(object)
+    else:
+        dt = {"i8": np.int8, "i16": np.int16, "i32": np.int32,
+              "i64": np.int64}[kind]
+        k = rng.integers(lo, max(hi, lo + 1), n).astype(dt).astype(object)
+    if null_p:
+        k[rng.random(n) < null_p] = None
+    return k
+
+
+def packing_round_once(seed) -> bool:
+    """Lane-packing oracle round (ISSUE 5): random key bit-widths, dtype
+    mixes (narrow/wide ints, bool, dict strings, f32, f64 — the latter
+    must decline), null densities and world sizes; multi-key sort,
+    distributed join, groupby and shuffle each differential-checked
+    against the CYLON_TPU_NO_LANE_PACK=1 oracle on the same inputs."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(4, MAX_N))
+    world = int(rng.choice([1, 2, 4, 8]))
+    null_p = float(rng.choice([0.0, 0.1, 0.3]))
+    nkeys = int(rng.integers(1, 4))
+    kinds = ["i8", "i16", "i32", "i64", "bool", "str", "f32", "f64"]
+    specs = [
+        (str(rng.choice(kinds)), int(rng.integers(1, 21)))
+        for _ in range(nkeys)
+    ]
+    asc = [bool(rng.integers(0, 2)) for _ in range(nkeys)]
+    params = dict(seed=seed, profile="packing", n=n, world=world,
+                  null_p=null_p, specs=specs, asc=asc)
+    ctx = ctx_for(world)
+    knames = [f"k{i}" for i in range(nkeys)]
+    data = {kn: _rand_key_col(rng, n, sp, null_p)
+            for kn, sp in zip(knames, specs)}
+    data["v"] = rng.normal(size=n).astype(np.float32)
+    df = pd.DataFrame(data)
+    rdf = pd.DataFrame({
+        **{kn: _rand_key_col(rng, max(n // 2, 1), sp, null_p)
+           for kn, sp in zip(knames, specs)},
+        "w": rng.normal(size=max(n // 2, 1)).astype(np.float32),
+    })
+    ok = True
+
+    t = ct.Table.from_pandas(ctx, df)
+    got = t.sort(knames, ascending=asc).to_pandas()
+    want = _packing_off(
+        lambda: ct.Table.from_pandas(ctx, df)
+        .sort(knames, ascending=asc).to_pandas()
+    )
+    # the oracle is OUR OWN unpacked lexsort on identical data: the packed
+    # permutation must match row-for-row, so compare in emitted order
+    # (check() would re-sort and mask an order bug)
+    g = got.astype(str).reset_index(drop=True)
+    w = want.astype(str).reset_index(drop=True)
+    if len(g) != len(w) or not g.equals(w):
+        print(f"MISMATCH packing/sort_order params={params}", flush=True)
+        ok = False
+
+    got = t.distributed_groupby(knames, {"v": "sum"}).to_pandas()
+    want = _packing_off(
+        lambda: ct.Table.from_pandas(ctx, df)
+        .distributed_groupby(knames, {"v": "sum"}).to_pandas()
+    )
+    ok &= check(got, want, "packing/groupby", params)
+
+    rt = ct.Table.from_pandas(ctx, rdf)
+    got = t.distributed_join(rt, on=knames, how="inner").to_pandas()
+    want = _packing_off(
+        lambda: ct.Table.from_pandas(ctx, df).distributed_join(
+            ct.Table.from_pandas(ctx, rdf), on=knames, how="inner"
+        ).to_pandas()
+    )
+    ok &= check(got, want, "packing/join", params)
+
+    if world > 1:
+        got = t.shuffle([knames[0]]).to_pandas()
+        want = _packing_off(
+            lambda: ct.Table.from_pandas(ctx, df)
+            .shuffle([knames[0]]).to_pandas()
+        )
+        ok &= check(got, want, "packing/shuffle", params)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -711,7 +817,7 @@ def main():
                          "respill/overflow/capacity-retry paths)")
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
-                             "ordering", "semi"],
+                             "ordering", "semi", "packing"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -731,7 +837,8 @@ def main():
     fn = {"skew": skew_round_once, "plan": plan_round_once,
           "shuffle": shuffle_round_once,
           "ordering": ordering_round_once,
-          "semi": semi_round_once}.get(args.profile, round_once)
+          "semi": semi_round_once,
+          "packing": packing_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
