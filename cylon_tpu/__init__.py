@@ -6,21 +6,21 @@ live in TPU HBM as XLA device buffers, relational kernels lowered to
 jit-compiled XLA computations, and a mesh communicator running the shuffle
 over ICI via ``lax.all_to_all`` — no MPI, no per-row C++ loops.
 """
-import os
-
 import jax
+
+from .utils import envgate as _envgate
 
 # Dataframe semantics need 64-bit ints/floats (CSV ints are int64, pandas
 # float is float64). Opt out with CYLON_TPU_NO_X64=1 for pure-32-bit
 # pipelines (TPU int64 is emulated; hot benchmarks should use 32-bit columns).
-if not os.environ.get("CYLON_TPU_NO_X64"):
+if not _envgate.NO_X64.raw():
     jax.config.update("jax_enable_x64", True)
 
 # Optional platform pin (e.g. CYLON_TPU_PLATFORM=cpu for the virtual-device
 # mesh). The jax.config route is used on purpose: the JAX_PLATFORMS env var
 # can hang backend selection in tunneled-TPU images, the config update before
 # first backend touch cannot. Embedded/C-ABI consumers rely on this knob.
-_platform = os.environ.get("CYLON_TPU_PLATFORM")
+_platform = _envgate.PLATFORM.raw()
 if _platform:
     jax.config.update("jax_platforms", _platform)
 
@@ -30,7 +30,7 @@ if _platform:
 # XLA's default. The reference pays its optimization once at native build
 # time — this is the knob for users who'd rather pay less per first-touch
 # shape.
-_effort = os.environ.get("CYLON_TPU_COMPILE_EFFORT")
+_effort = _envgate.COMPILE_EFFORT.raw()
 if _effort:
     try:
         _effort_f = float(_effort)

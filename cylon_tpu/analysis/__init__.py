@@ -1,0 +1,32 @@
+"""graft-lint: static invariant analysis for the three recurring bug
+families every PR so far has shipped "review hardening" fixes for:
+
+1. a gate/knob that changes kernel behavior but is missing from the
+   kernel cache key or plan fingerprint (stale-program aliasing);
+2. a Python scalar closure-captured into a ``jit``/``shard_map`` body as
+   a baked constant when it should be a replicated operand (silent
+   per-value recompiles);
+3. an accidental device->host sync inside a dispatch loop (the chunked
+   shuffle engine exists to avoid exactly this).
+
+Two layers:
+
+- **AST pass** (:mod:`.ast_pass`): source-level analysis of
+  ``cylon_tpu/`` — env-gate reads reachable from cache-key builders must
+  be threaded into the key (via a keyed carrier, taint into the key
+  expression, a declarative ``# lint: key=...`` site comment, or an
+  audited registry exemption — never a blanket ignore), plus
+  trace-time-read and baked-constant rules.
+- **jaxpr pass** (:mod:`.jaxpr_pass` / :mod:`.plans` /
+  :mod:`.contracts`): trace a registry of representative plans on a
+  dryrun mesh, count collectives per primitive, detect host transfers,
+  and check the machine-readable contract table — the single source of
+  truth the hand-written collective-count pin tests re-export from.
+
+Run both via ``python -m tools.graft_lint``; import
+:mod:`cylon_tpu.analysis.contracts` from tests.
+"""
+from .ast_pass import Finding, run_ast_pass  # noqa: F401
+from . import contracts  # noqa: F401
+
+__all__ = ["Finding", "run_ast_pass", "contracts"]

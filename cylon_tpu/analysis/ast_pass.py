@@ -1,0 +1,1055 @@
+"""Layer 1: the AST pass over ``cylon_tpu/``.
+
+Rules
+-----
+``gate-not-in-key``
+    Every env-gate read (``os.environ``, an ``envgate`` knob, an
+    ``env_gate``-produced ``enabled()``) of kind ``impl``/``kill-switch``
+    that is *reachable* from a function that builds a kernel cache key or
+    a plan fingerprint must be THREADED into that key. Threading is
+    recognized when the key expression (a) calls a function that
+    transitively reads the gate (keyed carrier — e.g. ``impl_tag()``),
+    (b) contains a local name tainted by the gate (e.g. ``r_presorted =
+    covers_prefix(...)``), or (c) the read site carries a declarative
+    ``# lint: key=<VAR>`` comment / an audited registry exemption
+    (:mod:`.registry`). Reachability stops at other key-building
+    functions: they police their own keys.
+
+``trace-time-read``
+    Knobs of kind ``dispatch``/``tuning``/``startup``/``observability``/
+    ``native`` must never be read inside a kernel body (a function traced
+    by jit/shard_map): their declared contract is host-side resolution,
+    and a trace-time read would bake the value without any key to guard
+    it.
+
+``baked-constant``
+    A kernel body's closure-captured value must be derivable from the
+    cache key (names in the key expression, values tainted by keyed
+    gates, per-context state, module-level constants) or be declared
+    ``# lint: keyed=<name>`` (threaded some other way, audited at the
+    site) / ``# lint: operand=<name>``. Anything else is a Python value
+    baked into the traced program with nothing forcing a recompile when
+    it changes.
+
+``unregistered-env-read``
+    Any literal ``CYLON_TPU_*`` environment read outside
+    ``utils/envgate.py`` that does not go through a declared knob.
+
+The pass is purely syntactic — it never imports the analyzed modules —
+so it runs on seeded known-bad fixtures (tests/lint_fixtures) exactly as
+it runs on the live tree.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .registry import EXEMPT, exemption_reason
+
+ENV_PREFIX = "CYLON_TPU_"
+# engine entry points whose second argument IS the cache key / fingerprint
+KEY_FUNCS = {"get_kernel", "run", "plan_executable"}
+# callables that trace their function argument (kernel-body markers)
+JIT_WRAPPERS = {"jit", "shard_map", "make_jaxpr", "pmap"}
+# kinds whose reads must be threaded into a reachable cache key
+KEYED_KINDS = {"impl", "kill-switch"}
+
+_LINT_RE = re.compile(
+    r"#\s*lint:\s*(key|keyed|operand)\s*=\s*([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str
+    line: int
+    func: str
+    name: str
+    message: str
+
+    def __str__(self) -> str:
+        return (
+            f"{self.file}:{self.line}: [{self.rule}] {self.func}: "
+            f"{self.name}: {self.message}"
+        )
+
+
+# ----------------------------------------------------------------------
+# per-function facts
+# ----------------------------------------------------------------------
+@dataclass
+class FuncInfo:
+    qualname: str
+    module: str
+    node: ast.AST
+    parent: Optional[str]  # enclosing function qualname
+    class_name: Optional[str]
+    direct_reads: List[Tuple[str, int]] = field(default_factory=list)
+    callees: List[Tuple[str, ...]] = field(default_factory=list)  # descriptors
+    key_exprs: List[ast.AST] = field(default_factory=list)
+    is_key_builder: bool = False
+    is_kernel_body: bool = False
+    is_builder: bool = False
+    nested: List[str] = field(default_factory=list)
+    lint_key: Set[str] = field(default_factory=set)     # lint: key=VAR
+    lint_keyed: Set[str] = field(default_factory=set)   # lint: keyed=name
+    lint_operand: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: ast.Module
+    is_pkg: bool = False  # a package __init__.py
+    alias_to_module: Dict[str, str] = field(default_factory=dict)
+    # local name -> (module, remote name) for from-imports
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # names bound at module level (constants, functions, classes, imports)
+    module_names: Set[str] = field(default_factory=set)
+    # module-level `enabled` fns / knob objects: local name -> env var
+    gate_readers: Dict[str, str] = field(default_factory=dict)
+    knob_names: Dict[str, str] = field(default_factory=dict)  # knob -> var
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+
+
+class _Analysis:
+    def __init__(self, knob_kinds: Dict[str, str]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.knob_kinds = dict(knob_kinds)
+        # env vars declared via env_gate("VAR") in analyzed sources
+        self.declared_vars: Set[str] = set(self.knob_kinds)
+        self._reads_full_memo: Dict[str, Set[str]] = {}
+        # method name -> [qualnames] fallback resolver
+        self.method_index: Dict[str, List[str]] = {}
+
+    # -- name resolution ------------------------------------------------
+    def resolve_callee(self, desc: Tuple[str, ...], mod: ModuleInfo,
+                       func: FuncInfo) -> Optional[str]:
+        kind = desc[0]
+        if kind == "name":
+            name = desc[1]
+            # local nested function?
+            for q in func.nested:
+                if q.rsplit(".", 1)[-1] == name:
+                    return q
+            q = f"{mod.name}.{name}"
+            if q in self.funcs:
+                return q
+            if name in mod.from_imports:
+                m, remote = mod.from_imports[name]
+                q = f"{m}.{remote}"
+                return q if q in self.funcs else None
+            return None
+        if kind == "self":
+            meth = desc[1]
+            if func.class_name:
+                q = f"{mod.name}.{func.class_name}.{meth}"
+                if q in self.funcs:
+                    return q
+            return self._unique_method(meth)
+        if kind == "attr":
+            base, meth = desc[1], desc[2]
+            if base in mod.alias_to_module:
+                q = f"{mod.alias_to_module[base]}.{meth}"
+                return q if q in self.funcs else None
+            # obj.method(): unique-name fallback over analyzed classes
+            return self._unique_method(meth)
+        return None
+
+    def _unique_method(self, meth: str) -> Optional[str]:
+        cands = self.method_index.get(meth, [])
+        return cands[0] if len(cands) == 1 else None
+
+    # -- transitive env reads (full descent; carrier semantics) ---------
+    def reads_full(self, qual: str, _stack: Optional[Set[str]] = None) -> Set[str]:
+        if qual in self._reads_full_memo:
+            return self._reads_full_memo[qual]
+        # memoize only results computed from an empty stack: a set built
+        # while a recursion cycle is open is PARTIAL (the back edge
+        # returned {}), and caching it would silently drop transitive
+        # reads on mutually recursive helpers — a lint false negative
+        top_level = _stack is None
+        _stack = _stack if _stack is not None else set()
+        if qual in _stack:
+            return set()
+        _stack.add(qual)
+        f = self.funcs[qual]
+        mod = self.modules[f.module]
+        out = {v for v, _ln in f.direct_reads}
+        for q in f.nested:
+            out |= self.reads_full(q, _stack)
+        for desc in f.callees:
+            callee = self.resolve_callee(desc, mod, f)
+            if callee is not None:
+                out |= self.reads_full(callee, _stack)
+        _stack.discard(qual)
+        if top_level:
+            self._reads_full_memo[qual] = out
+        return out
+
+    # -- scoped reachability: stop at other key builders ----------------
+    def reads_scoped(self, root: str) -> List[Tuple[str, int, str]]:
+        """[(var, line, origin_qualname)] reachable from ``root`` without
+        descending into other key-building functions."""
+        seen: Set[str] = set()
+        out: List[Tuple[str, int, str]] = []
+
+        def visit(qual: str) -> None:
+            if qual in seen:
+                return
+            seen.add(qual)
+            f = self.funcs[qual]
+            if qual != root and f.is_key_builder:
+                return  # polices its own key
+            for v, ln in f.direct_reads:
+                out.append((v, ln, qual))
+            for q in f.nested:
+                visit(q)
+            mod = self.modules[f.module]
+            for desc in f.callees:
+                callee = self.resolve_callee(desc, mod, f)
+                if callee is not None:
+                    visit(callee)
+
+        visit(root)
+        return out
+
+
+# ----------------------------------------------------------------------
+# module collection
+# ----------------------------------------------------------------------
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _env_read_var(call: ast.AST) -> Optional[str]:
+    """Literal env var of an ``os.environ.get("V", ...)`` /
+    ``os.environ["V"]`` expression, else None. Returns "" for a
+    non-literal environ access (unknown var)."""
+    if isinstance(call, ast.Call):
+        chain = _attr_chain(call.func)
+        if chain and len(chain) >= 3 and chain[-2] == "environ" and chain[-1] in (
+            "get", "pop", "setdefault",
+        ):
+            if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+                call.args[0].value, str
+            ):
+                return call.args[0].value
+            return ""
+    if isinstance(call, ast.Subscript):
+        chain = _attr_chain(call.value)
+        if chain and chain[-1] == "environ":
+            sl = call.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                return sl.value
+            return ""
+    return None
+
+
+def _module_name(root: str, path: str, package: Optional[str]) -> str:
+    rel = os.path.relpath(path, root)
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if package:
+        parts = [package] + [p for p in parts if p]
+    return ".".join(p for p in parts if p) or (package or "mod")
+
+
+def _resolve_relative(
+    mod: str, level: int, target: Optional[str], is_pkg: bool = False
+) -> str:
+    parts = mod.split(".")
+    # level 1 = current package. A non-__init__ module's dotted name
+    # includes its own leaf (drop `level` components); a package
+    # __init__'s name IS its package (drop one fewer) — getting this
+    # wrong silently loses analyzer edges for gates read in __init__.py
+    drop = level - 1 if is_pkg else level
+    base = parts[: len(parts) - drop] if drop <= len(parts) else []
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+class _FuncCollector(ast.NodeVisitor):
+    """Collect FuncInfo facts for every function in one module."""
+
+    def __init__(self, an: _Analysis, mod: ModuleInfo, lint_comments):
+        self.an = an
+        self.mod = mod
+        self.stack: List[FuncInfo] = []
+        self.class_stack: List[str] = []
+        self.lint_comments = lint_comments  # [(line, tag, names)]
+
+    # ---- helpers
+    def _qual(self, name: str) -> str:
+        if self.stack:
+            return f"{self.stack[-1].qualname}.<locals>.{name}"
+        if self.class_stack:
+            return f"{self.mod.name}.{'.'.join(self.class_stack)}.{name}"
+        return f"{self.mod.name}.{name}"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        qual = self._qual(node.name)
+        fi = FuncInfo(
+            qualname=qual,
+            module=self.mod.name,
+            node=node,
+            parent=self.stack[-1].qualname if self.stack else None,
+            class_name=self.class_stack[-1] if self.class_stack else None,
+        )
+        if self.stack:
+            self.stack[-1].nested.append(qual)
+            if self.stack[-1].is_builder:
+                fi.is_kernel_body = True
+            # the get_kernel builder convention: a NESTED `build*` whose
+            # returned function is the traced kernel. Top-level `build_*`
+            # factories (plan.lower.build_executor, shuffle round helpers)
+            # are ordinary host code, not builders.
+            if node.name.startswith("build"):
+                fi.is_builder = True
+        # attach lint comments that fall inside this function's span
+        end = getattr(node, "end_lineno", node.lineno)
+        for line, tag, names in self.lint_comments:
+            if node.lineno <= line <= end:
+                if tag == "key":
+                    fi.lint_key |= names
+                elif tag == "keyed":
+                    fi.lint_keyed |= names
+                else:
+                    fi.lint_operand |= names
+        self.mod.functions[qual] = fi
+        self.an.funcs[qual] = fi
+        if self.class_stack and not self.stack:
+            self.an.method_index.setdefault(node.name, []).append(qual)
+        self.stack.append(fi)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # ---- function-level imports (common in this codebase: lazy/cyclic
+    # imports inside hot functions) fold into the module's alias maps
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.mod.alias_to_module[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        src = (
+            _resolve_relative(
+                self.mod.name, node.level, node.module, self.mod.is_pkg
+            )
+            if node.level
+            else (node.module or "")
+        )
+        for a in node.names:
+            local = a.asname or a.name
+            self.mod.from_imports.setdefault(local, (src, a.name))
+            self.mod.alias_to_module.setdefault(local, f"{src}.{a.name}")
+
+    # ---- reads / calls inside functions
+    def visit_Call(self, node: ast.Call) -> None:
+        fi = self.stack[-1] if self.stack else None
+        var = _env_read_var(node)
+        if var is not None and fi is not None:
+            fi.direct_reads.append((var, node.lineno))
+        chain = _attr_chain(node.func)
+        if fi is not None and chain:
+            # knob reads: <knob>.get()/raw()/truthy() where <knob> resolves
+            # to an envgate declaration, and enabled() gate calls
+            if chain[-1] in ("get", "raw", "truthy") and len(chain) >= 2:
+                v = self._knob_var(chain[:-1])
+                if v:
+                    fi.direct_reads.append((v, node.lineno))
+            v = self._gate_reader_var(chain)
+            if v:
+                fi.direct_reads.append((v, node.lineno))
+            # env_gate("VAR") declarations inside functions count as reads
+            if chain[-1] in ("env_gate",) and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                    fi.direct_reads.append((a0.value, node.lineno))
+            # call-graph edge + key-builder detection
+            if len(chain) == 1:
+                fi.callees.append(("name", chain[0]))
+            elif chain[0] in ("self", "cls") and len(chain) == 2:
+                fi.callees.append(("self", chain[1]))
+            elif len(chain) == 2:
+                fi.callees.append(("attr", chain[0], chain[1]))
+            else:
+                fi.callees.append(("attr", chain[-2], chain[-1]))
+            leaf = chain[-1]
+            if leaf in KEY_FUNCS and len(node.args) >= 2:
+                fi.is_key_builder = True
+                fi.key_exprs.append(node.args[1])
+            if leaf in JIT_WRAPPERS and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Name):
+                    for q in fi.nested:
+                        if q.rsplit(".", 1)[-1] == a0.id:
+                            self.an.funcs[q].is_kernel_body = True
+            # cache.get(key) dispatch pattern (fused-join style)
+            if leaf == "get" and len(chain) >= 2 and chain[-2].endswith("cache"):
+                if node.args and isinstance(node.args[0], ast.Name) and (
+                    node.args[0].id == "key"
+                ):
+                    fi.is_key_builder = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        fi = self.stack[-1] if self.stack else None
+        var = _env_read_var(node)
+        if var is not None and fi is not None and isinstance(node.ctx, ast.Load):
+            fi.direct_reads.append((var, node.lineno))
+        self.generic_visit(node)
+
+    def _knob_var(self, chain: List[str]) -> Optional[str]:
+        """Resolve ``[_eg, REPEAT_IMPL]`` / ``[TRACE]`` to its env var."""
+        if len(chain) == 1:
+            name = chain[0]
+            if name in self.mod.knob_names:
+                return self.mod.knob_names[name]
+            if name in self.mod.from_imports:
+                m, remote = self.mod.from_imports[name]
+                other = self.an.modules.get(m)
+                if other and remote in other.knob_names:
+                    return other.knob_names[remote]
+            return None
+        base, leaf = chain[-2], chain[-1]
+        if base in self.mod.alias_to_module:
+            other = self.an.modules.get(self.mod.alias_to_module[base])
+            if other and leaf in other.knob_names:
+                return other.knob_names[leaf]
+        return None
+
+    def _gate_reader_var(self, chain: List[str]) -> Optional[str]:
+        """Resolve ``enabled()`` / ``_ord.enabled()`` to its env var."""
+        leaf = chain[-1]
+        if len(chain) == 1:
+            if leaf in self.mod.gate_readers:
+                return self.mod.gate_readers[leaf]
+            if leaf in self.mod.from_imports:
+                m, remote = self.mod.from_imports[leaf]
+                other = self.an.modules.get(m)
+                if other:
+                    return other.gate_readers.get(remote)
+            return None
+        base = chain[-2]
+        if base in self.mod.alias_to_module:
+            other = self.an.modules.get(self.mod.alias_to_module[base])
+            if other:
+                return other.gate_readers.get(leaf)
+        return None
+
+
+def _collect_module_scaffold(an: _Analysis, mod: ModuleInfo) -> None:
+    """First pass: imports, module-level names, gate/knob declarations."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.alias_to_module[a.asname or a.name.split(".")[0]] = a.name
+                mod.module_names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            src = (
+                _resolve_relative(mod.name, node.level, node.module, mod.is_pkg)
+                if node.level
+                else (node.module or "")
+            )
+            for a in node.names:
+                local = a.asname or a.name
+                mod.from_imports[local] = (src, a.name)
+                mod.module_names.add(local)
+                # importing a module via from-pkg: alias to submodule
+                mod.alias_to_module.setdefault(local, f"{src}.{a.name}")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            mod.module_names.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            names: List[str] = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.extend(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+            mod.module_names.update(names)
+            value = node.value
+            if isinstance(value, ast.Call):
+                chain = _attr_chain(value.func) or []
+                leaf = chain[-1] if chain else ""
+                lit = (
+                    value.args[0].value
+                    if value.args
+                    and isinstance(value.args[0], ast.Constant)
+                    and isinstance(value.args[0].value, str)
+                    else None
+                )
+                if leaf == "EnvKnob" and lit:
+                    for n in names:
+                        mod.knob_names[n] = lit
+                    an.declared_vars.add(lit)
+                if leaf in ("env_gate",) or leaf.endswith("env_gate"):
+                    if lit and len(names) >= 1:
+                        # enabled, disabled = env_gate("VAR")
+                        mod.gate_readers[names[0]] = lit
+                        an.declared_vars.add(lit)
+
+
+def _lint_comments(source: str) -> List[Tuple[int, str, Set[str]]]:
+    out = []
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _LINT_RE.search(line)
+        if m:
+            names = {n.strip() for n in m.group(2).split(",")}
+            out.append((i, m.group(1), names))
+    return out
+
+
+# ----------------------------------------------------------------------
+# key expressions, taint and closure-capture classification
+# ----------------------------------------------------------------------
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _assignments(
+    fn_node: ast.AST,
+) -> List[Tuple[Set[str], ast.AST, int, Set[str]]]:
+    """[(targets, value, line, condition_names)] for assignments directly
+    inside ``fn_node`` (nested defs excluded — their locals are their
+    own). ``condition_names`` are the names appearing in enclosing
+    if/while tests: an assignment under ``if gate_decision:`` is
+    control-dependent on the gate, which taint propagation must see
+    (e.g. ``if provably_sorted: _sorted = True``)."""
+    out = []
+
+    def walk(node, conds: Set[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Assign):
+                tg: Set[str] = set()
+                for t in child.targets:
+                    if isinstance(t, ast.Name):
+                        tg.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        tg.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        )
+                if tg:
+                    out.append((tg, child.value, child.lineno, set(conds)))
+            elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                if isinstance(child.target, ast.Name):
+                    out.append(
+                        ({child.target.id}, child.value, child.lineno, set(conds))
+                    )
+            elif isinstance(child, (ast.For, ast.AsyncFor)):
+                out.append(
+                    (_names_in(child.target), child.iter, child.lineno, set(conds))
+                )
+                walk(child, conds)
+            elif isinstance(child, (ast.If, ast.While)):
+                walk(child, conds | _names_in(child.test))
+            elif isinstance(child, ast.IfExp):
+                walk(child, conds | _names_in(child.test))
+            else:
+                walk(child, conds)
+
+    walk(fn_node, set())
+    return out
+
+
+def _bound_in_expr(value: ast.AST) -> Set[str]:
+    """Names bound INSIDE an expression (comprehension targets, lambda
+    params) — never free leaves of the enclosing scope."""
+    bound: Set[str] = set()
+    for n in ast.walk(value):
+        if isinstance(n, ast.comprehension):
+            bound |= _names_in(n.target)
+        elif isinstance(n, ast.Lambda):
+            bound |= _params(n)
+    return bound
+
+
+def _params(fn_node) -> Set[str]:
+    a = fn_node.args
+    names = {p.arg for p in a.args + a.kwonlyargs + a.posonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _calls_in(node: ast.AST) -> List[Tuple[str, ...]]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            chain = _attr_chain(n.func)
+            if not chain:
+                continue
+            if len(chain) == 1:
+                out.append(("name", chain[0]))
+            elif chain[0] in ("self", "cls") and len(chain) == 2:
+                out.append(("self", chain[1]))
+            else:
+                out.append(("attr", chain[-2], chain[-1]))
+    return out
+
+
+class _KeyContext:
+    """Key expression facts for one key-building function."""
+
+    def __init__(self, an: _Analysis, fi: FuncInfo):
+        self.an = an
+        self.fi = fi
+        self.mod = an.modules[fi.module]
+        self.assigns = _assignments(fi.node)
+        exprs = list(fi.key_exprs)
+        # `key = (...)` local assignment feeds `key`-named expressions and
+        # the cache.get(key) pattern
+        for tg, value, _ln, _cn in self.assigns:
+            if "key" in tg:
+                exprs.append(value)
+        self.key_names: Set[str] = set()
+        self.key_calls: List[Tuple[str, ...]] = []
+        for e in exprs:
+            self.key_names |= _names_in(e)
+            self.key_calls += _calls_in(e)
+        self.key_names |= fi.lint_keyed
+        # taint: local name -> set of env vars its value derives from
+        self.taint: Dict[str, Set[str]] = {}
+        resolver = _FuncCollector(an, self.mod, [])
+        for tg, value, _ln, _cn in self.assigns:
+            vars_here: Set[str] = set()
+            for n in ast.walk(value):
+                ev = _env_read_var(n)
+                if ev:
+                    vars_here.add(ev)
+            for desc in _calls_in(value):
+                callee = an.resolve_callee(desc, self.mod, fi)
+                if callee is not None:
+                    vars_here |= an.reads_full(callee)
+            # enabled()-style readers / knob reads resolved via module facts
+            for n in ast.walk(value):
+                if isinstance(n, ast.Call):
+                    chain = _attr_chain(n.func)
+                    if chain:
+                        gv = resolver._gate_reader_var(chain)
+                        if gv:
+                            vars_here.add(gv)
+                        if chain[-1] in ("get", "raw", "truthy") and len(chain) > 1:
+                            kv = resolver._knob_var(chain[:-1])
+                            if kv:
+                                vars_here.add(kv)
+            if vars_here:
+                for t in tg:
+                    self.taint.setdefault(t, set()).update(vars_here)
+        # propagate through name references AND control dependence
+        # (`if gate_decision: x = True` taints x); two rounds cover the
+        # chained x = f(gate); y = g(x); `if y: z = ...` shapes
+        for _round in range(2):
+            for tg, value, _ln, conds in self.assigns:
+                inherited: Set[str] = set()
+                for n in _names_in(value) | conds:
+                    inherited |= self.taint.get(n, set())
+                if inherited:
+                    for t in tg:
+                        self.taint.setdefault(t, set()).update(inherited)
+
+    def var_satisfied(self, var: str, origin: FuncInfo) -> bool:
+        fi = self.fi
+        if var in fi.lint_key or var in origin.lint_key:
+            return True
+        # declarative comment anywhere on the path: the origin's enclosing
+        # chain counts (a read inside a nested helper annotated at its def)
+        parent = origin.parent
+        while parent:
+            pf = self.an.funcs.get(parent)
+            if pf is None:
+                break
+            if var in pf.lint_key:
+                return True
+            parent = pf.parent
+        if exemption_reason(fi.qualname, var) or exemption_reason(
+            origin.qualname, var
+        ):
+            return True
+        for desc in self.key_calls:
+            callee = self.an.resolve_callee(desc, self.mod, fi)
+            if callee is not None and var in self.an.reads_full(callee):
+                return True
+        for n in self.key_names:
+            if var in self.taint.get(n, set()):
+                return True
+        return False
+
+
+def _enclosing_key_context(an: _Analysis, fi: FuncInfo) -> Optional[FuncInfo]:
+    """Innermost enclosing function that is a key builder or has a `key`
+    local — the keying scope a kernel body is checked against."""
+    q = fi.parent
+    while q:
+        f = an.funcs[q]
+        if f.is_key_builder:
+            return f
+        for tg, _v, _ln, _cn in _assignments(f.node):
+            if "key" in tg:
+                return f
+        q = f.parent
+    return None
+
+
+_BUILTINS = set(dir(builtins))
+
+
+def _free_names(fi: FuncInfo) -> Set[str]:
+    """Names loaded in ``fi`` that are not bound locally (approximate
+    closure captures)."""
+    node = fi.node
+    bound = _params(node)
+    for tg, _v, _ln, _cn in _assignments(node):
+        bound |= tg
+    for n in ast.walk(node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if n is not node:
+                bound.add(n.name)
+        elif isinstance(n, ast.comprehension):
+            bound |= _names_in(n.target)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            bound.add(n.name)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for a in n.names:
+                bound.add(a.asname or a.name.split(".")[0])
+        elif isinstance(n, ast.With):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    bound |= _names_in(item.optional_vars)
+    loads = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            loads.add(n.id)
+    return loads - bound - _BUILTINS
+
+
+def _check_baked_constants(
+    an: _Analysis, kf: FuncInfo, kctx: _KeyContext, findings: List[Finding],
+    path: str,
+) -> None:
+    fi = kctx.fi
+    mod = an.modules[fi.module]
+    # collect the kernel's effective free names, following locally-defined
+    # helper functions it calls (their captures bake the same way)
+    seen_fns: Set[str] = set()
+    free: Set[str] = set()
+
+    def add_free(f: FuncInfo) -> None:
+        if f.qualname in seen_fns:
+            return
+        seen_fns.add(f.qualname)
+        for name in _free_names(f):
+            # locally-defined function in an enclosing scope -> recurse
+            target = None
+            q = f.parent
+            while q:
+                pf = an.funcs[q]
+                for nq in pf.nested:
+                    if nq.rsplit(".", 1)[-1] == name:
+                        target = an.funcs[nq]
+                        break
+                if target:
+                    break
+                q = pf.parent
+            if target is not None:
+                add_free(target)
+            else:
+                free.add(name)
+
+    add_free(kf)
+
+    # enclosing assignment/param map (builder chain up to the key context)
+    chain_fns: List[FuncInfo] = []
+    q = kf.parent
+    while q:
+        chain_fns.append(an.funcs[q])
+        if q == fi.qualname:
+            break
+        q = an.funcs[q].parent
+    assigns: Dict[str, ast.AST] = {}
+    params: Set[str] = set()
+    declared_ok: Set[str] = set()
+    for f in chain_fns:
+        declared_ok |= f.lint_keyed | f.lint_operand
+        for tg, value, _ln, _cn in _assignments(f.node):
+            for t in tg:
+                assigns.setdefault(t, value)
+        params |= _params(f.node)
+    declared_ok |= kf.lint_keyed | kf.lint_operand
+
+    def source_safe(name: str, stack: Set[str]) -> bool:
+        if name in kctx.key_names or name in declared_ok:
+            return True
+        if name in mod.module_names or name in mod.alias_to_module:
+            return True
+        if name in _BUILTINS:
+            return True
+        if name in ("ctx", "cls"):
+            return True
+        vars_ = kctx.taint.get(name)
+        if vars_ and all(kctx.var_satisfied(v, kf) for v in vars_):
+            return True
+        if name in stack:
+            return True
+        if name in assigns:
+            stack.add(name)
+            value = assigns[name]
+            if isinstance(value, ast.Constant):
+                stack.discard(name)
+                return True
+            # leaf descriptors: plain loaded names minus names bound
+            # inside the expression itself (comprehension targets,
+            # lambda params); attribute accesses of the form
+            # <base>.ctx are per-context state and drop their base
+            leaves: Set[str] = set()
+            for n in ast.walk(value):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                    leaves.add(n.id)
+            leaves -= _bound_in_expr(value)
+            for n in ast.walk(value):
+                if isinstance(n, ast.Attribute) and n.attr == "ctx":
+                    base = _attr_chain(n)
+                    if base:
+                        leaves.discard(base[0])
+            ok = all(source_safe(leaf, stack) for leaf in leaves)
+            stack.discard(name)
+            return ok
+        if name in params:
+            return False  # un-keyed caller-supplied value
+        return False
+
+    for name in sorted(free):
+        if name in mod.module_names or name in mod.alias_to_module:
+            continue
+        if name not in assigns and name not in params:
+            continue  # unresolved (builtin-ish); not a capture we track
+        if source_safe(name, set()):
+            continue
+        node = assigns.get(name)
+        line = getattr(node, "lineno", kf.node.lineno)
+        findings.append(
+            Finding(
+                rule="baked-constant",
+                file=path,
+                line=line,
+                func=kf.qualname,
+                name=name,
+                message=(
+                    "closure-captured value enters a jit/shard_map body as "
+                    "a baked constant; thread it into the kernel cache key, "
+                    "pass it as an operand, or declare `# lint: keyed="
+                    f"{name}` / `# lint: operand={name}` with the audited "
+                    "mechanism"
+                ),
+            )
+        )
+
+
+# ----------------------------------------------------------------------
+# entry point
+# ----------------------------------------------------------------------
+def default_knob_kinds() -> Dict[str, str]:
+    """var -> kind from the live envgate registry."""
+    from ..utils.envgate import REGISTRY
+
+    return {var: knob.kind for var, knob in REGISTRY.items()}
+
+
+def run_ast_pass(
+    root: str,
+    package: Optional[str] = None,
+    knob_kinds: Optional[Dict[str, str]] = None,
+    files: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every AST rule over ``root`` (a package directory).
+
+    ``package``: dotted prefix for module names (``"cylon_tpu"`` for the
+    live tree; fixtures pass None). ``knob_kinds`` defaults to the live
+    envgate registry.
+    """
+    kinds = dict(knob_kinds if knob_kinds is not None else default_knob_kinds())
+    an = _Analysis(kinds)
+    paths = list(files) if files else sorted(
+        os.path.join(dp, f)
+        for dp, _dn, fns in os.walk(root)
+        for f in fns
+        if f.endswith(".py")
+    )
+    sources: Dict[str, str] = {}
+    for path in paths:
+        with open(path, "r") as fh:
+            src = fh.read()
+        sources[path] = src
+        tree = ast.parse(src, filename=path)
+        name = _module_name(root, path, package)
+        an.modules[name] = ModuleInfo(
+            name=name, path=path, tree=tree,
+            is_pkg=os.path.basename(path) == "__init__.py",
+        )
+    for mod in an.modules.values():
+        _collect_module_scaffold(an, mod)
+    for mod in an.modules.values():
+        collector = _FuncCollector(an, mod, _lint_comments(sources[mod.path]))
+        collector.visit(mod.tree)
+
+    findings: List[Finding] = []
+    envgate_mod = f"{package}.utils.envgate" if package else None
+    # the sanctioned accessor module reads os.environ with non-literal
+    # names by design; its reads are attributed at knob/gate call sites
+    if envgate_mod in an.modules:
+        for fi in an.modules[envgate_mod].functions.values():
+            fi.direct_reads = []
+
+    # rule: unregistered-env-read (the sanctioned accessor module itself
+    # and declarations are exempt — they ARE the registry)
+    for mod in an.modules.values():
+        if mod.name == envgate_mod:
+            continue
+        for fi in mod.functions.values():
+            for var, line in fi.direct_reads:
+                if var.startswith(ENV_PREFIX) and var not in an.declared_vars:
+                    findings.append(
+                        Finding(
+                            rule="unregistered-env-read",
+                            file=mod.path,
+                            line=line,
+                            func=fi.qualname,
+                            name=var,
+                            message=(
+                                "raw environment read of an undeclared "
+                                "knob; declare it in utils/envgate.py "
+                                "(kind + keyed_via) and read it through "
+                                "the knob"
+                            ),
+                        )
+                    )
+
+    # rule: gate-not-in-key
+    for mod in an.modules.values():
+        for fi in mod.functions.values():
+            if not fi.is_key_builder:
+                continue
+            kctx = _KeyContext(an, fi)
+            reported: Set[Tuple[str, str]] = set()
+            for var, line, origin_q in an.reads_scoped(fi.qualname):
+                kind = kinds.get(var)
+                if kind is not None and kind not in KEYED_KINDS:
+                    continue
+                # undeclared knobs are policed only inside the framework
+                # namespace (foreign vars like XLA_FLAGS are jax's to key)
+                if kind is None and not var.startswith(ENV_PREFIX):
+                    continue
+                origin = an.funcs[origin_q]
+                if kctx.var_satisfied(var, origin):
+                    continue
+                if (fi.qualname, var) in reported:
+                    continue
+                reported.add((fi.qualname, var))
+                findings.append(
+                    Finding(
+                        rule="gate-not-in-key",
+                        file=an.modules[origin.module].path,
+                        line=line,
+                        func=fi.qualname,
+                        name=var,
+                        message=(
+                            f"gate read (in {origin_q}) is reachable from "
+                            "this cache-key builder but is not threaded "
+                            "into the key: add it to the key tuple, route "
+                            "it through a keyed carrier (e.g. impl_tag), "
+                            "or declare `# lint: key=" + var + "` with the "
+                            "audited mechanism"
+                        ),
+                    )
+                )
+
+    # rules: trace-time-read + baked-constant (kernel bodies)
+    for mod in an.modules.values():
+        for fi in mod.functions.values():
+            if not fi.is_kernel_body:
+                continue
+            # trace-time reads: every env read reachable from the kernel
+            # body whose declared kind promises host-only resolution
+            seen: Set[str] = set()
+            for var, line, origin_q in an.reads_scoped(fi.qualname):
+                kind = kinds.get(var)
+                if kind in KEYED_KINDS or kind is None:
+                    continue
+                if (var, origin_q) in seen:
+                    continue
+                seen.add((var, origin_q))
+                origin = an.funcs[origin_q]
+                if var in origin.lint_key or var in fi.lint_key:
+                    continue
+                if exemption_reason(origin_q, var):
+                    continue
+                findings.append(
+                    Finding(
+                        rule="trace-time-read",
+                        file=an.modules[origin.module].path,
+                        line=line,
+                        func=fi.qualname,
+                        name=var,
+                        message=(
+                            f"knob of kind {kind!r} (declared host-only) "
+                            f"is read at trace time (in {origin_q}) inside "
+                            "a kernel body — resolve it on the host and "
+                            "pass the result through the cache key or an "
+                            "operand"
+                        ),
+                    )
+                )
+            kcf = _enclosing_key_context(an, fi)
+            if kcf is not None:
+                kctx = _KeyContext(an, kcf)
+                _check_baked_constants(an, fi, kctx, findings, mod.path)
+
+    return findings
+
+
+def check_no_blanket_exemptions() -> List[str]:
+    """Audit the exemption registry itself: every entry must name a
+    concrete gate variable and carry a substantive reason."""
+    problems = []
+    for (scope, var), reason in EXEMPT.items():
+        if var == "*" or not var.startswith(ENV_PREFIX):
+            problems.append(f"exemption ({scope}, {var}) is not gate-specific")
+        if len(reason.strip()) < 20:
+            problems.append(
+                f"exemption ({scope}, {var}) lacks an audited reason"
+            )
+    return problems

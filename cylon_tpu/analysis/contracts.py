@@ -1,0 +1,223 @@
+"""The machine-readable collective/host-sync contract table.
+
+This is the single source of truth for "how many collectives may this
+path issue, and where may it touch the host". The hand-written pins in
+``tests/test_shuffle_chunked.py`` / ``tests/test_semi_filter.py``
+re-export these constants instead of carrying their own literals, the
+jaxpr layer of ``python -m tools.graft_lint`` checks every contract
+against a registry of representative plans traced on a dryrun mesh
+(:mod:`.plans`), and CI runs both.
+
+Contract semantics
+------------------
+- ``collectives``: exact TOTAL traced collective-primitive count for one
+  warm execution of the op, as a function of the round count K (the
+  census walker scales ``scan`` bodies by trip count, so fused K-round
+  programs count correctly).
+- per-primitive bounds (``all_to_all`` etc.): exact counts by primitive
+  name.
+- ``host_syncs``: exact device->host fetch count for one warm execution
+  — crucially K-INDEPENDENT for the chunked engine (a sync inside the
+  round dispatch loop would scale with K; that regression is the whole
+  point of the zero-host-sync round loop).
+- ``sync_sites``: the WHITELIST of function names allowed to fetch. For
+  the chunked shuffle that is exactly ``_shuffle_many`` — the count-phase
+  fetch and the ONE deferred round-count fetch after the last dispatch.
+  Any other site observed during the monitored run is a violation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple, Union
+
+Count = Union[int, Callable[[int], int]]
+
+
+def _eval(c: Optional[Count], k: int) -> Optional[int]:
+    if c is None:
+        return None
+    return c(k) if callable(c) else int(c)
+
+
+@dataclass(frozen=True)
+class CollectiveContract:
+    name: str
+    description: str
+    # exact totals (None = unconstrained), each an int or fn of round
+    # count K
+    collectives: Optional[Count] = None
+    all_to_all: Optional[Count] = None
+    all_gather: Optional[Count] = None
+    psum: Optional[Count] = None
+    # exact host fetches per warm execution; must be K-independent
+    host_syncs: Optional[Count] = None
+    # function names allowed to perform device->host fetches
+    sync_sites: Tuple[str, ...] = ()
+    # host-callback primitives allowed inside traced programs (none, for
+    # every shipped path)
+    allow_callbacks: bool = False
+
+    def check(
+        self,
+        census: "object",
+        k: int = 1,
+        sync_events: Optional[list] = None,
+    ) -> list:
+        """Violation strings for a measured (census, sync_events) pair.
+
+        ``census`` is a :class:`cylon_tpu.analysis.jaxpr_pass.Census`.
+        """
+        out = []
+        pairs = [
+            ("collectives", self.collectives, census.total),
+            ("all_to_all", self.all_to_all, census.counts.get("all_to_all", 0)),
+            ("all_gather", self.all_gather, census.counts.get("all_gather", 0)),
+            ("psum", self.psum, census.counts.get("psum", 0)),
+        ]
+        for label, want, got in pairs:
+            w = _eval(want, k)
+            if w is not None and got != w:
+                out.append(
+                    f"{self.name}: {label} = {got}, contract says {w} (K={k})"
+                )
+        if not self.allow_callbacks and census.host_callbacks:
+            out.append(
+                f"{self.name}: host-callback primitives inside traced "
+                f"programs: {census.host_callbacks}"
+            )
+        if sync_events is not None:
+            w = _eval(self.host_syncs, k)
+            if w is not None and len(sync_events) != w:
+                out.append(
+                    f"{self.name}: {len(sync_events)} host syncs, contract "
+                    f"says {w} (K={k}): "
+                    + ", ".join(e.site for e in sync_events)
+                )
+            bad = [e for e in sync_events if e.site not in self.sync_sites]
+            if bad:
+                out.append(
+                    f"{self.name}: host sync outside the whitelisted sites "
+                    f"{self.sync_sites}: "
+                    + ", ".join(f"{e.site} ({e.file}:{e.line})" for e in bad)
+                )
+        return out
+
+
+# ----------------------------------------------------------------------
+# the pinned numbers (tests re-export these — change them ONLY with the
+# engine change that moves them, never to green a failing pin)
+# ----------------------------------------------------------------------
+
+#: an eager distributed join issues exactly 2 payload collectives (one
+#: header-fused all_to_all per side) — down from 4 pre-fusion (PR 2)
+DIST_JOIN_PAYLOAD_COLLECTIVES = 2
+
+#: the semi-join sketch filter adds exactly ONE all_gather on top (PR 4)
+DIST_JOIN_SKETCH_COLLECTIVES = 1
+
+
+def shuffle_collectives(k: int) -> int:
+    """A K-round chunked shuffle issues exactly K collectives: the count
+    exchange rides the payload collective's header rows (PR 2)."""
+    return k
+
+
+def fused_join_collectives(respill: int) -> int:
+    """The fused join step: each side's shuffle is (1 + respill)
+    header-fused all_to_alls, plus the 2 overflow psums."""
+    return 2 * (1 + respill) + 2
+
+
+def fused_q3_collectives(respill: int, num_slices: int = 1) -> int:
+    """The fused join->groupby-SUM (q3) step: the pair's sliced shuffle
+    rounds (2 sides x num_slices x (1 + respill) fused all_to_alls) plus
+    3 psums — the 2 shuffle-overflow reductions and the global
+    grand-total psum the q3 shape adds."""
+    return 2 * num_slices * (1 + respill) + 3
+
+
+#: per-table host syncs of one chunked shuffle: the count-phase fetch and
+#: the ONE deferred round-count fetch after the last dispatch — both in
+#: ``_shuffle_many``, and K-independent by construction
+SHUFFLE_HOST_SYNCS_PER_TABLE = 2
+
+#: the only function allowed to fetch during a shuffle (the whitelisted
+#: deferred count fetch; see docs/ARCHITECTURE.md "Static invariants")
+SHUFFLE_SYNC_SITES = ("_shuffle_many",)
+
+CONTRACTS: Dict[str, CollectiveContract] = {
+    "shuffle_single": CollectiveContract(
+        name="shuffle_single",
+        description=(
+            "single-table K-round hash shuffle (eager engine): K fused "
+            "all_to_alls, 2 K-independent host syncs, both in "
+            "_shuffle_many"
+        ),
+        collectives=shuffle_collectives,
+        all_to_all=shuffle_collectives,
+        host_syncs=SHUFFLE_HOST_SYNCS_PER_TABLE,
+        sync_sites=SHUFFLE_SYNC_SITES,
+    ),
+    "shuffle_wire_packed": CollectiveContract(
+        name="shuffle_wire_packed",
+        description=(
+            "bit-width-narrowed shuffle (PR 5): the wire plan changes lane "
+            "layout, never the collective count or the sync discipline"
+        ),
+        collectives=shuffle_collectives,
+        all_to_all=shuffle_collectives,
+        host_syncs=SHUFFLE_HOST_SYNCS_PER_TABLE,
+        sync_sites=SHUFFLE_SYNC_SITES,
+    ),
+    "dist_join": CollectiveContract(
+        name="dist_join",
+        description=(
+            "eager distributed inner join, semi filter off: one "
+            "header-fused all_to_all per side, zero extra collectives; "
+            "pair count fetches + deferred round fetches in _shuffle_many "
+            "plus the ONE speculative-join stats fetch in Table.join"
+        ),
+        collectives=DIST_JOIN_PAYLOAD_COLLECTIVES,
+        all_to_all=DIST_JOIN_PAYLOAD_COLLECTIVES,
+        all_gather=0,
+        host_syncs=2 * SHUFFLE_HOST_SYNCS_PER_TABLE + 1,
+        sync_sites=SHUFFLE_SYNC_SITES + ("join",),
+    ),
+    "dist_join_semi": CollectiveContract(
+        name="dist_join_semi",
+        description=(
+            "semi-filtered distributed inner join: 2 payload all_to_alls "
+            "+ exactly 1 sketch all_gather; the filter adds NO host sync "
+            "(the filtered counts ride the existing count fetch)"
+        ),
+        collectives=DIST_JOIN_PAYLOAD_COLLECTIVES
+        + DIST_JOIN_SKETCH_COLLECTIVES,
+        all_to_all=DIST_JOIN_PAYLOAD_COLLECTIVES,
+        all_gather=DIST_JOIN_SKETCH_COLLECTIVES,
+        host_syncs=2 * SHUFFLE_HOST_SYNCS_PER_TABLE + 1,
+        sync_sites=SHUFFLE_SYNC_SITES + ("join",),
+    ),
+    "fused_join_step": CollectiveContract(
+        name="fused_join_step",
+        description=(
+            "fully fused distributed join program (pipeline.py): "
+            "2 x (1 + respill) header-fused all_to_alls + 2 overflow "
+            "psums, all inside ONE XLA program (K passed as 1 + respill)"
+        ),
+        # checked via jaxpr census with k = respill
+        collectives=lambda respill: fused_join_collectives(respill),
+        all_to_all=lambda respill: 2 * (1 + respill),
+        psum=2,
+    ),
+    "q3_fused_step": CollectiveContract(
+        name="q3_fused_step",
+        description=(
+            "fused join->groupby-SUM (TPC-H q3 shape) program: "
+            "2 x (1 + respill) fused all_to_alls + 3 psums (2 overflow "
+            "reductions + the global grand-total)"
+        ),
+        collectives=lambda respill: fused_q3_collectives(respill),
+        all_to_all=lambda respill: 2 * (1 + respill),
+        psum=3,
+    ),
+}

@@ -1,0 +1,144 @@
+"""Layer 2: the jaxpr pass — collective census + host-transfer detection.
+
+Walks closed jaxprs of traced programs (either via ``jax.make_jaxpr`` on
+a fused step, or via the engine's kernel recorder over a whole eager op)
+and counts collective primitives per name, scaling ``scan`` bodies by
+their static trip count (the fused K-round pipelines run their rounds in
+one scan — an unscaled walk under-reports by K). Host-callback
+primitives (``pure_callback`` & friends — in-program device->host
+transfers) are collected separately; no shipped path is allowed any.
+
+The contract table (:mod:`.contracts`) consumes the census; the plan
+registry (:mod:`.plans`) produces it for every representative plan.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import jax
+
+COLLECTIVE_PRIMS = (
+    "all_to_all",
+    "all_gather",
+    "all_gather_invariant",
+    "psum",
+    "psum_invariant",
+    "ppermute",
+    "pgather",
+    "reduce_scatter",
+)
+
+# in-program host transfers: a callback inside a dispatch-loop kernel is
+# a synchronous device->host round trip XLA cannot overlap away
+HOST_CALLBACK_PRIMS = (
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "callback",
+    "outside_call",
+    "host_callback_call",
+    "infeed",
+    "outfeed",
+)
+
+
+@dataclass
+class Census:
+    counts: Dict[str, int] = field(default_factory=dict)
+    # collectives that execute inside a `while` body (no static trip
+    # count: the census counts them once but records the loop context)
+    in_dynamic_loop: Dict[str, int] = field(default_factory=dict)
+    host_callbacks: List[str] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+    def merge_scaled(self, other: "Census", scale: int) -> None:
+        for k, v in other.counts.items():
+            self.counts[k] = self.counts.get(k, 0) + v * scale
+        for k, v in other.in_dynamic_loop.items():
+            self.in_dynamic_loop[k] = self.in_dynamic_loop.get(k, 0) + v
+        self.host_callbacks.extend(other.host_callbacks * max(scale, 1))
+
+
+def _subjaxprs(eqn):
+    def norm(v):
+        if hasattr(v, "eqns"):
+            return v
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            return inner
+        return None
+
+    for v in eqn.params.values():
+        sub = norm(v)
+        if sub is not None:
+            yield sub
+        elif isinstance(v, (list, tuple)):
+            for vi in v:
+                sub = norm(vi)
+                if sub is not None:
+                    yield sub
+
+
+def census_jaxpr(jaxpr, census: Census, in_while: bool = False) -> None:
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "scan":
+            trips = int(eqn.params.get("length", 1))
+            sub = Census()
+            for s in _subjaxprs(eqn):
+                census_jaxpr(s, sub, in_while)
+            census.merge_scaled(sub, trips)
+            continue
+        if prim == "while":
+            sub = Census()
+            for s in _subjaxprs(eqn):
+                census_jaxpr(s, sub, True)
+            census.merge_scaled(sub, 1)
+            continue
+        if prim in COLLECTIVE_PRIMS:
+            census.counts[prim] = census.counts.get(prim, 0) + 1
+            if in_while:
+                census.in_dynamic_loop[prim] = (
+                    census.in_dynamic_loop.get(prim, 0) + 1
+                )
+        if prim in HOST_CALLBACK_PRIMS:
+            census.host_callbacks.append(prim)
+        for s in _subjaxprs(eqn):
+            census_jaxpr(s, census, in_while)
+
+
+def census_fn(fn, *args, **kwargs) -> Census:
+    """Trace ``fn(*args)`` and census its closed jaxpr (nothing runs)."""
+    closed = jax.make_jaxpr(fn, **kwargs)(*args)
+    c = Census()
+    census_jaxpr(closed.jaxpr, c)
+    return c
+
+
+def census_recorded(op, warm: bool = True) -> Tuple[Census, int]:
+    """Run ``op`` under the engine's kernel recorder and census every
+    dispatched program: (merged census, number of recorded programs).
+    ``warm=True`` runs once first so compilation stays out of the
+    recorded call — identical discipline to
+    ``benchmarks.roofline.traced_collectives``."""
+    from ..engine import record_kernels, recorded_kernels
+
+    if warm:
+        op()
+    record_kernels(True)
+    try:
+        op()
+    finally:
+        kernels = recorded_kernels()
+        record_kernels(False)
+    total = Census()
+    for fn, args in kernels:
+        closed = jax.make_jaxpr(fn)(*args)
+        sub = Census()
+        census_jaxpr(closed.jaxpr, sub)
+        total.merge_scaled(sub, 1)
+    return total, len(kernels)

@@ -1,0 +1,288 @@
+"""The representative-plan registry for the jaxpr layer.
+
+Each entry builds a small but shape-faithful instance of one dispatch
+path on the dryrun mesh (8 virtual CPU devices), runs it warm under the
+kernel recorder + host-sync monitor, and checks the measured collective
+census and fetch sites against the contract table. ``python -m
+tools.graft_lint --jaxpr`` runs every entry; ``tests/test_analysis.py``
+runs them in-process on the shared test mesh.
+
+Paths covered (the ISSUE-6 registry):
+
+- ``shuffle_single``   — one-table hash shuffle at K = 1 and K > 1;
+- ``shuffle_wire_packed`` — narrow-int table whose wire plan engages;
+- ``dist_join``        — eager distributed inner join, semi filter off;
+- ``dist_join_semi``   — selective pair, sketch all_gather engaged;
+- ``fused_join_step``  — the fully fused join program (jaxpr census);
+- ``q3_fused_step``    — the fused join->groupby-SUM (q3) program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .contracts import CONTRACTS
+from .jaxpr_pass import Census, census_fn, census_recorded
+from .hostsync import sync_monitor
+
+
+@dataclass
+class PlanResult:
+    name: str
+    k: int
+    census: Census
+    sync_sites: List[str]
+    violations: List[str]
+
+
+def dryrun_context(world: int = 8):
+    """A CPU mesh context. The caller (tools/graft_lint) must have set
+    ``--xla_force_host_platform_device_count`` BEFORE jax initialized;
+    in-process test suites already run on the 8-device harness."""
+    import jax
+
+    import cylon_tpu as ct
+
+    devices = jax.devices()
+    if len(devices) < world:
+        raise RuntimeError(
+            f"dryrun mesh needs {world} devices, found {len(devices)}: set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before jax "
+            "initializes (tools/graft_lint does this automatically)"
+        )
+    return ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+
+
+def _measure(op: Callable, contract, k: int) -> PlanResult:
+    """Warm ``op`` outside the monitor, then census + sync-monitor one
+    warm execution and check the contract."""
+    op()
+    op()
+    with sync_monitor() as events:
+        census, _nprog = census_recorded(op, warm=False)
+    violations = contract.check(census, k=k, sync_events=events)
+    return PlanResult(
+        name=contract.name,
+        k=k,
+        census=census,
+        sync_sites=[e.site for e in events],
+        violations=violations,
+    )
+
+
+# ----------------------------------------------------------------------
+# plan builders
+# ----------------------------------------------------------------------
+def _shuffle_table(ctx, rng, n=4000):
+    import cylon_tpu as ct
+
+    return ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, 100, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+        },
+    )
+
+
+def run_shuffle_single(ctx, rng) -> List[PlanResult]:
+    from ..utils.tracing import report, reset_trace
+
+    t = _shuffle_table(ctx, rng)
+    out = []
+    contract = CONTRACTS["shuffle_single"]
+    for budget in (1 << 40, 8 * 16 * 12):  # K = 1 and K > 1
+        def op():
+            return t.shuffle(["k"], byte_budget=budget)
+
+        reset_trace()
+        op()
+        k = int(report("shuffle.")["shuffle.rounds"]["rows"])
+        out.append(_measure(op, contract, k))
+    return out
+
+
+def run_shuffle_wire_packed(ctx, rng) -> List[PlanResult]:
+    from ..utils.tracing import get_count, report, reset_trace
+
+    import cylon_tpu as ct
+
+    n = 4096
+    t = ct.Table.from_pydict(
+        ctx,
+        {
+            # narrow measured ranges: the wire plan's packed words beat
+            # the plain int32/int64 lanes and the gate engages
+            "k": rng.integers(0, 1 << 12, n).astype(np.int64),
+            "a": rng.integers(0, 1 << 6, n).astype(np.int64),
+            "b": rng.integers(0, 2, n).astype(bool),
+        },
+    )
+    contract = CONTRACTS["shuffle_wire_packed"]
+
+    def op():
+        return t.shuffle(["k"])
+
+    reset_trace()
+    op()
+    k = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    res = _measure(op, contract, k)
+    if not get_count("lane_pack.wire.applied"):
+        res.violations.append(
+            "shuffle_wire_packed: the wire-narrowing gate never engaged — "
+            "the plan is not exercising the packed-wire path"
+        )
+    return [res]
+
+
+def _join_pair(ctx, rng, n=2000):
+    import cylon_tpu as ct
+
+    lt = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, 200, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+        },
+    )
+    rt = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, 200, 3 * n // 4).astype(np.int32),
+            "w": rng.normal(size=3 * n // 4).astype(np.float32),
+        },
+    )
+    return lt, rt
+
+
+def _selective_pair(ctx, rng, n=4000):
+    """~10%-overlap keyspaces with payload columns wide enough to repay
+    the sketch collective (mirrors tests/test_semi_filter.py)."""
+    import cylon_tpu as ct
+
+    K = 6 * n
+    cols_l = {"k": rng.integers(0, K, n).astype(np.int32)}
+    cols_r = {
+        "k": rng.integers(int(0.9 * K), int(1.9 * K), n).astype(np.int32)
+    }
+    for i in range(3):
+        cols_l[f"v{i}"] = rng.normal(size=n).astype(np.float32)
+        cols_r[f"w{i}"] = rng.normal(size=n).astype(np.float32)
+    return (
+        ct.Table.from_pydict(ctx, cols_l),
+        ct.Table.from_pydict(ctx, cols_r),
+    )
+
+
+def run_dist_join(ctx, rng) -> List[PlanResult]:
+    from ..ops import sketch as _sk
+
+    lt, rt = _join_pair(ctx, rng)
+    contract = CONTRACTS["dist_join"]
+
+    def op():
+        return lt.distributed_join(rt, on="k", how="inner")
+
+    with _sk.disabled():
+        return [_measure(op, contract, 1)]
+
+
+def run_dist_join_semi(ctx, rng) -> List[PlanResult]:
+    from ..utils.tracing import get_count
+
+    lt, rt = _selective_pair(ctx, rng)
+    contract = CONTRACTS["dist_join_semi"]
+
+    def op():
+        return lt.distributed_join(rt, on="k", how="inner")
+
+    res = _measure(op, contract, 1)
+    if not get_count("shuffle.semi_filter.applied"):
+        res.violations.append(
+            "dist_join_semi: the semi filter never engaged — the plan is "
+            "not exercising the sketch path"
+        )
+    return [res]
+
+
+def _fused_step_census(ctx, make_step, respill: int, contract) -> PlanResult:
+    import jax
+    import jax.numpy as jnp
+
+    world, cap = ctx.world_size, 64
+    sds = jax.ShapeDtypeStruct
+    cols = [
+        (sds((world * cap,), jnp.int32), None),
+        (sds((world * cap,), jnp.float32), None),
+    ]
+    counts = sds((world,), jnp.int32)
+    step = make_step(respill)
+    census = census_fn(step, (cols, counts, cols, counts), ())
+    violations = contract.check(census, k=respill)
+    return PlanResult(
+        name=contract.name, k=respill, census=census,
+        sync_sites=[], violations=violations,
+    )
+
+
+def run_fused_join_step(ctx, _rng) -> List[PlanResult]:
+    from ..ops import join as _j
+    from ..parallel.pipeline import make_distributed_join_step
+
+    contract = CONTRACTS["fused_join_step"]
+
+    def make(respill):
+        return make_distributed_join_step(
+            ctx.mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,),
+            how=_j.INNER, bucket_cap=32, join_cap=512, respill=respill,
+        )
+
+    return [
+        _fused_step_census(ctx, make, respill, contract)
+        for respill in (0, 1, 2)
+    ]
+
+
+def run_q3_fused_step(ctx, _rng) -> List[PlanResult]:
+    from ..parallel.pipeline import make_join_groupby_step
+
+    contract = CONTRACTS["q3_fused_step"]
+
+    from ..ops import join as _j
+
+    def make(respill):
+        return make_join_groupby_step(
+            ctx.mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,),
+            agg_col_idx=1, how=_j.INNER, bucket_cap=32, join_cap=512,
+            group_cap=512, respill=respill,
+        )
+
+    return [
+        _fused_step_census(ctx, make, respill, contract)
+        for respill in (0, 1)
+    ]
+
+
+PLAN_RUNNERS = [
+    run_shuffle_single,
+    run_shuffle_wire_packed,
+    run_dist_join,
+    run_dist_join_semi,
+    run_fused_join_step,
+    run_q3_fused_step,
+]
+
+
+def run_all(ctx=None, seed: int = 7) -> List[PlanResult]:
+    """Run every registered plan; ``ctx=None`` builds the dryrun mesh."""
+    if ctx is None:
+        ctx = dryrun_context()
+    results: List[PlanResult] = []
+    for runner in PLAN_RUNNERS:
+        rng = np.random.default_rng(seed)
+        results.extend(runner(ctx, rng))
+    return results

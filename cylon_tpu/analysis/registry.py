@@ -1,0 +1,35 @@
+"""Audited exemptions for the AST pass.
+
+Policy: NO blanket ignores. Every entry names one concrete gate variable,
+scopes it to a function (or ``"*"`` for a gate whose audit is global),
+and records the reviewed reason the gate does not need to be threaded
+into the reachable cache key. ``test_analysis.py`` asserts this shape
+(:func:`cylon_tpu.analysis.ast_pass.check_no_blanket_exemptions`), so an
+exemption can never silently widen into an ignore-all.
+
+Prefer a ``# lint: key=<VAR>`` comment AT the read site when the gate is
+threaded by a mechanism the analyzer cannot see (e.g. get_kernel's
+wrapping-flag key components); use this registry only for gates whose
+audit is genuinely site-independent.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+# (function-qualname-suffix | "*", env var) -> audited reason
+EXEMPT: Dict[Tuple[str, str], str] = {
+    ("*", "CYLON_TPU_TRACE"): (
+        "observability only: trace_enabled() gates span LOGGING in "
+        "utils/tracing.py; no traced program or key decision reads it"
+    ),
+}
+
+
+def exemption_reason(qualname: str, var: str) -> Optional[str]:
+    r = EXEMPT.get(("*", var))
+    if r:
+        return r
+    for (scope, v), reason in EXEMPT.items():
+        if v == var and scope != "*" and qualname.endswith(scope):
+            return reason
+    return None

@@ -14,8 +14,9 @@ concrete backends are TPU-native:
 from __future__ import annotations
 
 import enum
-import os
 from typing import Any, Dict, Optional, Sequence
+
+from .utils import envgate as _envgate
 
 # ----------------------------------------------------------------------
 # chunked-shuffle byte budget (parallel/shuffle.py plan_rounds)
@@ -38,7 +39,7 @@ def shuffle_byte_budget(configured: Optional[object] = None) -> int:
     module default."""
     if configured:
         return int(configured)
-    env = os.environ.get("CYLON_TPU_SHUFFLE_BUDGET", "")
+    env = _envgate.SHUFFLE_BUDGET.get()
     if env:
         return int(env)
     return DEFAULT_SHUFFLE_BYTE_BUDGET
@@ -73,7 +74,7 @@ def sketch_bits(configured: Optional[object] = None) -> int:
     the CYLON_TPU_SKETCH_BITS env var, then the module default."""
     if configured:
         return int(configured)
-    env = os.environ.get("CYLON_TPU_SKETCH_BITS", "")
+    env = _envgate.SKETCH_BITS.get()
     if env:
         return int(env)
     return DEFAULT_SKETCH_BITS

@@ -39,7 +39,9 @@ def _enable_compile_cache(platform: str) -> None:
     _compile_cache_set = True
     import os
 
-    loc = os.environ.get("CYLON_TPU_COMPILE_CACHE", "")
+    from .utils import envgate as _envgate
+
+    loc = _envgate.COMPILE_CACHE.get()
     if loc == "0":
         return
     if platform == "cpu" and not loc:

@@ -38,7 +38,9 @@ def _asan() -> bool:
     AddressSanitizer — the analog of the reference's Debug build
     (-fsanitize=address, cpp/CMakeLists.txt:57). Loading the instrumented
     .so additionally requires libasan to be LD_PRELOADed (see get_lib)."""
-    return os.environ.get("CYLON_TPU_NATIVE_ASAN", "0") == "1"
+    from ..utils import envgate as _envgate
+
+    return _envgate.NATIVE_ASAN.get() == "1"
 
 
 def _asan_runtime_loaded() -> bool:
@@ -204,7 +206,9 @@ def get_lib():
     with _lock:
         if _lib_handle is not None or _load_failed:
             return _lib_handle
-        if os.environ.get("CYLON_TPU_NO_NATIVE"):
+        from ..utils import envgate as _envgate
+
+        if _envgate.NO_NATIVE.raw():
             _load_failed = True
             return None
         if _asan() and not _asan_runtime_loaded():

@@ -196,14 +196,16 @@ def impl_tag() -> tuple:
     time, so any kernel cached by an env-independent key (ctx._jit_cache via
     engine.get_kernel) would silently keep the impl it was first compiled
     with after a mid-process env flip. Join-family cache keys append this
-    tag so an A/B flip recompiles instead of reusing the stale program."""
-    import os
+    tag so an A/B flip recompiles instead of reusing the stale program.
+    The analyzer (cylon_tpu/analysis) treats a call to this function inside
+    a key expression as the keyed carrier of all four knobs."""
+    from ..utils import envgate as _eg
 
     return (
-        os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter"),
-        os.environ.get("CYLON_TPU_SEGSUM_IMPL", "scatter"),
-        os.environ.get("CYLON_TPU_EMIT_IMPL", "gather"),
-        os.environ.get("CYLON_TPU_EXPAND_GATHER", "take"),
+        _eg.REPEAT_IMPL.get(),
+        _eg.SEGSUM_IMPL.get(),
+        _eg.EMIT_IMPL.get(),
+        _eg.EXPAND_GATHER.get(),
     )
 
 
@@ -221,10 +223,10 @@ def _repeat_ss(ends: jax.Array, cap_out: int) -> jax.Array:
     combined double-argsort replaces the repeat's scatter+cumsum lowering.
     (Kept selectable: round-2 measurements showed XLA TPU scatters can lose
     to sorts in other fusion contexts.)"""
-    import os
+    from ..utils import envgate as _eg
 
     n = ends.shape[0]
-    if os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter") == "scatter":
+    if _eg.REPEAT_IMPL.get() == "scatter":
         starts = jnp.concatenate([jnp.zeros((1,), ends.dtype), ends[:-1]])
         cnt = ends - starts
         rows = jnp.arange(n, dtype=jnp.int32)
@@ -538,9 +540,9 @@ def emit_impl_for(world_size: int, platform: str) -> str:
     validates compiled-on-hardware under shard_map. The whole path stays
     opt-in behind CYLON_TPU_EMIT_IMPL=windowed, so the default join never
     depends on it."""
-    import os
+    from ..utils import envgate as _eg
 
-    if os.environ.get("CYLON_TPU_EMIT_IMPL", "gather") != "windowed":
+    if _eg.EMIT_IMPL.get() != "windowed":
         return "gather"
     from .pallas_gather import expand_available
 
@@ -560,7 +562,7 @@ def emit_impl_kwargs(ctx) -> Tuple[str, dict]:
     multi-device meshes run the pallas_call per-shard inside shard_map,
     UNJITTED (expand_rows_raw) — the nested jit was the round-3 recursion
     trigger."""
-    import os
+    from ..utils import envgate as _eg
 
     impl = emit_impl_for(
         ctx.world_size, ctx.mesh.devices.flat[0].platform
@@ -572,7 +574,9 @@ def emit_impl_kwargs(ctx) -> Tuple[str, dict]:
     # the exact multi-chip construction — compiled pallas inside
     # jit(shard_map) — on the single real chip (get_kernel keys include the
     # wrapping flags, so this cannot alias the unwrapped program)
-    force_sm = os.environ.get("CYLON_TPU_FORCE_SHARD_MAP", "0") == "1"
+    # lint: key=CYLON_TPU_FORCE_SHARD_MAP -- threaded via get_kernel's
+    # wrapping-flag key components (use_shard_map/check_vma join every key)
+    force_sm = _eg.FORCE_SHARD_MAP.get() == "1"
     return impl, {
         "check_vma": False,
         "use_shard_map": ctx.world_size > 1 or force_sm,
@@ -653,12 +657,11 @@ def _emit_inner_left_windowed(
     same scatter/expand, reconstructing the right-side run positions without
     any second repeat. The right gather is unchanged (its positions are not
     monotone in original-left emit order)."""
-    import os
-
+    from ..utils import envgate as _eg
     from .gather import pack_cols, pack_gather, unpack_cols
     from .pallas_gather import expand_rows_raw
 
-    impl = os.environ.get("CYLON_TPU_EXPAND_GATHER", "take")
+    impl = _eg.EXPAND_GATHER.get()
     cap_l = lo.shape[0]
     idx_l = jnp.arange(cap_l, dtype=jnp.int32)
     live_l = idx_l < nl
@@ -925,9 +928,9 @@ def join_sum_by_key_pushdown(
 
     # segment scatter-adds into group slots; rows past group_cap drop (the
     # unclamped ng reveals the truncation to the caller)
-    import os
+    from ..utils import envgate as _eg
 
-    if os.environ.get("CYLON_TPU_SEGSUM_IMPL", "scatter") == "sorted":
+    if _eg.SEGSUM_IMPL.get() == "sorted":
         # gid is monotone non-decreasing over sorted space, so the scatter
         # indices are sorted — XLA's TPU lowering can then accumulate
         # sequentially instead of the general scatter path. Non-group rows

@@ -86,7 +86,12 @@ _NULL_ENC = np.uint32(0xFFFFFFFF)  # nulls-last orderable sentinel (set ops)
 # the CYLON_TPU_NO_SEMI_FILTER=1 kill switch: enabled() turns every
 # sketch consumer off; disabled() is the differential-oracle toggle
 # (shared machinery with ordering.py's gate — utils/envgate.py)
-enabled, disabled = env_gate("CYLON_TPU_NO_SEMI_FILTER")
+enabled, disabled = env_gate(
+    "CYLON_TPU_NO_SEMI_FILTER",
+    keyed_via="the shuffle key carries the semi statics (probe_row, "
+    "use_range) only when a sketch is attached; the plan fingerprint "
+    "includes the gate (plan/lazy.py)",
+)
 
 
 def join_filter_sides(how: str) -> Optional[str]:

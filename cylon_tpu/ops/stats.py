@@ -53,7 +53,12 @@ from .sort import KeyCol, orderable_key
 
 # the CYLON_TPU_NO_LANE_PACK=1 kill switch (shared machinery with the
 # ordering/semi-filter toggles — utils/envgate.py)
-enabled, disabled = env_gate("CYLON_TPU_NO_LANE_PACK")
+enabled, disabled = env_gate(
+    "CYLON_TPU_NO_LANE_PACK",
+    keyed_via="stat_cols / quantized fuse plans / WirePlan statics join "
+    "every consumer kernel cache key; the plan fingerprint includes the "
+    "gate (plan/lazy.py)",
+)
 
 _MAXU64 = np.uint64(0xFFFFFFFFFFFFFFFF)
 

@@ -100,7 +100,12 @@ def validate(ordering: Optional[Ordering], column_names) -> Optional[Ordering]:
 # the semi-filter gate (utils/envgate.py).
 from .utils.envgate import env_gate as _env_gate
 
-enabled, disabled = _env_gate("CYLON_TPU_NO_ORDERING")
+enabled, disabled = _env_gate(
+    "CYLON_TPU_NO_ORDERING",
+    keyed_via="every consumer gate decision (r_presorted, sorted-input "
+    "fast paths, sort elisions) joins its kernel cache key; the plan "
+    "fingerprint includes the gate (plan/lazy.py)",
+)
 
 
 def covers_prefix(

@@ -48,13 +48,21 @@ from .utils.tracing import bump, gauge, span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
-# single-dispatch speculative join (see Table.join); CYLON_TPU_EXACT_JOIN=1
-# forces the exact two-phase count->emit path
 import operator as _op
-import os as _os
 import time as _time
 
-_SPECULATIVE_JOIN = _os.environ.get("CYLON_TPU_EXACT_JOIN", "0") != "1"
+from .utils import envgate as _eg
+
+
+def _speculative_join() -> bool:
+    """Single-dispatch speculative join gate (see Table.join);
+    CYLON_TPU_EXACT_JOIN=1 forces the exact two-phase count->emit path.
+    Read per call (not at import) so a mid-process flip takes effect: the
+    two paths dispatch under distinct key suffixes ('spec' vs
+    'probe'/'emit'), so the flip can never alias compiled programs."""
+    # lint: key=CYLON_TPU_EXACT_JOIN -- dispatch-path selection between
+    # distinctly-keyed programs (see envgate.EXACT_JOIN.keyed_via)
+    return _eg.EXACT_JOIN.get() != "1"
 
 
 def _scalar(x) -> jax.Array:
@@ -308,6 +316,10 @@ class Table:
         tables that came through a shuffle already carry bounds (the count
         pass measured them) and pay nothing here. Returns {} when the
         CYLON_TPU_NO_LANE_PACK kill switch is on."""
+        # lint: key=CYLON_TPU_NO_LANE_PACK -- the gate short-circuits BEFORE
+        # any kernel dispatch (no stats kernel runs at all when off); the
+        # stats kernel body itself is gate-independent, and every consumer
+        # keys its derived fuse/wire plan (None when stats are absent)
         if not _st.enabled():
             return {}
         out: Dict[str, Optional["_st.ColStat"]] = {}
@@ -1467,7 +1479,7 @@ class Table:
             )
         if r_presorted:
             bump("ordering.join_presorted_probe")
-        if _SPECULATIVE_JOIN:
+        if _speculative_join():
             # INNER/LEFT/RIGHT: max(cap_l, cap_r) covers every <=1-match-per-
             # key workload at HALF the emit/gather width of cap_l + cap_r;
             # overflow falls back to the exact two-phase path below AND
@@ -1929,10 +1941,14 @@ class Table:
         lflat = left._flat_cols()
         rflat = right._flat_cols()
         group_cap = min(left.shard_cap, right.shard_cap)
+        # impl_tag: the kernel reads CYLON_TPU_SEGSUM_IMPL at trace time
+        # (join_sum_by_key_pushdown's scatter discipline) — graft-lint's
+        # first live catch: without the tag a mid-process flip kept the
+        # stale program
         key = (
             "join_sum_pushdown", lk_idx, rk_idx, val_idx, len(lflat),
             len(rflat), group_cap,
-        )
+        ) + _j.impl_tag()
 
         def build():
             def kern(dp, rep):
@@ -2161,6 +2177,8 @@ class Table:
         out_pairs = [
             (n, c) for n, c in self._columns.items() if n != _order_col
         ]
+        # lint: keyed=out_idx -- fully determined by (len(flat), order_idx),
+        # both key components: out_idx is every column index except order_idx
         out_idx = tuple(all_names.index(n) for n, _ in out_pairs)
         flat = self._flat_cols()
         # Single-dispatch: dedup output is a subset of the input rows, so
@@ -3489,16 +3507,23 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 st["rounds_out"].append((out, nout))
         t_disp = _time.perf_counter()
 
-        # the ONE deferred sync: fetch every round's received counts,
-        # validate against the count-phase expectation, assemble tables
+        # the ONE deferred sync per table: every round's received counts
+        # come back in a single stacked fetch (fetching per round made the
+        # deferred-sync count scale with K — flagged by the graft-lint
+        # host-sync pass, which pins host_syncs as K-independent), then
+        # validate against the count-phase expectation and assemble tables
         for st in states:
             bump("host_sync")
             t = st["t"]
             src_pairs = list(zip(t.column_names, t._columns.values()))
             bc = st["bucket_cap"]
+            nouts = [nout for _out, nout in st["rounds_out"]]
+            got_all = _fetch(
+                nouts[0] if len(nouts) == 1 else jnp.stack(nouts)
+            ).reshape(len(nouts), -1).astype(np.int64)
             round_tables: List["Table"] = []
-            for r, (out, nout) in enumerate(st["rounds_out"]):
-                got = _fetch(nout).astype(np.int64)
+            for r, (out, _nout) in enumerate(st["rounds_out"]):
+                got = got_all[r]
                 expect = (
                     np.clip(st["send_counts"] - r * bc, 0, bc)
                     .sum(axis=0)
@@ -3985,12 +4010,16 @@ def _concat2(a: "Table", b: "Table") -> "Table":
 
         return kern
 
-    out, nout = get_kernel(ctx, key, build)(
+    out, _nout = get_kernel(ctx, key, build)(
         (aflat, bflat, a.counts_dev, b.counts_dev),
         (jnp.zeros((cap_out,), jnp.int8),),
     )
+    # new_counts is already known on the host (sum of the inputs' counts):
+    # fetching the kernel's count lane here was a redundant device->host
+    # sync on every multi-round shuffle's reassembly — flagged by the
+    # graft-lint host-sync pass (analysis/hostsync.py) and removed
     return a._rebuild_cols(
-        list(zip(names, a._columns.values())), out, _fetch(nout).astype(np.int64), cap_out
+        list(zip(names, a._columns.values())), out, new_counts, cap_out
     )
 
 

@@ -1,25 +1,126 @@
-"""Shared env-var kill-switch machinery for optimization gates.
+"""Declared environment knobs: the ONE registry of every ``CYLON_TPU_*``
+variable the framework reads, plus the shared kill-switch machinery.
 
-Several subsystems ship a ``CYLON_TPU_NO_<X>=1`` escape hatch whose OFF
-path doubles as the differential-testing oracle (ordering fast paths,
-the semi-join sketch filter). :func:`env_gate` builds the
-``enabled()`` / ``disabled()`` pair once so the save/set/restore toggle
-has exactly one implementation.
+Why a registry instead of scattered ``os.environ.get`` calls: PRs 1-5 each
+shipped "review hardening" fixes from the same bug family — a gate that
+changes kernel behavior but is missing from a kernel cache key, so a
+mid-process env flip silently reuses the program compiled under the other
+gate state. The static analyzer (``cylon_tpu/analysis``; ``python -m
+tools.graft_lint``) enforces that invariant mechanically, and it needs a
+machine-readable answer to "what kind of knob is this and how does it
+reach compiled programs?". Every knob therefore declares:
+
+- ``kind`` — the policy class the analyzer applies (see ``KINDS`` below);
+- ``keyed_via`` — for knobs that alter traced programs, the audited
+  description of the mechanism that threads them into the kernel cache
+  key / plan fingerprint (the analyzer verifies the mechanism exists for
+  ``impl``/``kill-switch`` kinds; for the others the declaration IS the
+  audit and the analyzer instead enforces the kind's read-site policy).
+
+Reading a ``CYLON_TPU_*`` variable through raw ``os.environ`` anywhere in
+``cylon_tpu/`` is itself a lint finding (rule ``unregistered-env-read``):
+new knobs start here.
 """
 from __future__ import annotations
 
 import contextlib
 import os
+from typing import Dict, Optional
+
+# ----------------------------------------------------------------------
+# knob kinds and the analyzer policy attached to each
+# ----------------------------------------------------------------------
+KINDS = {
+    # Read at TRACE time (inside a kernel body) or while choosing what a
+    # kernel body will contain: MUST be threaded into every consumer
+    # kernel's cache key (the analyzer verifies a keyed carrier exists).
+    "impl": "trace-time kernel-impl choice; must land in the cache key",
+    # VAR=1 disables an optimization; the gate decision changes traced
+    # programs, so consumers must key it exactly like an impl knob.
+    "kill-switch": "optimization escape hatch; gate decision must be keyed",
+    # Selects WHICH distinctly-keyed dispatch path runs; never read inside
+    # a kernel body (the analyzer enforces host-only reads).
+    "dispatch": "host-side path selection between distinctly-keyed programs",
+    # Host-resolved numeric tuning; reaches programs only through operand
+    # shapes / replicated operands, which jit keys intrinsically. Host-only
+    # reads enforced.
+    "tuning": "host-resolved sizing knob; reaches kernels via shapes only",
+    # Read once at import / context init, before any kernel exists.
+    "startup": "import/init-time configuration",
+    # Alters logging only, never a compiled program.
+    "observability": "logging/trace output only",
+    # Native-extension build configuration (no XLA program involvement).
+    "native": "native extension build/runtime config",
+}
+
+REGISTRY: Dict[str, "EnvKnob"] = {}
 
 
-def env_gate(var: str):
-    """(enabled, disabled) pair for a ``VAR=1``-disables gate.
+class EnvKnob:
+    """One declared environment variable. Instantiating registers it."""
+
+    __slots__ = ("var", "default", "kind", "keyed_via", "note")
+
+    def __init__(
+        self,
+        var: str,
+        default: str = "",
+        kind: str = "impl",
+        keyed_via: Optional[str] = None,
+        note: str = "",
+    ) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"unknown knob kind {kind!r} for {var}")
+        if kind in ("impl", "kill-switch") and not keyed_via:
+            raise ValueError(
+                f"{var}: kind={kind!r} requires keyed_via= (the audited "
+                "cache-key threading mechanism)"
+            )
+        self.var = var
+        self.default = default
+        self.kind = kind
+        self.keyed_via = keyed_via
+        self.note = note
+        REGISTRY[var] = self
+
+    def get(self) -> str:
+        """Current value (per-call read — flips take effect immediately)."""
+        return os.environ.get(self.var, self.default)
+
+    def raw(self) -> Optional[str]:
+        """Raw environment value, ``None`` when unset (no default)."""
+        return os.environ.get(self.var)
+
+    def truthy(self) -> bool:
+        """Set to anything non-empty and non-'0'."""
+        return self.get() not in ("", "0")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"EnvKnob({self.var!r}, kind={self.kind!r})"
+
+
+def env_gate(var: str, keyed_via: str = "", note: str = ""):
+    """(enabled, disabled) pair for a ``VAR=1``-disables kill switch.
 
     ``enabled()`` reads the env per call — gate flips between calls take
     effect immediately (consumers key compiled kernels on the chosen
     path, so flips recompile, never alias). ``disabled()`` is a
     reentrant save/set/restore context manager: the differential-oracle
-    toggle for tests and fuzz profiles."""
+    toggle for tests and fuzz profiles.
+
+    Declares the variable in the registry as a kill-switch; ``keyed_via``
+    documents (for the analyzer and for reviewers) the mechanism that
+    threads the gate decision into kernel cache keys / plan fingerprints.
+    """
+    EnvKnob(
+        var,
+        "0",
+        kind="kill-switch",
+        keyed_via=keyed_via
+        or "consumers thread each gate decision into their kernel cache "
+        "key; the plan fingerprint includes the gate (plan/lazy.py)",
+        note=note,
+    )
 
     def enabled() -> bool:
         return os.environ.get(var, "0") != "1"
@@ -37,3 +138,100 @@ def env_gate(var: str):
                 os.environ[var] = prev
 
     return enabled, disabled
+
+
+# ----------------------------------------------------------------------
+# knob declarations (kill-switch gates are declared at their consumer
+# modules via env_gate: CYLON_TPU_NO_ORDERING in ordering.py,
+# CYLON_TPU_NO_SEMI_FILTER in ops/sketch.py, CYLON_TPU_NO_LANE_PACK in
+# ops/stats.py)
+# ----------------------------------------------------------------------
+
+# -- trace-time kernel-impl choices (ops/join.py) -----------------------
+# All four are read while building join-family kernel bodies; impl_tag()
+# packages their values as the cache-key component every join-family key
+# appends, so a mid-process A/B flip recompiles instead of reusing the
+# stale program.
+REPEAT_IMPL = EnvKnob(
+    "CYLON_TPU_REPEAT_IMPL", "scatter", kind="impl",
+    keyed_via="ops.join.impl_tag appended to every join-family cache key",
+    note="repeat-expand lowering: 'scatter' (default, measured faster on "
+    "v5e) or 'sort' (argsort trick)",
+)
+SEGSUM_IMPL = EnvKnob(
+    "CYLON_TPU_SEGSUM_IMPL", "scatter", kind="impl",
+    keyed_via="ops.join.impl_tag appended to every join-family cache key",
+    note="segment-sum lowering in the fused join->groupby pushdown",
+)
+EMIT_IMPL = EnvKnob(
+    "CYLON_TPU_EMIT_IMPL", "gather", kind="impl",
+    keyed_via="ops.join.impl_tag appended to every join-family cache key",
+    note="join emit: 'gather' (default) or 'windowed' (Pallas expand)",
+)
+EXPAND_GATHER = EnvKnob(
+    "CYLON_TPU_EXPAND_GATHER", "take", kind="impl",
+    keyed_via="ops.join.impl_tag appended to every join-family cache key",
+    note="in-kernel gather flavor of the Pallas windowed expand",
+)
+FORCE_SHARD_MAP = EnvKnob(
+    "CYLON_TPU_FORCE_SHARD_MAP", "0", kind="impl",
+    keyed_via="engine.get_kernel appends its wrapping flags "
+    "(use_shard_map, check_vma) to every cache key",
+    note="keep shard_map on a 1-device mesh (hardware probe only)",
+)
+
+# -- host-side dispatch selection --------------------------------------
+EXACT_JOIN = EnvKnob(
+    "CYLON_TPU_EXACT_JOIN", "0", kind="dispatch",
+    keyed_via="speculative and exact paths dispatch under distinct key "
+    "suffixes ('spec' vs 'probe'/'emit'); no program aliasing",
+    note="=1 forces the exact two-phase count->emit join path",
+)
+
+# -- host-resolved tuning ----------------------------------------------
+SHUFFLE_BUDGET = EnvKnob(
+    "CYLON_TPU_SHUFFLE_BUDGET", "", kind="tuning",
+    keyed_via="budget -> bucket_cap -> static shapes of the round "
+    "kernels' rep operands (jit shape specialization)",
+    note="per-round shuffle exchange byte budget (config.py)",
+)
+SKETCH_BITS = EnvKnob(
+    "CYLON_TPU_SKETCH_BITS", "", kind="tuning",
+    keyed_via="bits -> sketch operand shapes + the 'semi_sketch' cache "
+    "key's bits component",
+    note="semi-join sketch bit cap (config.py)",
+)
+
+# -- import/init-time configuration ------------------------------------
+NO_X64 = EnvKnob(
+    "CYLON_TPU_NO_X64", "", kind="startup",
+    note="=1 skips jax_enable_x64 at import (pure-32-bit pipelines)",
+)
+PLATFORM = EnvKnob(
+    "CYLON_TPU_PLATFORM", "", kind="startup",
+    note="pin the jax platform before first backend touch",
+)
+COMPILE_EFFORT = EnvKnob(
+    "CYLON_TPU_COMPILE_EFFORT", "", kind="startup",
+    note="XLA scheduling-effort tradeoff, read once at import",
+)
+COMPILE_CACHE = EnvKnob(
+    "CYLON_TPU_COMPILE_CACHE", "", kind="startup",
+    note="persistent XLA compile cache location (context init)",
+)
+
+# -- observability ------------------------------------------------------
+TRACE = EnvKnob(
+    "CYLON_TPU_TRACE", "0", kind="observability",
+    note="=1 logs each tracing span as it closes; alters no program",
+)
+
+# -- native extension ---------------------------------------------------
+NATIVE_ASAN = EnvKnob(
+    "CYLON_TPU_NATIVE_ASAN", "0", kind="native",
+    note="build the native codecs under AddressSanitizer",
+)
+NO_NATIVE = EnvKnob(
+    "CYLON_TPU_NO_NATIVE", "", kind="native",
+    note="disable the native C++ codecs (pure-python fallbacks)",
+)

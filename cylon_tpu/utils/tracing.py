@@ -36,7 +36,9 @@ _stats: Dict[str, Dict[str, float]] = defaultdict(
 
 
 def trace_enabled() -> bool:
-    return os.environ.get("CYLON_TPU_TRACE", "0") == "1"
+    from .envgate import TRACE
+
+    return TRACE.get() == "1"
 
 
 @contextlib.contextmanager

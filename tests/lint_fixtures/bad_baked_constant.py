@@ -1,0 +1,20 @@
+"""Seeded known-bad fixture (graft-lint rule ``baked-constant``): a
+caller-supplied Python scalar is closure-captured into the jit body as a
+baked XLA constant — every new value silently recompiles (or worse, the
+cached program keeps the first value) because nothing threads it into
+the cache key and it never rides as an operand.
+"""
+from cylon_tpu.engine import get_kernel
+
+
+def bad_baked_constant(ctx, cols, threshold):
+    key = ("fixture_bad_baked", len(cols))
+
+    def build():
+        def kern(dp, rep):
+            (data, counts) = dp
+            return data > threshold
+
+        return kern
+
+    return get_kernel(ctx, key, build)(cols, ())

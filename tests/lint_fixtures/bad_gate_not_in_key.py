@@ -1,0 +1,22 @@
+"""Seeded known-bad fixture (graft-lint rule ``gate-not-in-key``): the
+kernel body reads CYLON_TPU_REPEAT_IMPL at trace time, but the cache key
+never sees it — a mid-process flip would silently reuse the stale
+program. tests/test_analysis.py asserts the AST pass flags exactly this.
+"""
+import os
+
+from cylon_tpu.engine import get_kernel
+
+
+def bad_gate_not_in_key(ctx, cols):
+    key = ("fixture_bad_gate", len(cols))
+
+    def build():
+        def kern(dp, rep):
+            if os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter") == "scatter":
+                return dp
+            return rep
+
+        return kern
+
+    return get_kernel(ctx, key, build)(cols, ())

@@ -1,0 +1,58 @@
+"""Known-GOOD twins of the seeded bad fixtures: the same shapes with the
+invariant held. The AST pass must stay silent on every function here —
+tests/test_analysis.py asserts zero findings against this file.
+"""
+import os
+
+from cylon_tpu.engine import get_kernel
+
+
+def good_gate_threaded(ctx, cols):
+    """The gate value is resolved on the host and TAINTS the key."""
+    impl = os.environ.get("CYLON_TPU_REPEAT_IMPL", "scatter")
+    key = ("fixture_good_gate", len(cols), impl)
+
+    def build():
+        def kern(dp, rep):
+            if impl == "scatter":
+                return dp
+            return rep
+
+        return kern
+
+    return get_kernel(ctx, key, build)(cols, ())
+
+
+def good_scalar_keyed(ctx, cols, threshold):
+    """The captured scalar is a key component: a new value compiles a new
+    program instead of aliasing the old one."""
+    key = ("fixture_good_baked", len(cols), threshold)
+
+    def build():
+        def kern(dp, rep):
+            (data, counts) = dp
+            return data > threshold
+
+        return kern
+
+    return get_kernel(ctx, key, build)(cols, ())
+
+
+def good_comment_declared(ctx, cols):
+    """A read threaded by a mechanism the analyzer cannot see, declared
+    at the site — the audited ``# lint: key=`` escape, never a blanket
+    ignore."""
+    # lint: key=CYLON_TPU_EMIT_IMPL -- fixture: stands in for a mechanism
+    # like get_kernel's wrapping-flag key components
+    impl = os.environ.get("CYLON_TPU_EMIT_IMPL", "gather")
+    key = ("fixture_good_comment", len(cols))
+
+    def build():
+        def kern(dp, rep):
+            if impl == "gather":
+                return dp
+            return rep
+
+        return kern
+
+    return get_kernel(ctx, key, build)(cols, ())

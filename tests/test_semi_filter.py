@@ -364,7 +364,15 @@ def test_filtered_join_collectives_and_sketch_bytes(devices, rng, monkeypatch):
     colls, per_bytes = traced_collectives(
         lambda: lt.distributed_join(rt, on="k", how="inner")
     )
-    assert colls == 3, f"expected 2 payload + 1 sketch collectives, got {colls}"
+    from cylon_tpu.analysis import contracts
+
+    expect = (
+        contracts.DIST_JOIN_PAYLOAD_COLLECTIVES
+        + contracts.DIST_JOIN_SKETCH_COLLECTIVES
+    )
+    assert colls == expect, (
+        f"expected {expect} (2 payload + 1 sketch) collectives, got {colls}"
+    )
     cap_bytes = 2 * _sk.sketch_len(32768) * 4
     assert min(per_bytes) <= cap_bytes, (per_bytes, cap_bytes)
 

@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 import cylon_tpu as ct
+from cylon_tpu.analysis import contracts
 from cylon_tpu.engine import round_cap
 from cylon_tpu.parallel import shuffle as _sh
 
@@ -57,7 +58,12 @@ def test_distributed_join_exactly_two_collectives(devices, rng):
         colls, _ = _traced_collectives(
             lambda: lt.distributed_join(rt, on="k", how="inner")
         )
-    assert colls == 2, f"expected 2 collectives per distributed join, traced {colls}"
+    # the pinned number lives in the contract table (analysis/contracts.py)
+    # — graft-lint checks the same constant against the plan registry
+    assert colls == contracts.DIST_JOIN_PAYLOAD_COLLECTIVES, (
+        f"expected {contracts.DIST_JOIN_PAYLOAD_COLLECTIVES} collectives "
+        f"per distributed join, traced {colls}"
+    )
 
 
 def test_single_shuffle_one_collective_per_round(devices, rng):
@@ -78,7 +84,9 @@ def test_single_shuffle_one_collective_per_round(devices, rng):
         colls, _ = _traced_collectives(
             lambda: t.shuffle(["k"], byte_budget=budget)
         )
-        assert colls == rounds, (budget, rounds, colls)
+        assert colls == contracts.shuffle_collectives(rounds), (
+            budget, rounds, colls,
+        )
 
 
 def test_fused_pipeline_collectives_halved(devices):
@@ -106,7 +114,7 @@ def test_fused_pipeline_collectives_halved(devices):
                 (sds((world * cap,), jnp.float32), None)]
         counts = sds((world,), jnp.int32)
         rep = analyze(step, (cols, counts, cols, counts), ())
-        expect = 2 * (1 + respill) + 2
+        expect = contracts.fused_join_collectives(respill)
         assert rep.collective_count == expect, (
             respill, rep.collective_count, expect
         )
