@@ -59,7 +59,8 @@ JIT_WRAPPERS = {"jit", "shard_map", "make_jaxpr", "pmap"}
 KEYED_KINDS = {"impl", "kill-switch"}
 
 _LINT_RE = re.compile(
-    r"#\s*lint:\s*(key|keyed|operand)\s*=\s*([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"#\s*lint:\s*(key|keyed|operand|guarded|sync)\s*=\s*"
+    r"([A-Za-z0-9_.]+(?:\s*,\s*[A-Za-z0-9_.]+)*)"
 )
 
 
@@ -99,6 +100,15 @@ class FuncInfo:
     lint_key: Set[str] = field(default_factory=set)     # lint: key=VAR
     lint_keyed: Set[str] = field(default_factory=set)   # lint: keyed=name
     lint_operand: Set[str] = field(default_factory=set)
+    # L3 effect declarations (analysis/effects.py): guarded=<lock> audits a
+    # shared-state write, sync=<why> audits/reclassifies a sync-looking site.
+    # SITE-scoped (line -> names): a declaration covers only the statement
+    # it is attached to (same line or a comment block just above), never
+    # the whole function — one audit must not blanket future sites.
+    lint_guarded: Set[str] = field(default_factory=set)
+    lint_sync: Set[str] = field(default_factory=set)
+    lint_guarded_at: Dict[int, Set[str]] = field(default_factory=dict)
+    lint_sync_at: Dict[int, Set[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -336,6 +346,12 @@ class _FuncCollector(ast.NodeVisitor):
                     fi.lint_key |= names
                 elif tag == "keyed":
                     fi.lint_keyed |= names
+                elif tag == "guarded":
+                    fi.lint_guarded |= names
+                    fi.lint_guarded_at.setdefault(line, set()).update(names)
+                elif tag == "sync":
+                    fi.lint_sync |= names
+                    fi.lint_sync_at.setdefault(line, set()).update(names)
                 else:
                     fi.lint_operand |= names
         self.mod.functions[qual] = fi
@@ -889,18 +905,16 @@ def default_knob_kinds() -> Dict[str, str]:
     return {var: knob.kind for var, knob in REGISTRY.items()}
 
 
-def run_ast_pass(
+def build_analysis(
     root: str,
     package: Optional[str] = None,
     knob_kinds: Optional[Dict[str, str]] = None,
     files: Optional[Sequence[str]] = None,
-) -> List[Finding]:
-    """Run every AST rule over ``root`` (a package directory).
-
-    ``package``: dotted prefix for module names (``"cylon_tpu"`` for the
-    live tree; fixtures pass None). ``knob_kinds`` defaults to the live
-    envgate registry.
-    """
+) -> Tuple[_Analysis, Dict[str, str]]:
+    """Parse ``root`` and build the shared interprocedural fact base
+    (modules, call graph, env reads, lint comments): the substrate of the
+    Layer-1 rules here AND the Layer-3 effect pass (:mod:`.effects`).
+    Returns ``(analysis, {path: source})``."""
     kinds = dict(knob_kinds if knob_kinds is not None else default_knob_kinds())
     an = _Analysis(kinds)
     paths = list(files) if files else sorted(
@@ -925,6 +939,23 @@ def run_ast_pass(
     for mod in an.modules.values():
         collector = _FuncCollector(an, mod, _lint_comments(sources[mod.path]))
         collector.visit(mod.tree)
+    return an, sources
+
+
+def run_ast_pass(
+    root: str,
+    package: Optional[str] = None,
+    knob_kinds: Optional[Dict[str, str]] = None,
+    files: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every AST rule over ``root`` (a package directory).
+
+    ``package``: dotted prefix for module names (``"cylon_tpu"`` for the
+    live tree; fixtures pass None). ``knob_kinds`` defaults to the live
+    envgate registry.
+    """
+    an, _sources = build_analysis(root, package, knob_kinds, files)
+    kinds = an.knob_kinds
 
     findings: List[Finding] = []
     envgate_mod = f"{package}.utils.envgate" if package else None

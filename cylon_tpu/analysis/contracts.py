@@ -141,9 +141,260 @@ def fused_q3_collectives(respill: int, num_slices: int = 1) -> int:
 #: ``_shuffle_many``, and K-independent by construction
 SHUFFLE_HOST_SYNCS_PER_TABLE = 2
 
-#: the only function allowed to fetch during a shuffle (the whitelisted
-#: deferred count fetch; see docs/ARCHITECTURE.md "Static invariants")
-SHUFFLE_SYNC_SITES = ("_shuffle_many",)
+#: the functions allowed to fetch during a shuffle: the whitelisted
+#: deferred count fetch, plus the up-front materialization of a deferred-
+#: count INPUT (applies the pending overshoot compaction before the pack
+#: kernels specialize on the capacity; see docs/ARCHITECTURE.md "Static
+#: invariants")
+SHUFFLE_SYNC_SITES = ("_shuffle_many", "_materialize_counts")
+
+
+# ----------------------------------------------------------------------
+# Layer 3: host-sync budgets + effect signatures (ISSUE 7)
+# ----------------------------------------------------------------------
+
+#: a dispatch-async eager op performs ZERO host syncs at dispatch time —
+#: its count fetch is deferred to result materialization
+EAGER_OP_HOST_SYNCS = 0
+
+#: the q3 dispatch() contract: exactly ONE host sync, at result fetch
+Q3_DISPATCH_HOST_SYNCS = 1
+
+#: ...attributed to the deferred-count materialization, nowhere else
+Q3_DISPATCH_SYNC_SITES = ("_materialize_counts",)
+
+#: the ops the optimized q3 plan lowers to (plan/rules.fused_join_groupby
+#: + pushdowns); each must hold a 0-site static sync budget so the ONE
+#: materialization sync is provably the only fetch of a q3 dispatch
+Q3_DISPATCH_OPS = (
+    "Table.filter",
+    "Table.project",
+    "Table._join_sum_pushdown",
+)
+
+
+@dataclass(frozen=True)
+class SyncBudget:
+    """Exact number of distinct device->host sync SITES a budget-owning
+    function may reach (reachability stops at other owners — each polices
+    its own sites, the L1 key-builder scoping rule applied to effects).
+
+    ``amortized``: the sync is paid at most once per table/result and
+    cached (a deferred-count materialization, an ensure_stats
+    measurement) — delegation to an amortized owner classifies a caller
+    as MATERIALIZE, not SYNC, on the L3 effect lattice."""
+
+    sites: int
+    amortized: bool = False
+    note: str = ""
+
+
+#: the static sync-site pin table (:mod:`.syncfree` enforces EXACT
+#: equality: a new fetch on a 0-budget op is a CI failure with a
+#: file:line call path; a removed fetch is a pin update HERE, made with
+#: the engine change that moves it)
+SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
+    # dispatch-async eager ops: the count fetch is deferred (EAGER_OP_HOST_SYNCS)
+    "Table.filter": SyncBudget(0, note="single-dispatch, deferred counts"),
+    "Table.project": SyncBudget(0, note="metadata only"),
+    "Table.sort": SyncBudget(0, note="permutation: counts pass through"),
+    "Table.groupby": SyncBudget(0, note="static group bound, deferred counts"),
+    "Table.unique": SyncBudget(0, note="subset bound, deferred counts"),
+    "Table._two_table_setop": SyncBudget(
+        0, note="union/subtract/intersect: subset bound, deferred counts"
+    ),
+    "Table._join_sum_pushdown": SyncBudget(
+        0, note="fused q3 kernel: static group bound, deferred counts"
+    ),
+    # ops that own genuine host decisions
+    "Table.join": SyncBudget(
+        3,
+        note="speculative stats fetch (overflow check) + exact-path probe "
+        "stats fetch + the pallas_pk stats fetch — each a packed single "
+        "fetch; the emit phases reuse the probe counts",
+    ),
+    "Table.bucket_pack": SyncBudget(1, note="bucket-count fetch"),
+    "Table._fused_join": SyncBudget(1, note="fused-step stats fetch"),
+    "table._shuffle_many": SyncBudget(
+        2,
+        note="count-phase fetch + ONE deferred round-count fetch; "
+        "K-independent (SHUFFLE_HOST_SYNCS_PER_TABLE)",
+    ),
+    "task.task_partition": SyncBudget(
+        1, note="ONE sort+count fetch covers all T task splits"
+    ),
+    # amortized machinery: paid once, cached
+    "Table._materialize_counts": SyncBudget(
+        1, amortized=True,
+        note="THE deferred result fetch (+ in-place overshoot compaction)",
+    ),
+    "Table.ensure_stats": SyncBudget(
+        1, amortized=True,
+        note="on-demand column range stats; cached on the table, free for "
+        "shuffle outputs (the count pass measured them)",
+    ),
+}
+
+
+#: the pinned effect signature of every public entry point on the
+#: certified dispatch surface (:func:`cylon_tpu.analysis.syncfree
+#: .public_entries`): DISPATCH_SAFE < MATERIALIZE < SYNC — see
+#: docs/ARCHITECTURE.md "Static invariants" for the lattice semantics.
+#: Filled per-entry; syncfree flags any public entry missing here
+#: (effect-unpinned) or drifting from its pin (effect-drift).
+EFFECT_SIGNATURES: Dict[str, str] = {
+    "DataFrame.add_prefix": "DISPATCH_SAFE",
+    "DataFrame.add_suffix": "DISPATCH_SAFE",
+    "DataFrame.applymap": "SYNC",
+    "DataFrame.astype": "SYNC",
+    "DataFrame.columns": "DISPATCH_SAFE",
+    "DataFrame.concat": "SYNC",
+    "DataFrame.context": "DISPATCH_SAFE",
+    "DataFrame.count": "SYNC",
+    "DataFrame.drop": "DISPATCH_SAFE",
+    "DataFrame.drop_duplicates": "SYNC",
+    "DataFrame.fillna": "DISPATCH_SAFE",
+    "DataFrame.groupby": "SYNC",
+    "DataFrame.iloc": "DISPATCH_SAFE",
+    "DataFrame.index": "DISPATCH_SAFE",
+    "DataFrame.is_cpu": "DISPATCH_SAFE",
+    "DataFrame.is_device": "DISPATCH_SAFE",
+    "DataFrame.isin": "DISPATCH_SAFE",
+    "DataFrame.isna": "DISPATCH_SAFE",
+    "DataFrame.isnull": "DISPATCH_SAFE",
+    "DataFrame.iterrows": "SYNC",
+    "DataFrame.join": "SYNC",
+    "DataFrame.lazy": "DISPATCH_SAFE",
+    "DataFrame.loc": "DISPATCH_SAFE",
+    "DataFrame.mask": "MATERIALIZE",
+    "DataFrame.max": "SYNC",
+    "DataFrame.mean": "SYNC",
+    "DataFrame.merge": "SYNC",
+    "DataFrame.min": "SYNC",
+    "DataFrame.notna": "DISPATCH_SAFE",
+    "DataFrame.notnull": "DISPATCH_SAFE",
+    "DataFrame.rename": "DISPATCH_SAFE",
+    "DataFrame.reset_index": "DISPATCH_SAFE",
+    "DataFrame.set_index": "DISPATCH_SAFE",
+    "DataFrame.shape": "MATERIALIZE",
+    "DataFrame.sort_values": "SYNC",
+    "DataFrame.sum": "SYNC",
+    "DataFrame.table": "DISPATCH_SAFE",
+    "DataFrame.to_arrow": "SYNC",
+    "DataFrame.to_cpu": "DISPATCH_SAFE",
+    "DataFrame.to_csv": "SYNC",
+    "DataFrame.to_device": "DISPATCH_SAFE",
+    "DataFrame.to_dict": "SYNC",
+    "DataFrame.to_numpy": "SYNC",
+    "DataFrame.to_pandas": "SYNC",
+    "DataFrame.to_table": "DISPATCH_SAFE",
+    "DataFrame.where": "MATERIALIZE",
+    "LazyFrame.collect": "SYNC",
+    "LazyFrame.columns": "DISPATCH_SAFE",
+    "LazyFrame.dispatch": "SYNC",
+    "LazyFrame.explain": "DISPATCH_SAFE",
+    "LazyFrame.filter": "DISPATCH_SAFE",
+    "LazyFrame.from_table": "DISPATCH_SAFE",
+    "LazyFrame.groupby": "DISPATCH_SAFE",
+    "LazyFrame.head": "DISPATCH_SAFE",
+    "LazyFrame.join": "DISPATCH_SAFE",
+    "LazyFrame.limit": "DISPATCH_SAFE",
+    "LazyFrame.plan": "DISPATCH_SAFE",
+    "LazyFrame.select": "DISPATCH_SAFE",
+    "LazyFrame.sort": "DISPATCH_SAFE",
+    "LazyFrame.union": "DISPATCH_SAFE",
+    "Table.add_column": "DISPATCH_SAFE",
+    "Table.add_prefix": "DISPATCH_SAFE",
+    "Table.add_suffix": "DISPATCH_SAFE",
+    "Table.applymap": "SYNC",
+    "Table.astype": "SYNC",
+    "Table.bucket_pack": "SYNC",
+    "Table.build_index": "DISPATCH_SAFE",
+    "Table.clear": "MATERIALIZE",
+    "Table.column": "DISPATCH_SAFE",
+    "Table.column_count": "DISPATCH_SAFE",
+    "Table.column_names": "DISPATCH_SAFE",
+    "Table.column_stats": "DISPATCH_SAFE",
+    "Table.concat": "MATERIALIZE",
+    "Table.context": "DISPATCH_SAFE",
+    "Table.count": "SYNC",
+    "Table.counts_dev": "MATERIALIZE",
+    "Table.distributed_groupby": "SYNC",
+    "Table.distributed_intersect": "SYNC",
+    "Table.distributed_join": "SYNC",
+    "Table.distributed_pipeline_groupby": "SYNC",
+    "Table.distributed_sort": "SYNC",
+    "Table.distributed_subtract": "SYNC",
+    "Table.distributed_union": "SYNC",
+    "Table.distributed_unique": "SYNC",
+    "Table.drop": "DISPATCH_SAFE",
+    "Table.dropna": "MATERIALIZE",
+    "Table.dtype_of": "DISPATCH_SAFE",
+    "Table.ensure_stats": "SYNC",
+    "Table.equals": "SYNC",
+    "Table.fillna": "DISPATCH_SAFE",
+    "Table.filter": "MATERIALIZE",
+    "Table.from_arrow": "SYNC",
+    "Table.from_encoded": "SYNC",
+    "Table.from_encoded_shards": "SYNC",
+    "Table.from_list": "SYNC",
+    "Table.from_numpy": "SYNC",
+    "Table.from_pandas": "SYNC",
+    "Table.from_pydict": "SYNC",
+    "Table.from_shards": "SYNC",
+    "Table.get_index": "DISPATCH_SAFE",
+    "Table.groupby": "MATERIALIZE",
+    "Table.hash_partition": "DISPATCH_SAFE",
+    "Table.iloc": "DISPATCH_SAFE",
+    "Table.index": "MATERIALIZE",
+    "Table.intersect": "DISPATCH_SAFE",
+    "Table.isin": "DISPATCH_SAFE",
+    "Table.isna": "DISPATCH_SAFE",
+    "Table.isnull": "DISPATCH_SAFE",
+    "Table.iterrows": "SYNC",
+    "Table.join": "SYNC",
+    "Table.lazy": "DISPATCH_SAFE",
+    "Table.live_mask": "DISPATCH_SAFE",
+    "Table.loc": "DISPATCH_SAFE",
+    "Table.mask": "MATERIALIZE",
+    "Table.max": "SYNC",
+    "Table.mean": "SYNC",
+    "Table.merge": "MATERIALIZE",
+    "Table.min": "SYNC",
+    "Table.minmax": "SYNC",
+    "Table.notna": "DISPATCH_SAFE",
+    "Table.notnull": "DISPATCH_SAFE",
+    "Table.ordering": "DISPATCH_SAFE",
+    "Table.pipeline_groupby": "DISPATCH_SAFE",
+    "Table.project": "DISPATCH_SAFE",
+    "Table.rename": "DISPATCH_SAFE",
+    "Table.reset_index": "DISPATCH_SAFE",
+    "Table.row_count": "MATERIALIZE",
+    "Table.row_counts": "MATERIALIZE",
+    "Table.select": "DISPATCH_SAFE",
+    "Table.select_rows": "SYNC",
+    "Table.set_index": "DISPATCH_SAFE",
+    "Table.shape": "MATERIALIZE",
+    "Table.shard_cap": "DISPATCH_SAFE",
+    "Table.show": "SYNC",
+    "Table.shuffle": "SYNC",
+    "Table.sort": "MATERIALIZE",
+    "Table.subtract": "DISPATCH_SAFE",
+    "Table.sum": "SYNC",
+    "Table.take": "MATERIALIZE",
+    "Table.task_partition": "SYNC",
+    "Table.to_arrow": "SYNC",
+    "Table.to_csv": "SYNC",
+    "Table.to_numpy": "SYNC",
+    "Table.to_pandas": "SYNC",
+    "Table.to_pydict": "SYNC",
+    "Table.to_string": "SYNC",
+    "Table.union": "DISPATCH_SAFE",
+    "Table.unique": "DISPATCH_SAFE",
+    "Table.where": "MATERIALIZE",
+    "Table.with_ordering": "DISPATCH_SAFE",
+    "Table.world_size": "DISPATCH_SAFE",
+}
 
 CONTRACTS: Dict[str, CollectiveContract] = {
     "shuffle_single": CollectiveContract(
@@ -219,5 +470,28 @@ CONTRACTS: Dict[str, CollectiveContract] = {
         collectives=lambda respill: fused_q3_collectives(respill),
         all_to_all=lambda respill: 2 * (1 + respill),
         psum=3,
+    ),
+    "eager_sync_free": CollectiveContract(
+        name="eager_sync_free",
+        description=(
+            "dispatch-async eager ops (filter / project / groupby / "
+            "unique / set-op / sort): zero collectives-unconstrained, "
+            "ZERO host syncs at dispatch — the count fetch is deferred "
+            "to result materialization (L3 budget: 0 sites)"
+        ),
+        host_syncs=EAGER_OP_HOST_SYNCS,
+        sync_sites=(),
+    ),
+    "q3_dispatch": CollectiveContract(
+        name="q3_dispatch",
+        description=(
+            "LazyFrame.dispatch() of the fused q3 join->groupby-SUM plan "
+            "on a 1-device mesh: ZERO host syncs at dispatch, exactly ONE "
+            "at result fetch, attributed to _materialize_counts (the "
+            "collect_async precursor contract; runtime twin of the "
+            "static q3-dispatch-budget check)"
+        ),
+        host_syncs=Q3_DISPATCH_HOST_SYNCS,
+        sync_sites=Q3_DISPATCH_SYNC_SITES,
     ),
 }

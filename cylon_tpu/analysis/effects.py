@@ -1,0 +1,480 @@
+"""Layer 3 (part 1): interprocedural effect inference over the L1 call graph.
+
+Walks every function's body (on the :mod:`.ast_pass` fact base — purely
+syntactic, so fixtures analyze exactly like the live tree) and extracts
+three effect families:
+
+``sync``
+    Device->host transfer sites: calls to ``_fetch`` (the sanctioned
+    funnel), ``jax.device_get``, ``.block_until_ready()``, ``.item()``
+    (a device scalar read), and ``np.asarray`` over a value locally
+    tainted as a kernel-dispatch result. A detected site may be
+    reclassified with ``# lint: sync=host`` (audited: the value is host
+    memory, e.g. a numpy scalar) and an invisible one declared with
+    ``# lint: sync=device`` (audited: the call syncs through a mechanism
+    the detector cannot see). Declarations are SITE-scoped: one covers
+    only the statement it is attached to — a trailing comment on the
+    site's line or a comment block starting at most ``DECL_WINDOW``
+    lines above it — so an audited ``.item()`` never silences a
+    ``_fetch`` added later in the same function.
+
+``materialize``
+    Reads of the deferred-count machinery — ``.row_count`` /
+    ``.row_counts`` / ``.shape`` / ``._row_counts`` attribute loads and
+    ``_materialize*`` calls. These reach the ONE deferred fetch
+    (``table.Table._materialize_counts``) and are tracked separately
+    from dispatch-time syncs: a dispatched chain stays sync-free
+    precisely because every count read is funneled here.
+
+``shared writes``
+    Non-atomic mutation of cross-query state: module-level mutables
+    (subscript/attribute stores, mutator method calls, ``global``
+    rebinds), any ``__dict__``-hosted map (the per-context cache
+    pattern — names tainted by ``x.__dict__.get/setdefault`` are
+    tracked locals), and ``os.environ`` stores. ``dict.setdefault`` on a
+    ``__dict__`` is the sanctioned GIL-atomic publish and is NOT a
+    finding; everything else must be dominated by a lock (a ``with``
+    whose expression names a ``*lock*`` object) or carry an audited
+    ``# lint: guarded=<lock-or-reason>`` declaration (site-scoped, same
+    proximity rule as ``sync=``: one declaration blesses one write).
+
+:mod:`.syncfree` consumes these per-function facts to classify public
+entry points on the effect lattice (``DISPATCH_SAFE`` < ``MATERIALIZE``
+< ``SYNC``, with an orthogonal unguarded-``MUTATES_SHARED`` flag that is
+always a finding) and to enforce the per-op sync-site budgets pinned in
+:mod:`.contracts`.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .ast_pass import (
+    FuncInfo,
+    _Analysis,
+    _attr_chain,
+)
+
+#: call leaves that ARE a device->host sync wherever they appear
+SYNC_LEAVES = {"_fetch", "device_get", "block_until_ready"}
+
+#: attribute loads that route through the deferred-count materialization.
+#: Deliberately NOT ``shape``: on a Table it merely delegates to
+#: ``row_count`` (which IS here, so Table.shape still classifies), while
+#: ``.shape`` on a jax array — ubiquitous inside kernel builder bodies —
+#: is static metadata with no host sync; including it would misclassify
+#: every dispatch-safe eager op as MATERIALIZE.
+MATERIALIZE_ATTRS = {"row_count", "row_counts", "_row_counts"}
+MATERIALIZE_CALLS = {"_materialize", "_materialize_counts"}
+
+#: non-atomic mutators on a shared container (``setdefault`` is excluded:
+#: it is the sanctioned GIL-atomic create-or-get publish for
+#: ``__dict__``-hosted caches — see engine.get_kernel)
+MUTATOR_LEAVES = {
+    "append", "update", "pop", "popitem", "clear", "extend", "remove",
+    "insert", "add", "discard",
+}
+
+#: a ``guarded=`` / ``sync=`` declaration covers sites on its own line or
+#: up to this many lines below it (the comment block sits directly above
+#: the audited statement). Deliberately small: a declaration is an audit
+#: of ONE site, and a blanket function-wide suppression would let the
+#: next edit's real sync/write ride an old audit straight through CI.
+DECL_WINDOW = 3
+
+
+@dataclass(frozen=True)
+class SyncSite:
+    qualname: str
+    file: str
+    line: int
+    kind: str  # fetch | device_get | block | item | asarray | declared
+
+
+@dataclass(frozen=True)
+class SharedWrite:
+    qualname: str
+    file: str
+    line: int
+    target: str
+    guards: Tuple[str, ...]  # lock names dominating the write ("" = none)
+
+    @property
+    def guarded(self) -> bool:
+        return bool(self.guards)
+
+
+@dataclass
+class FuncEffects:
+    sync_sites: List[SyncSite] = field(default_factory=list)
+    materialize_refs: List[Tuple[int, str]] = field(default_factory=list)
+    shared_writes: List[SharedWrite] = field(default_factory=list)
+
+
+def _is_lockish(expr: ast.AST) -> Optional[str]:
+    """Name of the lock a ``with`` item takes, or None. Recognized: any
+    name/attribute/call chain whose LAST component contains 'lock'
+    (``_lock``, ``self._cache_lock``, ``cache_lock(ctx)``)."""
+    chain = None
+    if isinstance(expr, ast.Call):
+        chain = _attr_chain(expr.func)
+    else:
+        chain = _attr_chain(expr)
+    if chain and "lock" in chain[-1].lower():
+        return chain[-1]
+    return None
+
+
+class _EffectVisitor:
+    """Extract one function's effect facts (nested defs excluded — they
+    have their own FuncInfo and are reached through call edges)."""
+
+    def __init__(self, an: _Analysis, fi: FuncInfo, path: str):
+        self.an = an
+        self.fi = fi
+        self.mod = an.modules[fi.module]
+        self.path = path
+        self.out = FuncEffects()
+        self.globals_declared: Set[str] = set()
+        self.local_bound: Set[str] = set()
+        # locals holding a __dict__-hosted (cross-query) container
+        self.shared_locals: Set[str] = set()
+        # locals holding a kernel-dispatch result (device value)
+        self.device_locals: Set[str] = set()
+        node = fi.node
+        # pre-pass: local bindings + shared/device taint through simple
+        # assignments, in source order (good enough for the straight-line
+        # `cache = ctx.__dict__.setdefault(...)` shapes this targets)
+        for child in ast.walk(node):
+            if isinstance(child, ast.Global):
+                self.globals_declared.update(child.names)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if child is not node:
+                    self.local_bound.add(child.name)
+        for child in self._own_nodes(node):
+            if isinstance(child, ast.Assign):
+                targets = [
+                    t.id for t in child.targets if isinstance(t, ast.Name)
+                ]
+                self.local_bound.update(targets)
+                if targets:
+                    if self._expr_touches_dunder_dict(child.value):
+                        self.shared_locals.update(targets)
+                    if self._expr_is_device(child.value):
+                        self.device_locals.update(targets)
+            elif isinstance(child, ast.AnnAssign):
+                if isinstance(child.target, ast.Name):
+                    self.local_bound.add(child.target.id)
+        a = node.args
+        for p in a.args + a.kwonlyargs + a.posonlyargs:
+            self.local_bound.add(p.arg)
+        if a.vararg:
+            self.local_bound.add(a.vararg.arg)
+        if a.kwarg:
+            self.local_bound.add(a.kwarg.arg)
+        # declared-invisible sync sites: one per ``sync=device``
+        # declaration, attributed to the declaration's own line
+        for line, names in sorted(fi.lint_sync_at.items()):
+            if "device" in names:
+                self.out.sync_sites.append(
+                    SyncSite(fi.qualname, path, line, "declared")
+                )
+
+    # -- helpers --------------------------------------------------------
+    def _sync_host_near(self, line: int) -> bool:
+        """A ``# lint: sync=host`` reclassification covering ``line``
+        (site-scoped: same line or a declaration within DECL_WINDOW
+        lines above)."""
+        return any(
+            0 <= line - d <= DECL_WINDOW and "host" in names
+            for d, names in self.fi.lint_sync_at.items()
+        )
+
+    def _declared_guards(self, line: int) -> Tuple[str, ...]:
+        """``# lint: guarded=`` names covering ``line`` (site-scoped)."""
+        out: List[str] = []
+        for d, names in sorted(self.fi.lint_guarded_at.items()):
+            if 0 <= line - d <= DECL_WINDOW:
+                out.extend(sorted(names))
+        return tuple(out)
+
+    def _own_nodes(self, node):
+        """Every descendant of ``node`` that is not inside a nested def."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield child
+            yield from self._own_nodes(child)
+
+    def _expr_touches_dunder_dict(self, expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            chain = None
+            if isinstance(n, ast.Call):
+                chain = _attr_chain(n.func)
+            elif isinstance(n, ast.Attribute):
+                chain = _attr_chain(n)
+            if chain and "__dict__" in chain:
+                return True
+            if isinstance(n, ast.Name) and n.id in self.shared_locals:
+                return True
+        return False
+
+    def _expr_is_device(self, expr: ast.AST) -> bool:
+        """A kernel-dispatch result: ``get_kernel(...)(...)`` /
+        ``run(...)`` / ``jax.jit(...)(...)`` or a name already tainted."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Call):
+                    inner = _attr_chain(n.func.func)
+                    if inner and inner[-1] in ("get_kernel", "jit"):
+                        return True
+                chain = _attr_chain(n.func)
+                if chain and chain[-1] in ("run", "device_put"):
+                    if chain[-1] == "run" and len(chain) == 1:
+                        return True
+                    if chain[-1] == "device_put":
+                        return True
+            if isinstance(n, ast.Name) and n.id in self.device_locals:
+                return True
+        return False
+
+    def _is_shared_base(self, name: str) -> bool:
+        """A bare name denoting cross-query state: a module-level mutable
+        of THIS module (not an import alias, not locally rebound), or a
+        local tainted by ``__dict__``."""
+        if name in self.shared_locals:
+            return True
+        if name in self.globals_declared:
+            return True
+        if name in self.local_bound:
+            return False
+        if name in self.mod.alias_to_module or name in self.mod.from_imports:
+            return False
+        return name in self.mod.module_names
+
+    def _record_write(self, line: int, target: str, guards: Tuple[str, ...]):
+        self.out.shared_writes.append(
+            SharedWrite(self.fi.qualname, self.path, line, target, guards)
+        )
+
+    # -- the walk -------------------------------------------------------
+    def run(self) -> FuncEffects:
+        self._walk(self.fi.node, ())
+        return self.out
+
+    def _walk(self, node: ast.AST, guards: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                names = tuple(
+                    g for item in child.items
+                    if (g := _is_lockish(item.context_expr)) is not None
+                )
+                self._walk(child, guards + names)
+                continue
+            self._visit_one(child, guards)
+            self._walk(child, guards)
+
+    def _visit_one(self, node: ast.AST, guards: Tuple[str, ...]) -> None:
+        fi = self.fi
+        line = getattr(node, "lineno", 0)
+        eff_guards = guards + self._declared_guards(line)
+
+        # ---- shared-state writes
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    base = _attr_chain(t.value)
+                    if base and (
+                        "__dict__" in base
+                        or base[-1] == "environ"
+                        or self._is_shared_base(base[0])
+                        and len(base) == 1
+                    ):
+                        self._record_write(
+                            node.lineno, ".".join(base) + "[...]", eff_guards
+                        )
+                elif isinstance(t, ast.Attribute):
+                    base = _attr_chain(t)
+                    if base and base[0] != "self" and (
+                        base[0] in self.mod.alias_to_module
+                        and self._alias_in_package(base[0])
+                        or self._is_shared_base(base[0])
+                        and base[0] not in self.mod.alias_to_module
+                    ):
+                        self._record_write(
+                            node.lineno, ".".join(base), eff_guards
+                        )
+                elif isinstance(t, ast.Name):
+                    if t.id in self.globals_declared:
+                        self._record_write(node.lineno, t.id, eff_guards)
+
+        # ---- calls: syncs, materialize, mutators
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain is None and isinstance(node.func, ast.Attribute):
+                # method on a non-name base (e.g. ``jnp.sum(...).item()``):
+                # the leaf still classifies sync-wise
+                chain = ["<expr>", node.func.attr]
+            if chain:
+                leaf = chain[-1]
+                if leaf in SYNC_LEAVES and not self._sync_host_near(line):
+                    kind = {
+                        "_fetch": "fetch",
+                        "device_get": "device_get",
+                        "block_until_ready": "block",
+                    }[leaf]
+                    self.out.sync_sites.append(
+                        SyncSite(fi.qualname, self.path, node.lineno, kind)
+                    )
+                elif (
+                    leaf == "item"
+                    and len(chain) >= 2
+                    and not node.args
+                    and not self._sync_host_near(line)
+                ):
+                    self.out.sync_sites.append(
+                        SyncSite(fi.qualname, self.path, node.lineno, "item")
+                    )
+                elif (
+                    leaf == "asarray"
+                    and not self._sync_host_near(line)
+                    and any(self._expr_is_device(a) for a in node.args)
+                ):
+                    self.out.sync_sites.append(
+                        SyncSite(
+                            fi.qualname, self.path, node.lineno, "asarray"
+                        )
+                    )
+                if leaf in MATERIALIZE_CALLS:
+                    self.out.materialize_refs.append((node.lineno, leaf))
+                # non-atomic mutation of a shared container
+                if leaf in MUTATOR_LEAVES and len(chain) >= 2:
+                    base = chain[:-1]
+                    shared = (
+                        "__dict__" in base
+                        or base[-1] == "environ"
+                        or (len(base) == 1 and self._is_shared_base(base[0]))
+                        or (
+                            base[0] in self.mod.alias_to_module
+                            and self._alias_in_package(base[0])
+                            and len(base) >= 2
+                        )
+                    )
+                    if shared:
+                        self._record_write(
+                            node.lineno,
+                            ".".join(chain) + "()",
+                            eff_guards,
+                        )
+
+        # ---- materialize-attr loads (deferred-count reads)
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            if node.attr in MATERIALIZE_ATTRS:
+                self.out.materialize_refs.append((node.lineno, node.attr))
+
+    def _alias_in_package(self, alias: str) -> bool:
+        target = self.mod.alias_to_module.get(alias, "")
+        root = self.mod.name.split(".")[0]
+        return target.split(".")[0] == root and target in self.an.modules
+
+
+def compute_effects(
+    an: _Analysis, sources: Optional[Dict[str, str]] = None
+) -> Dict[str, FuncEffects]:
+    """Per-function effect facts for every function in the analysis."""
+    out: Dict[str, FuncEffects] = {}
+    for mod in an.modules.values():
+        for qual, fi in mod.functions.items():
+            out[qual] = _EffectVisitor(an, fi, mod.path).run()
+    return out
+
+
+#: attribute bases with a statically-known class, completing delegation
+#: edges the name-based resolver cannot see (DataFrame wraps a Table)
+_TYPED_ATTRS = {"_table": "table.Table"}
+
+
+def _resolve_typed(an: _Analysis, desc, mod, f) -> Optional[str]:
+    got = an.resolve_callee(desc, mod, f)
+    if got is not None:
+        return got
+    if desc[0] == "attr" and desc[1] in _TYPED_ATTRS:
+        pkg = mod.name.split(".")[0]
+        q = f"{pkg}.{_TYPED_ATTRS[desc[1]]}.{desc[2]}"
+        if q in an.funcs:
+            return q
+    if desc[0] == "attr":
+        # ClassName.method(...) on a class of the same module
+        q = f"{mod.name}.{desc[1]}.{desc[2]}"
+        if q in an.funcs:
+            return q
+    return None
+
+
+def reachable(
+    an: _Analysis,
+    root: str,
+    stop_at: Sequence[str] = (),
+) -> Tuple[List[str], Dict[str, str], Dict[str, str]]:
+    """Call-graph closure from ``root``.
+
+    Returns ``(visited, parent, delegations)``: ``parent`` maps each
+    visited function to its first-discovered caller (for call-path
+    attribution), ``delegations`` maps each NOT-descended boundary
+    function (its qualname ends with an entry of ``stop_at``) to the
+    caller that reached it. The root itself is never treated as a
+    boundary."""
+    visited: List[str] = []
+    parent: Dict[str, str] = {}
+    delegations: Dict[str, str] = {}
+    seen: Set[str] = set()
+
+    def boundary(qual: str) -> bool:
+        return any(qual.endswith(s) for s in stop_at)
+
+    def visit(qual: str) -> None:
+        if qual in seen:
+            return
+        seen.add(qual)
+        visited.append(qual)
+        f = an.funcs[qual]
+        mod = an.modules[f.module]
+        callees = list(f.nested)
+        for desc in f.callees:
+            callee = _resolve_typed(an, desc, mod, f)
+            if callee is not None:
+                callees.append(callee)
+        for callee in callees:
+            if callee in seen:
+                continue
+            if callee != root and boundary(callee):
+                delegations.setdefault(callee, qual)
+                continue
+            parent.setdefault(callee, qual)
+            visit(callee)
+
+    visit(root)
+    return visited, parent, delegations
+
+
+def call_path(parent: Dict[str, str], root: str, target: str) -> List[str]:
+    """Reconstruct root -> ... -> target from the parent map."""
+    path = [target]
+    cur = target
+    while cur != root:
+        cur = parent.get(cur, root)
+        path.append(cur)
+        if len(path) > 64:  # pragma: no cover - defensive
+            break
+    path.reverse()
+    return path

@@ -15,6 +15,14 @@ Paths covered (the ISSUE-6 registry):
 - ``dist_join_semi``   — selective pair, sketch all_gather engaged;
 - ``fused_join_step``  — the fully fused join program (jaxpr census);
 - ``q3_fused_step``    — the fused join->groupby-SUM (q3) program.
+
+And the ISSUE-7 sync-freedom entries:
+
+- ``eager_sync_free``  — filter/groupby/unique dispatch with ZERO
+  monitored fetches (deferred count lanes);
+- ``q3_dispatch``      — a fused q3 plan ``dispatch()`` on a 1-device
+  mesh: zero syncs at dispatch, exactly ONE at result materialization,
+  attributed to ``_materialize_counts``.
 """
 from __future__ import annotations
 
@@ -267,6 +275,82 @@ def run_q3_fused_step(ctx, _rng) -> List[PlanResult]:
     ]
 
 
+def run_eager_sync_free(ctx, rng) -> List[PlanResult]:
+    """The dispatch-async eager ops (ISSUE 7): filter, groupby and unique
+    dispatched WITHOUT materializing the results must perform ZERO
+    monitored fetches — their count lanes stay deferred on the device.
+    The runtime twin of the L3 0-site sync budgets."""
+    t = _shuffle_table(ctx, rng)
+    contract = CONTRACTS["eager_sync_free"]
+
+    def op():
+        a = t.filter(t.column("k").data < 50)
+        b = t.groupby("k", {"v": "sum"})
+        c = t.unique(["k"])
+        return a, b, c
+
+    return [_measure(op, contract, 1)]
+
+
+def run_q3_dispatch(ctx, rng) -> List[PlanResult]:
+    """The ``collect_async`` precursor pin (ISSUE 7 acceptance): a fused
+    q3 plan ``dispatch()``es with zero host syncs on a 1-device mesh (the
+    serving shape — many concurrent single-replica queries); its ONE sync
+    happens at result materialization, attributed to
+    ``_materialize_counts``. Static twin: the ``q3-dispatch-budget`` rule
+    in :mod:`.syncfree`."""
+    import jax
+
+    import cylon_tpu as ct
+
+    ctx1 = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=jax.devices()[:1])
+    )
+    n = 2000
+    ta = ct.Table.from_pydict(
+        ctx1,
+        {
+            "k": rng.integers(0, 50, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+        },
+    )
+    tb = ct.Table.from_pydict(
+        ctx1,
+        {
+            "rk": rng.integers(0, 50, n).astype(np.int32),
+            "w": rng.normal(size=n).astype(np.float32),
+        },
+    )
+    lf = (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(ct.col("w") > 0.0)
+        .groupby("k", {"v": "sum"})
+    )
+    contract = CONTRACTS["q3_dispatch"]
+
+    def op():
+        return lf.dispatch()._materialize()
+
+    res = _measure(op, contract, 1)
+    if "join_sum_by_key_pushdown" not in lf.explain():
+        res.violations.append(
+            "q3_dispatch: the plan did not lower to the fused "
+            "join_sum_by_key_pushdown — the pin is not exercising the q3 "
+            "fused path"
+        )
+    # the dispatch itself, before any result access, must be sync-free
+    with sync_monitor() as dev_events:
+        lf.dispatch()
+    if dev_events:
+        res.violations.append(
+            f"q3_dispatch: dispatch() performed {len(dev_events)} host "
+            "sync(s) before result materialization: "
+            + ", ".join(f"{e.site} ({e.file}:{e.line})" for e in dev_events)
+        )
+    return [res]
+
+
 PLAN_RUNNERS = [
     run_shuffle_single,
     run_shuffle_wire_packed,
@@ -274,6 +358,8 @@ PLAN_RUNNERS = [
     run_dist_join_semi,
     run_fused_join_step,
     run_q3_fused_step,
+    run_eager_sync_free,
+    run_q3_dispatch,
 ]
 
 

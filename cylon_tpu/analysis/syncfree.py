@@ -1,0 +1,344 @@
+"""Layer 3 (part 2): sync-freedom certification of the dispatch surface.
+
+Consumes the per-function effect facts of :mod:`.effects` and enforces,
+against the declarations in :mod:`.contracts`:
+
+``sync-budget``
+    Every budget-owning function (``contracts.SYNC_SITE_BUDGETS``) must
+    reach EXACTLY its pinned number of distinct device->host sync sites,
+    with reachability stopping at other budget owners (each polices its
+    own sites — the L1 key-builder scoping rule applied to effects). A
+    new fetch on a 0-budget op (eager filter/project/groupby/...) is a
+    CI failure carrying the full call path to the site; a removed one is
+    a pin update, so the sync discipline regresses loudly in both
+    directions.
+
+``effect-drift`` / ``effect-unpinned``
+    Every public ``Table`` / ``DataFrame`` / plan-executor entry point
+    carries a pinned effect signature (``contracts.EFFECT_SIGNATURES``)
+    on the lattice ``DISPATCH_SAFE`` < ``MATERIALIZE`` < ``SYNC``:
+
+    - ``DISPATCH_SAFE`` — dispatches with no reachable sync site, no
+      deferred-count read, and no unguarded shared-state write;
+    - ``MATERIALIZE``   — sync-free at dispatch; may force the deferred
+      count fetch (``_materialize_counts``) or an amortized, cached
+      measurement (``ensure_stats``) for host-driven arguments;
+    - ``SYNC``          — owns dispatch-time sync sites (or delegates to
+      a non-amortized owner, e.g. the shuffle's count fetches).
+
+``unguarded-shared-write``
+    No public entry point may reach a non-atomic write of cross-query
+    state (module mutable / ``ctx.__dict__`` map / ``os.environ``) that
+    is neither lock-dominated nor ``# lint: guarded=``-declared.
+
+``q3-dispatch-budget``
+    The static side of the acceptance pin: every op the fused q3 plan
+    lowers to must hold a 0-site budget and the materialization budget
+    must be exactly ``contracts.Q3_DISPATCH_HOST_SYNCS`` — so a q3
+    ``dispatch()`` provably performs its single host sync at result
+    fetch. The runtime twin is the ``q3_dispatch`` plan contract
+    (:mod:`.plans`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .ast_pass import Finding, _Analysis, build_analysis
+from .effects import (
+    FuncEffects,
+    SharedWrite,
+    SyncSite,
+    call_path,
+    compute_effects,
+    reachable,
+)
+
+#: entry points outside Table that complete the certified surface
+_EXTRA_ENTRY_CLASSES = {
+    ("cylon_tpu.frame", "DataFrame"),
+    ("cylon_tpu.plan.lazy", "LazyFrame"),
+}
+
+_DUNDER = "__"
+
+
+@dataclass
+class EffectReport:
+    entry: str               # short name, e.g. "Table.filter"
+    qualname: str
+    signature: str           # DISPATCH_SAFE | MATERIALIZE | SYNC (+flags)
+    sync_sites: List[SyncSite]
+    sync_paths: List[List[str]]
+    materialize: bool
+    delegations: List[str]   # budget owners this entry hands off to
+    unguarded_writes: List[SharedWrite]
+
+
+def _short_name(qual: str) -> str:
+    parts = qual.split(".")
+    if len(parts) >= 2 and parts[-2][:1].isupper():
+        return ".".join(parts[-2:])
+    return parts[-1]
+
+
+def public_entries(an: _Analysis, package: Optional[str]) -> Dict[str, str]:
+    """{short name: qualname} of the certified dispatch surface: public
+    (non-underscore, non-dunder) methods of Table, DataFrame and
+    LazyFrame. Fixture trees (package=None) expose every top-level
+    public function instead."""
+    out: Dict[str, str] = {}
+    if package is None:
+        for qual, fi in an.funcs.items():
+            name = qual.rsplit(".", 1)[-1]
+            if (
+                fi.parent is None
+                and fi.class_name is None
+                and not name.startswith("_")
+            ):
+                out[name] = qual
+        return out
+    classes = {(f"{package}.table", "Table")} | _EXTRA_ENTRY_CLASSES
+    for qual, fi in an.funcs.items():
+        name = qual.rsplit(".", 1)[-1]
+        if fi.parent is not None or name.startswith(_DUNDER):
+            continue
+        if name.startswith("_"):
+            continue
+        if (fi.module, fi.class_name) in classes:
+            out[f"{fi.class_name}.{name}"] = qual
+    return out
+
+
+def _owned_sites(
+    effects: Dict[str, FuncEffects], visited: Sequence[str]
+) -> List[SyncSite]:
+    sites: List[SyncSite] = []
+    seen = set()
+    for qual in visited:
+        for s in effects.get(qual, FuncEffects()).sync_sites:
+            k = (s.file, s.line)
+            if k not in seen:
+                seen.add(k)
+                sites.append(s)
+    return sites
+
+
+def classify_entry(
+    an: _Analysis,
+    effects: Dict[str, FuncEffects],
+    qual: str,
+    budgets: Dict[str, "object"],
+    entry_name: str = "",
+) -> EffectReport:
+    """One entry point's effect signature, with call-path attribution."""
+    stop = [s for s in budgets if not qual.endswith(s)]
+    visited, parent, delegations = reachable(an, qual, stop_at=stop)
+    sites = _owned_sites(effects, visited)
+    paths = [call_path(parent, qual, s.qualname) for s in sites]
+    materialize = any(
+        effects.get(q, FuncEffects()).materialize_refs for q in visited
+    )
+    # delegation to a non-amortized owner with a positive budget is a
+    # dispatch-time sync; amortized owners (cached measurements, the
+    # deferred result fetch) only lift the entry to MATERIALIZE
+    delegated_sync = False
+    delegated_amortized = False
+    for owner in delegations:
+        for suffix, b in budgets.items():
+            if owner.endswith(suffix) and b.sites > 0:
+                if b.amortized:
+                    delegated_amortized = True
+                else:
+                    delegated_sync = True
+    if sites or delegated_sync:
+        sig = "SYNC"
+    elif materialize or delegated_amortized:
+        sig = "MATERIALIZE"
+    else:
+        sig = "DISPATCH_SAFE"
+    unguarded = [
+        w
+        for q in visited
+        for w in effects.get(q, FuncEffects()).shared_writes
+        if not w.guarded
+    ]
+    if unguarded:
+        sig += "+MUTATES_SHARED"
+    return EffectReport(
+        entry=entry_name or _short_name(qual),
+        qualname=qual,
+        signature=sig,
+        sync_sites=sites,
+        sync_paths=paths,
+        materialize=materialize,
+        delegations=sorted(delegations),
+        unguarded_writes=unguarded,
+    )
+
+
+def _fmt_path(path: List[str]) -> str:
+    return " -> ".join(p.split(".")[-1] for p in path)
+
+
+def run_effect_pass(
+    root: str,
+    package: Optional[str] = None,
+    files: Optional[Sequence[str]] = None,
+    entries: Optional[Dict[str, str]] = None,
+    budgets: Optional[Dict[str, "object"]] = None,
+    signatures: Optional[Dict[str, str]] = None,
+    knob_kinds: Optional[Dict[str, str]] = None,
+) -> Tuple[List[Finding], Dict[str, EffectReport]]:
+    """Run Layer 3 over ``root``; returns (findings, {entry: report}).
+
+    On the live tree (``package='cylon_tpu'``) the budgets and pinned
+    signatures default to :mod:`.contracts`; fixtures pass explicit
+    ``entries``/``budgets``/``signatures`` (possibly empty dicts).
+    """
+    from . import contracts
+
+    if knob_kinds is None and package is None:
+        knob_kinds = {}
+    an, sources = build_analysis(root, package, knob_kinds, files)
+    effects = compute_effects(an)
+    if budgets is None:
+        budgets = contracts.SYNC_SITE_BUDGETS
+    if signatures is None and package is not None:
+        signatures = contracts.EFFECT_SIGNATURES
+    entry_map = entries if entries is not None else public_entries(an, package)
+
+    findings: List[Finding] = []
+    reports: Dict[str, EffectReport] = {}
+
+    # ---- sync-budget: every owner polices its own sites exactly
+    for suffix, budget in budgets.items():
+        owners = [q for q in an.funcs if q.endswith(suffix)]
+        for qual in owners:
+            rep = classify_entry(an, effects, qual, budgets, suffix)
+            if len(rep.sync_sites) != budget.sites:
+                detail = "; ".join(
+                    f"{s.kind}@{s.file}:{s.line} via {_fmt_path(p)}"
+                    for s, p in zip(rep.sync_sites, rep.sync_paths)
+                ) or "none"
+                findings.append(
+                    Finding(
+                        rule="sync-budget",
+                        file=an.modules[an.funcs[qual].module].path,
+                        line=an.funcs[qual].node.lineno,
+                        func=qual,
+                        name=suffix,
+                        message=(
+                            f"{len(rep.sync_sites)} reachable host-sync "
+                            f"site(s), budget pins {budget.sites} "
+                            f"(sites: {detail}) — a new sync breaks the "
+                            "dispatch-async contract; a removed one is a "
+                            "pin update in analysis/contracts.py"
+                        ),
+                    )
+                )
+
+    # ---- per-entry signatures + unguarded writes
+    for name, qual in sorted(entry_map.items()):
+        if qual not in an.funcs:
+            continue
+        rep = classify_entry(an, effects, qual, budgets, name)
+        reports[name] = rep
+        fi = an.funcs[qual]
+        path = an.modules[fi.module].path
+        for w in rep.unguarded_writes:
+            findings.append(
+                Finding(
+                    rule="unguarded-shared-write",
+                    file=w.file,
+                    line=w.line,
+                    func=qual,
+                    name=w.target,
+                    message=(
+                        f"write to cross-query shared state reachable from "
+                        f"public entry {name} is not lock-dominated: guard "
+                        "it (with <lock>:) or declare `# lint: guarded="
+                        "<lock>` with the audited mechanism"
+                    ),
+                )
+            )
+        if signatures is None:
+            continue
+        declared = signatures.get(name)
+        if declared is None:
+            findings.append(
+                Finding(
+                    rule="effect-unpinned",
+                    file=path,
+                    line=fi.node.lineno,
+                    func=qual,
+                    name=name,
+                    message=(
+                        f"public entry point has no pinned effect signature "
+                        f"(computed: {rep.signature}); add it to "
+                        "analysis/contracts.py EFFECT_SIGNATURES"
+                    ),
+                )
+            )
+        elif declared != rep.signature:
+            detail = "; ".join(
+                f"{s.kind}@{s.file}:{s.line} via {_fmt_path(p)}"
+                for s, p in zip(rep.sync_sites, rep.sync_paths)
+            )
+            findings.append(
+                Finding(
+                    rule="effect-drift",
+                    file=path,
+                    line=fi.node.lineno,
+                    func=qual,
+                    name=name,
+                    message=(
+                        f"effect signature drifted: pinned {declared}, "
+                        f"computed {rep.signature}"
+                        + (f" (sync sites: {detail})" if detail else "")
+                        + " — fix the regression or re-pin with the change "
+                        "that moves it"
+                    ),
+                )
+            )
+
+    # ---- the static q3 dispatch pin
+    if package is not None and signatures is not None:
+        total = 0
+        for op in contracts.Q3_DISPATCH_OPS:
+            b = budgets.get(op)
+            if b is None or b.sites != 0:
+                findings.append(
+                    Finding(
+                        rule="q3-dispatch-budget",
+                        file=root,
+                        line=0,
+                        func=op,
+                        name=op,
+                        message=(
+                            f"q3 dispatch component {op} must hold a 0-site "
+                            f"sync budget, found {b.sites if b else None}"
+                        ),
+                    )
+                )
+            else:
+                total += b.sites
+        mat = budgets.get("Table._materialize_counts")
+        mat_sites = mat.sites if mat is not None else 0
+        if total + mat_sites != contracts.Q3_DISPATCH_HOST_SYNCS:
+            findings.append(
+                Finding(
+                    rule="q3-dispatch-budget",
+                    file=root,
+                    line=0,
+                    func="q3_dispatch",
+                    name="Q3_DISPATCH_HOST_SYNCS",
+                    message=(
+                        f"q3 dispatch budget sums to {total + mat_sites}, "
+                        f"contract says {contracts.Q3_DISPATCH_HOST_SYNCS} "
+                        "(exactly one sync, at result fetch)"
+                    ),
+                )
+            )
+
+    return findings, reports

@@ -10,6 +10,7 @@ synchronizing, so an explicit barrier is rarely needed).
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -73,6 +74,12 @@ class CylonContext:
         self._config: Dict[str, str] = {}
         self._sequence = itertools.count()
         self._finalized = False
+        # guards every ctx.__dict__-hosted shared map (engine._jit_cache /
+        # _plan_cache, the join's _spec_cap_hints, the memory pool) so
+        # concurrent query dispatch never races a cache build; cache HITS
+        # stay lock-free (engine.py). RLock: a plan compile holding the
+        # lock may build kernels through get_kernel on the same context.
+        self._cache_lock = threading.RLock()
 
     # -- factory ------------------------------------------------------------
     @classmethod
@@ -207,7 +214,10 @@ class CylonContext:
 
             if not available():
                 return None
-            pool = self.__dict__["_memory_pool"] = MemoryPool()
+            with self._cache_lock:
+                pool = self.__dict__.get("_memory_pool")
+                if pool is None:
+                    pool = self.__dict__["_memory_pool"] = MemoryPool()
         return pool
 
     def memory_usage(self) -> int:

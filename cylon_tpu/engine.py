@@ -18,6 +18,7 @@ one XLA program per (shapes, statics) combination, compiled once and reused.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Tuple
 
 import jax
@@ -31,7 +32,25 @@ from .utils.tracing import bump
 # kernel-invocation recording for roofline analysis (benchmarks/roofline.py):
 # when enabled, every get_kernel dispatch appends (compiled_fn, args) so a
 # bench can re-trace exactly the programs an eager op chain executed.
+# lint: guarded=gil -- single-flag swap + list.append are GIL-atomic; the
+# recorder is a single-threaded bench/analysis harness, never a serving path
 _KERNEL_RECORD = None
+
+# fallback creator for contexts built before the per-context cache lock
+# existed (pickled/duck-typed contexts): serializes ONLY lock creation
+_LOCK_FALLBACK = threading.Lock()
+
+
+def cache_lock(ctx) -> "threading.RLock":
+    """The per-context lock guarding every ``ctx.__dict__``-hosted shared
+    map (``_jit_cache``, ``_plan_cache``, ``_spec_cap_hints``, the memory
+    pool). Created in ``CylonContext.__init__``; the fallback path covers
+    foreign context objects without racing the lock's own creation."""
+    lock = getattr(ctx, "_cache_lock", None)
+    if lock is None:
+        with _LOCK_FALLBACK:
+            lock = ctx.__dict__.setdefault("_cache_lock", threading.RLock())
+    return lock
 
 
 def record_kernels(enable: bool) -> None:
@@ -61,6 +80,8 @@ def record_dispatch(fn, *args) -> None:
         else x,
         args,
     )
+    # lint: guarded=gil -- list.append is GIL-atomic and the recorder is a
+    # single-threaded bench/analysis harness, never enabled while serving
     _KERNEL_RECORD.append((fn, spec))
 
 
@@ -94,26 +115,37 @@ def get_kernel(
     1-device mesh, where shard_map is a no-op): compiled ``pallas_call``
     under jit(shard_map) hits an unbounded-recursion jax bug on TPU.
     Caching and kernel recording behave identically either way."""
-    cache = ctx.__dict__.setdefault("_jit_cache", {})
+    cache = ctx.__dict__.get("_jit_cache")
+    if cache is None:
+        with cache_lock(ctx):
+            cache = ctx.__dict__.setdefault("_jit_cache", {})
     # wrapping flags are part of the identity: same logical key with a
     # different shard_map/vma wrapping must not alias to the first program
     key = key + (bool(use_shard_map), bool(check_vma))
+    # the hot path stays lock-cheap: a dict read is GIL-atomic, and an
+    # entry is published only AFTER it is fully built (under the lock)
     fn = cache.get(key)
     if fn is None:
-        kernel = builder()
-        if use_shard_map:
-            fn = jax.jit(
-                shard_map(
-                    kernel,
-                    mesh=ctx.mesh,
-                    in_specs=(PartitionSpec(ctx.axis_name), PartitionSpec()),
-                    out_specs=PartitionSpec(ctx.axis_name),
-                    check_vma=check_vma,
-                )
-            )
-        else:
-            fn = jax.jit(kernel)
-        cache[key] = fn
+        with cache_lock(ctx):
+            fn = cache.get(key)  # double-check: lost the build race
+            if fn is None:
+                kernel = builder()
+                if use_shard_map:
+                    fn = jax.jit(
+                        shard_map(
+                            kernel,
+                            mesh=ctx.mesh,
+                            in_specs=(
+                                PartitionSpec(ctx.axis_name),
+                                PartitionSpec(),
+                            ),
+                            out_specs=PartitionSpec(ctx.axis_name),
+                            check_vma=check_vma,
+                        )
+                    )
+                else:
+                    fn = jax.jit(kernel)
+                cache[key] = fn
     if _KERNEL_RECORD is None:
         return fn
 
@@ -144,22 +176,38 @@ def plan_executable(ctx: CylonContext, fingerprint, compile_fn):
 
     Returns ``(entry, hit)``; hits/misses are counted in the tracing
     registry (``plan.cache.hit`` / ``plan.cache.miss``) for tests and
-    benchmarks to assert on.
+    benchmarks to assert on — counter updates are atomic (the tracing
+    registry serializes them under its own lock).
+
+    Thread discipline: hits are lock-free (GIL-atomic dict read of a
+    fully-published entry); the miss path compiles UNDER the per-context
+    lock, so a cache stampede (many threads racing the first compile of
+    one fingerprint) compiles exactly once — the losers block, then hit.
     """
-    cache = ctx.__dict__.setdefault("_plan_cache", {})
+    cache = ctx.__dict__.get("_plan_cache")
+    if cache is None:
+        with cache_lock(ctx):
+            cache = ctx.__dict__.setdefault("_plan_cache", {})
     entry = cache.get(fingerprint)
     if entry is not None:
         bump("plan.cache.hit")
         return entry, True
-    bump("plan.cache.miss")
-    entry = compile_fn()
-    # bounded: literal values are part of the fingerprint, so a literal
-    # sweep (filter(col('v') > t) for many t) would otherwise grow one
-    # entry per value for the context's lifetime. FIFO eviction — dropping
-    # an entry only costs a re-optimize, the jitted kernels stay cached.
-    if len(cache) >= _PLAN_CACHE_MAX:
-        cache.pop(next(iter(cache)))
-    cache[fingerprint] = entry
+    with cache_lock(ctx):
+        entry = cache.get(fingerprint)
+        if entry is not None:
+            # stampede loser: the winner compiled while we waited
+            bump("plan.cache.hit")
+            return entry, True
+        bump("plan.cache.miss")
+        entry = compile_fn()
+        # bounded: literal values are part of the fingerprint, so a literal
+        # sweep (filter(col('v') > t) for many t) would otherwise grow one
+        # entry per value for the context's lifetime. FIFO eviction —
+        # dropping an entry only costs a re-optimize, the jitted kernels
+        # stay cached.
+        if len(cache) >= _PLAN_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[fingerprint] = entry
     return entry, False
 
 

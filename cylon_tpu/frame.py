@@ -139,7 +139,10 @@ class DataFrame:
 
     @property
     def shape(self) -> Tuple[int, int]:
-        return self._table.shape
+        # via row_count, not Table.shape: property reads are invisible to
+        # the L3 call graph, and row_count is the attribute the effect
+        # pass tracks as the deferred-count materialization funnel
+        return (self._table.row_count, self._table.column_count)
 
     def __len__(self) -> int:
         return self._table.row_count

@@ -249,7 +249,9 @@ def read_csv(
 
 
 _io_pool = None
-_io_pool_lock = threading.Lock()
+# RLock: _write_csv_one holds it across the whole native write (arena
+# reset + row emit) and _stage re-acquires it for first-use pool creation
+_io_pool_lock = threading.RLock()
 
 
 def _stage(data: np.ndarray, want) -> np.ndarray:
@@ -261,7 +263,11 @@ def _stage(data: np.ndarray, want) -> np.ndarray:
     if data.dtype == want and data.flags["C_CONTIGUOUS"]:
         return data
     if _io_pool is None and native.available():
-        _io_pool = native.MemoryPool(block_bytes=4 << 20)
+        # double-checked under the io lock: two concurrent writers must
+        # not each build (and leak) an arena (graft-lint L3 finding)
+        with _io_pool_lock:
+            if _io_pool is None:
+                _io_pool = native.MemoryPool(block_bytes=4 << 20)
     if _io_pool is None:
         return np.ascontiguousarray(data, dtype=want)
     out = _io_pool.alloc_array(data.shape, want)

@@ -181,7 +181,27 @@ class LazyFrame:
         return "\n".join(lines)
 
     def collect(self):
-        """Optimize, lower and execute the plan; returns an eager Table."""
+        """Optimize, lower and execute the plan; returns an eager Table
+        with host-known row counts (the result's deferred count lane is
+        materialized — ONE host sync — before returning)."""
+        t = self.dispatch()
+        t._materialize()
+        return t
+
+    def dispatch(self):
+        """Execute the plan WITHOUT the result-count host sync — the
+        ``collect_async`` precursor for concurrent query serving.
+
+        Every lowered single-dispatch eager op defers its count fetch, so
+        the whole chain is queued on the device with ZERO host syncs (for
+        sync-free plan shapes, e.g. the fused q3 join->groupby-SUM) and
+        the returned Table's buffers may still be in flight. Its row
+        counts materialize — the ONE host sync, attributed to
+        ``_materialize_counts`` — on first access (``row_counts`` /
+        ``to_pydict`` / ...). graft-lint pins this: the ``q3_dispatch``
+        contract (analysis/contracts.py) requires exactly one sync, at
+        result fetch, both statically (L3 sync budgets) and at runtime
+        (the monitored fetch census)."""
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
         from ..ops.sketch import enabled as _semi_enabled

@@ -12,8 +12,13 @@ the P shards owns ``shard_cap`` physical rows of every column, of which the
 first ``row_counts[i]`` are live (front-packed); the rest are padding. All
 relational kernels are static-shaped jit programs under shard_map; data-
 dependent output sizes use a single dispatch with a static bound where one
-exists (set ops/unique/groupby; joins speculate, falling back to the exact
-count->emit two-phase on overflow) — one host sync per op either way.
+exists (filter/set ops/unique/groupby; joins speculate, falling back to the
+exact count->emit two-phase on overflow). Single-dispatch ops DEFER their
+output-count fetch: the result Table carries the device count lane and the
+host sync happens at result materialization (``_materialize_counts``), so an
+eager op chain dispatches end-to-end with zero host syncs and ONE fetch at
+the end — the dispatch-async discipline graft-lint's L3 sync budgets pin
+(analysis/contracts.py SYNC_SITE_BUDGETS).
 
 "Local" ops act independently per shard (== per MPI rank in the reference);
 "distributed_*" ops are collective over the mesh.
@@ -21,6 +26,7 @@ count->emit two-phase on overflow) — one host sync per op either way.
 from __future__ import annotations
 
 import numbers
+import threading
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
@@ -32,6 +38,7 @@ import numpy as np
 from .column import Column, unify_dictionaries
 from .context import CylonContext
 from .dtypes import DataType, Type
+from . import engine as _engine
 from .engine import get_kernel, round_cap, shard_caps
 from . import ordering as _ord
 from .ordering import Ordering
@@ -68,6 +75,12 @@ def _speculative_join() -> bool:
 def _scalar(x) -> jax.Array:
     """Per-shard [1] arrays carry scalars through shard_map."""
     return x.reshape(1) if hasattr(x, "reshape") else jnp.asarray([x])
+
+
+@jax.jit
+def _as_i32(x):
+    """Dtype-normalize a deferred count lane on device (no host sync)."""
+    return x.astype(jnp.int32)
 
 
 def _fetch(arr) -> np.ndarray:
@@ -173,7 +186,21 @@ class Table:
     ):
         self.ctx = ctx
         self._columns: "OrderedDict[str, Column]" = columns
-        self._row_counts = np.asarray(row_counts, np.int64)
+        # row_counts may be a HOST array (known counts) or a DEVICE [P]
+        # per-shard count lane still in flight: single-dispatch eager ops
+        # (filter/groupby/set-ops/unique/fused join+sum) hand their count
+        # output straight through, DEFERRING the device->host sync to
+        # result materialization (_materialize_counts) — the dispatch-
+        # async property the graft-lint L3 sync budgets pin (filter/
+        # project/groupby = 0 host syncs at dispatch time).
+        self._counts_fut = None
+        self._counts_host = None
+        self._mat_lock = None
+        if isinstance(row_counts, jax.Array):
+            self._counts_fut = row_counts
+            self._mat_lock = threading.Lock()
+        else:
+            self._counts_host = np.asarray(row_counts, np.int64)
         self._shard_cap = int(shard_cap)
         self._counts_dev = None
         # sortedness metadata (cylon_tpu/ordering.py): None unless an op
@@ -217,6 +244,69 @@ class Table:
     @property
     def row_counts(self) -> np.ndarray:
         return self._row_counts
+
+    # -- deferred-count plumbing (the L3 sync-freedom refactor) --------
+    @property
+    def _row_counts(self) -> np.ndarray:
+        """Host per-shard live-row counts; materializes a deferred count
+        lane on first access (THE one host sync of a dispatched chain)."""
+        if self._counts_host is None:
+            self._materialize_counts()
+        return self._counts_host
+
+    @_row_counts.setter
+    def _row_counts(self, value) -> None:
+        self._counts_host = np.asarray(value, np.int64)
+        self._counts_fut = None
+        self._counts_dev = None
+
+    @property
+    def _counts_raw(self):
+        """Counts WITHOUT forcing materialization: the host array when
+        known, else the in-flight device lane. Pass this (never
+        ``_row_counts``) when handing counts to a new Table so a deferred
+        chain stays sync-free."""
+        return self._counts_host if self._counts_host is not None else self._counts_fut
+
+    def _rows_hint(self) -> Optional[int]:
+        """``row_count`` when already host-known, else None. Tracing spans
+        use this so observability never forces the materialization sync."""
+        return (
+            None if self._counts_host is None else int(self._counts_host.sum())
+        )
+
+    def _materialize(self) -> "Table":
+        """Force the deferred count fetch (no-op when counts are known)."""
+        if self._counts_host is None:
+            self._materialize_counts()
+        return self
+
+    def _materialize_counts(self) -> None:
+        """THE deferred device->host sync of the dispatch-async eager ops:
+        fetch the per-shard count lane recorded at dispatch time, then
+        apply the overshoot compaction the op would have applied eagerly
+        (round the capacity down when the static bound overshot the
+        realized max shard count by >= 4x — the ``_maybe_compact``
+        policy, applied in place so every holder of this handle sees the
+        compacted buffers)."""
+        with self._mat_lock:
+            if self._counts_host is not None:
+                return  # lost the race: the other thread materialized
+            bump("host_sync")
+            got = _fetch(self._counts_fut).reshape(-1).astype(np.int64)
+            tight = round_cap(int(got.max()) if got.size else 0)
+            if tight * 4 <= self._shard_cap:
+                compacted = self._compact(tight)
+                self._columns = compacted._columns
+                self._shard_cap = compacted._shard_cap
+                self._counts_dev = None
+            # publish LAST: the lock-free fast paths (_row_counts /
+            # _materialize / _rows_hint) key on _counts_host, so it must
+            # never be visible while the in-place compaction is still
+            # swapping _columns/_shard_cap — and _counts_fut is cleared
+            # only after, so _counts_raw never observes both None
+            self._counts_host = got
+            self._counts_fut = None
 
     @property
     def world_size(self) -> int:
@@ -352,7 +442,7 @@ class Table:
 
                 return kern
 
-            with span("stats.measure", rows=int(self.row_count)):
+            with span("stats.measure", rows=self._rows_hint()):
                 got = get_kernel(self.ctx, key, build)(
                     (flat, self.counts_dev), ()
                 )
@@ -586,10 +676,12 @@ class Table:
         return cls.from_encoded_shards(ctx, enc_shards)
 
     def _replace(self, columns=None, row_counts=None, shard_cap=None) -> "Table":
+        # _counts_raw, not _row_counts: replacing columns/metadata on a
+        # deferred-count handle must not force the materialization sync
         return Table(
             self.ctx,
             self._columns if columns is None else columns,
-            self._row_counts if row_counts is None else row_counts,
+            self._counts_raw if row_counts is None else row_counts,
             self._shard_cap if shard_cap is None else shard_cap,
             index_name=self.index_name,
         )
@@ -690,9 +782,17 @@ class Table:
     @property
     def counts_dev(self) -> jax.Array:
         if self._counts_dev is None:
-            self._counts_dev = jax.device_put(
-                self._row_counts.astype(np.int32), self.ctx.sharding
-            )
+            fut = self._counts_fut
+            if fut is not None:
+                # deferred counts already live on the device: feed them
+                # straight into the next kernel — device->device, NO sync
+                self._counts_dev = (
+                    fut if fut.dtype == jnp.int32 else _as_i32(fut)
+                )
+            else:
+                self._counts_dev = jax.device_put(
+                    self._row_counts.astype(np.int32), self.ctx.sharding
+                )
         return self._counts_dev
 
     def _flat_cols(self, names: Optional[Sequence[str]] = None) -> List[KeyCol]:
@@ -712,10 +812,6 @@ class Table:
         # rename it away (join suffixes) drop it, like pandas
         idx = self.index_name if self.index_name in cols else None
         return Table(self.ctx, cols, row_counts, cap, index_name=idx)
-
-    def _out_counts(self, per_shard) -> np.ndarray:
-        bump("host_sync")
-        return _fetch(per_shard).astype(np.int64)
 
     def _maybe_compact(self, counts: np.ndarray, factor: int = 4) -> "Table":
         """Single-sourced overshoot policy: slice the physical capacity down
@@ -751,7 +847,7 @@ class Table:
         return self._rebuild_cols(
             list(zip(self.column_names, self._columns.values())),
             out,
-            self._row_counts,
+            self._counts_raw,
             new_cap,
         )
 
@@ -945,45 +1041,33 @@ class Table:
         m = self._as_mask(mask)
         names = self.column_names
         flat = self._flat_cols()
-        key = ("filter", len(flat))
-
-        def build_count():
-            def kern(dp, rep):
-                (m, counts) = dp
-                n = counts[0]
-                cap = m.shape[0]
-                live = jnp.arange(cap, dtype=jnp.int32) < n
-                return _scalar(jnp.sum(m & live).astype(jnp.int32))
-
-            return kern
-
-        cnts = get_kernel(self.ctx, key + ("count",), build_count)(
-            (m, self.counts_dev), ()
-        )
-        cnts = self._out_counts(cnts)
-        cap_out = round_cap(int(cnts.max()))
+        # Single-dispatch, sync-free: the output is a subset of the input
+        # rows, so cap_out = shard_cap is a static exact upper bound (the
+        # set-op/groupby design) — no count phase, no fetch at all; the
+        # count lane rides the result and materializes on first access,
+        # compacting the overshoot then (L3 sync budget: filter = 0).
+        cap_out = self._shard_cap
+        key = ("filter", len(flat), "fused")
 
         def build_emit():
             def kern(dp, rep):
                 (m, cols, counts) = dp
-                (dummy,) = rep
-                co = dummy.shape[0]
                 n = counts[0]
                 cap = m.shape[0]
                 live = jnp.arange(cap, dtype=jnp.int32) < n
-                idx, total = _s.compact_mask(m & live, co)
+                idx, total = _s.compact_mask(m & live, cap)
                 out, _ = _g_pack.pack_gather(list(cols), idx)
                 return out, _scalar(total)
 
             return kern
 
-        out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
-            (m, flat, self.counts_dev), (jnp.zeros((cap_out,), jnp.int8),)
+        out, nout = get_kernel(self.ctx, key, build_emit)(
+            (m, flat, self.counts_dev), ()
         )
         # a row-subset in input order: the sortedness descriptor survives
         # (and range bounds stay conservative over any subset)
         return self._rebuild_cols(
-            list(zip(names, self._columns.values())), out, self._out_counts(nout), cap_out
+            list(zip(names, self._columns.values())), out, nout, cap_out
         )._attach_ordering(self._ordering)._attach_stats(self._stats)
 
     def select(self, predicate) -> "Table":
@@ -1042,12 +1126,18 @@ class Table:
             full[i * cap_out : i * cap_out + counts[i]] = phys[o[i] : o[i + 1]]
         idx_dev = jax.device_put(full, self.ctx.sharding)
         # one cached jitted gather per context (a fresh jax.jit each call
-        # would retrace + recompile every take())
+        # would retrace + recompile every take()); published under the
+        # context cache lock like every other _jit_cache entry
         cache = self.ctx.__dict__.setdefault("_jit_cache", {})
         gather = cache.get(("take_gather",))
         if gather is None:
-            gather = jax.jit(lambda d, i: d[i], out_shardings=self.ctx.sharding)
-            cache[("take_gather",)] = gather
+            with _engine.cache_lock(self.ctx):
+                gather = cache.get(("take_gather",))
+                if gather is None:
+                    gather = jax.jit(
+                        lambda d, i: d[i], out_shardings=self.ctx.sharding
+                    )
+                    cache[("take_gather",)] = gather
         cols: "OrderedDict[str, Column]" = OrderedDict()
         for n, c in self._columns.items():
             d = gather(c.data, idx_dev)
@@ -1145,10 +1235,13 @@ class Table:
         if fuse is not None:
             bump("lane_pack.sort_fused",
                  rows=fuse.n_plain - fuse.n_words)
-        with span("sort", rows=int(self.row_count)):
+        with span("sort", rows=self._rows_hint()):
             out = get_kernel(self.ctx, key, build)((flat, self.counts_dev), ())
+        # a sort permutes rows within each shard: counts are unchanged, so
+        # a deferred count lane passes straight through (no forced sync)
         res = self._rebuild_cols(
-            list(zip(all_names, self._columns.values())), out, self._row_counts, self._shard_cap
+            list(zip(all_names, self._columns.values())), out,
+            self._counts_raw, self._shard_cap,
         )._attach_stats(self._stats)
         mask_free = all(self._columns[n].valid is None for n in names)
         return res._attach_ordering(Ordering(
@@ -1337,7 +1430,7 @@ class Table:
 
             return kern
 
-        with span("bucket_pack", rows=int(self.row_count)):
+        with span("bucket_pack", rows=self._rows_hint()):
             out, bcounts = get_kernel(self.ctx, key, build)(
                 (kflat, flat, self.counts_dev), ()
             )
@@ -1516,7 +1609,7 @@ class Table:
 
                 return kern
 
-            with span("join.speculative", rows=int(self.row_count)):
+            with span("join.speculative", rows=self._rows_hint()):
                 out, stats = get_kernel(
                     self.ctx, key + ("spec",), build_spec, **emit_kw
                 )(
@@ -1541,9 +1634,15 @@ class Table:
                 )
             # speculation overflowed: remember the observed size so the next
             # join with this signature speculates wide enough immediately
-            hints[key] = round_cap(int(totals.max()))
+            # (guarded: the hints map is ctx-shared across concurrent
+            # queries; reads stay lock-free — a lost read only re-pays the
+            # one-time wasted speculative dispatch)
+            with _engine.cache_lock(self.ctx):
+                hints[key] = round_cap(int(totals.max()))
 
-        # phase 1: probe (the sorts) — returns reusable probe state + count
+        # phase 1: probe (the sorts) — returns reusable probe state + count.
+        # Count + overflow shadow ride ONE packed [2] i32 lane (the spec
+        # path's single-fetch discipline), so the exact path syncs once.
         def build_probe():
             def kern(dp, rep):
                 (lk, rk, nl, nr) = dp
@@ -1555,15 +1654,25 @@ class Table:
                 )
                 total = _j.count_from_probe(cnt, r_cnt, nl[0], nr[0], howi)
                 shadow = _j.count_overflow_check(cnt, r_cnt)
-                return lo, cnt, r_order, r_cnt, _scalar(total), _scalar(shadow)
+                stats = jnp.stack(
+                    [
+                        total.astype(jnp.int32),
+                        jax.lax.bitcast_convert_type(
+                            shadow.astype(jnp.float32), jnp.int32
+                        ),
+                    ]
+                )
+                return lo, cnt, r_order, r_cnt, stats
 
             return kern
 
-        lo, cnt, r_order, r_cnt, cnts, shadows = get_kernel(
+        lo, cnt, r_order, r_cnt, pstats = get_kernel(
             self.ctx, key + ("probe",), build_probe
         )((lflat_k, rflat_k, left.counts_dev, right.counts_dev), ())
-        cnts = self._out_counts(cnts)
-        _check_join_count(cnts, _fetch(shadows))
+        bump("host_sync")
+        pstats = _fetch(pstats).reshape(-1, 2)
+        cnts = pstats[:, 0].astype(np.int64)
+        _check_join_count(cnts, pstats[:, 1].copy().view(np.float32))
         cap_out = round_cap(int(cnts.max()))
 
         # phase 2: emit + gather, reusing the probe state (no re-sort)
@@ -1582,7 +1691,7 @@ class Table:
 
             return kern
 
-        out, nout = get_kernel(
+        out, _nout = get_kernel(
             self.ctx, key + ("emit",), build_emit, **emit_kw
         )(
             (lo, cnt, r_order, r_cnt, lflat, rflat, left.counts_dev, right.counts_dev),
@@ -1592,9 +1701,10 @@ class Table:
         # (reference join_utils.cpp:28-160 suffix renaming). This exact
         # two-phase path always emits LEFT order (a key-order request that
         # overflowed speculation degrades to no descriptor, never an
-        # unsound claim).
+        # unsound claim). The emit's count lane equals the probe's already-
+        # fetched counts — reuse them, no second sync.
         return self._rebuild_cols(
-            list(zip(out_names, src_cols)), out, self._out_counts(nout), cap_out
+            list(zip(out_names, src_cols)), out, cnts, cap_out
         )._attach_ordering(carry_ordering)
 
     def _pallas_pk_join(
@@ -1673,7 +1783,7 @@ class Table:
 
             return kern
 
-        with span("join.pallas_pk", rows=int(self.row_count)):
+        with span("join.pallas_pk", rows=self._rows_hint()):
             args = (lk, rk, lflat, rflat, left.counts_dev, right.counts_dev)
             # world==1: shard_map is a no-op AND its compiled-pallas
             # recursion bug is avoided (use_shard_map=False). Multi-device
@@ -1855,7 +1965,7 @@ class Table:
                     bucket_cap, join_cap, respill, num_slices,
                 )
                 cache[key] = step
-            with span("join.fused", rows=int(self.row_count)):
+            with span("join.fused", rows=self._rows_hint()):
                 from .engine import record_dispatch
 
                 record_dispatch(
@@ -1933,7 +2043,8 @@ class Table:
         order) then ``out_val`` = per-group sum over the join result.
         ``group_cap = min(cap_l, cap_r)`` is a static EXACT bound (a group
         needs a live row on both sides), so like groupby there is no count
-        phase and ONE host sync."""
+        phase and NO host sync: the count fetch is deferred to result
+        materialization (the q3 ``dispatch()`` single-sync pin)."""
         left, right = self, other
         lk_idx = tuple(left.column_names.index(n) for n in left_on)
         rk_idx = tuple(right.column_names.index(n) for n in right_on)
@@ -1974,13 +2085,10 @@ class Table:
 
             return kern
 
-        with span(
-            "join.sum_pushdown", rows=int(self.row_count + other.row_count)
-        ):
+        with span("join.sum_pushdown", rows=self._rows_hint()):
             out, nout = get_kernel(self.ctx, key, build)(
                 (lflat, left.counts_dev, rflat, right.counts_dev), ()
             )
-            counts = self._out_counts(nout)  # the ONE host sync
         cols_od: "OrderedDict[str, Column]" = OrderedDict()
         for name, srcn, (d, v) in zip(
             out_key_names, left_on, out[: len(left_on)]
@@ -1989,10 +2097,12 @@ class Table:
             cols_od[name] = Column(d, src.dtype, v, src.dictionary)
         d, v = out[-1]
         cols_od[out_val] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
-        res = Table(self.ctx, cols_od, counts, group_cap)
+        # deferred counts: the fetch (and the overshoot compaction) happen
+        # at result materialization — a dispatched q3 chain stays sync-free
+        res = Table(self.ctx, cols_od, nout, group_cap)
         # groups emit in canonical key order (join_sum_by_key_pushdown
         # numbers them over the merged kv-sort)
-        return res._maybe_compact(counts)._attach_ordering(Ordering(
+        return res._attach_ordering(Ordering(
             keys=tuple(out_key_names),
             ascending=(True,) * len(out_key_names),
             nulls_last=True, scope="shard", canonical=True,
@@ -2044,9 +2154,9 @@ class Table:
         Single-dispatch: the output is a subset of the input rows, so
         cap_out is a static exact upper bound (left cap for subtract/
         intersect, cap_l + cap_r for union) — no count phase, no overflow
-        possible, ONE host sync (the join speculative design, but with
-        speculation that can never miss). A selective result is compacted
-        after the fact like the join's. Subtract and intersect share ONE
+        possible, no dispatch-time host sync (the count fetch defers to
+        result materialization). A selective result is compacted there
+        like the join's. Subtract and intersect share ONE
         program: the op rides in as a replicated traced scalar
         (setops.setop_emit), not a cache key; union's differing cap_out
         and two-source gather make it its own program."""
@@ -2110,15 +2220,15 @@ class Table:
             return kern
 
         rep = () if is_union else (jnp.asarray(op == "intersect"),)
-        with span(f"setop.{op}", rows=int(self.row_count)):
+        with span(f"setop.{op}", rows=self._rows_hint()):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
                 (lflat, rflat, a.counts_dev, b.counts_dev), rep
             )
-            counts = self._out_counts(nout)  # the ONE host sync
+        # deferred counts: fetch + overshoot compaction happen at result
+        # materialization (L3 sync budget: set ops = 0 at dispatch time)
         res = a._rebuild_cols(
-            list(zip(a.column_names, a._columns.values())), out, counts, cap_out
+            list(zip(a.column_names, a._columns.values())), out, nout, cap_out
         )
-        res = res._maybe_compact(counts)
         if not is_union:
             # subtract/intersect keep a subset of LEFT rows in left order
             res = res._attach_ordering(self._ordering)._attach_stats(
@@ -2182,8 +2292,9 @@ class Table:
         out_idx = tuple(all_names.index(n) for n, _ in out_pairs)
         flat = self._flat_cols()
         # Single-dispatch: dedup output is a subset of the input rows, so
-        # cap_out = shard_cap is a static exact upper bound — no count phase,
-        # ONE host sync; selective results are compacted afterwards.
+        # cap_out = shard_cap is a static exact upper bound — no count
+        # phase, no dispatch-time host sync (deferred count fetch);
+        # selective results compact at materialization.
         cap_out = self.shard_cap
         # order-property reuse: input canonically ordered by the dedup keys
         # -> run-detect + mask compaction instead of the two canonical sorts
@@ -2223,15 +2334,15 @@ class Table:
 
             return kern
 
-        with span("unique", rows=int(self.row_count)):
+        with span("unique", rows=self._rows_hint()):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
                 (flat, self.counts_dev), ()
             )
-            counts = self._out_counts(nout)  # the ONE host sync
-        res = self._rebuild_cols(out_pairs, out, counts, cap_out)
+        # deferred counts: fetch + overshoot compaction at materialization
+        res = self._rebuild_cols(out_pairs, out, nout, cap_out)
         # dedup keeps a subset of rows in input order: descriptor survives
         # (range bounds likewise)
-        return res._maybe_compact(counts)._attach_ordering(
+        return res._attach_ordering(
             self._ordering
         )._attach_stats(self._stats)
 
@@ -2320,8 +2431,9 @@ class Table:
         ops_t = tuple(oid for _, oid, _ in specs)
         flat = self._flat_cols()
         # Single-dispatch: num_groups <= live rows, so cap_out = shard_cap is
-        # a static exact upper bound — no count phase, ONE host sync (same
-        # design as the set-ops); selective results compact afterwards.
+        # a static exact upper bound — no count phase, NO dispatch-time host
+        # sync (the count fetch defers to result materialization); selective
+        # results compact there.
         cap_out = self.shard_cap
         key = (
             "groupby", key_idx, val_idx, ops_t, ddof, quantile, len(flat),
@@ -2350,11 +2462,10 @@ class Table:
 
             return kern
 
-        with span("groupby.emit", rows=int(self.row_count)):
+        with span("groupby.emit", rows=self._rows_hint()):
             out, nout = get_kernel(self.ctx, key + ("emit",), build_emit)(
                 (flat, self.counts_dev), ()
             )
-            counts_np = self._out_counts(nout)  # the ONE host sync
         # build output schema
         names_src: List[Tuple[str, Column]] = [
             (n, self._columns[n]) for n in key_names
@@ -2367,8 +2478,11 @@ class Table:
             cols_od[n] = Column(d, src.dtype, v, src.dictionary)
         for cname, d, v in agg_cols:
             cols_od[cname] = Column(d, DataType.from_numpy_dtype(d.dtype), v, None)
-        res = Table(self.ctx, cols_od, counts_np, cap_out)
-        res = res._maybe_compact(counts_np)._attach_stats(
+        # deferred counts (L3 sync budget: groupby = 0 at dispatch time);
+        # the group-count fetch + overshoot compaction happen at result
+        # materialization
+        res = Table(self.ctx, cols_od, nout, cap_out)
+        res = res._attach_stats(
             {n: self._stats.get(n) for n in key_names}
         )
         if out_canonical:
@@ -2470,7 +2584,7 @@ class Table:
 
     def count(self, column: Union[str, int]) -> int:
         _, ok = self._masked_col(column)
-        return int(jnp.sum(ok))
+        return int(jnp.sum(ok).item())
 
     def min(self, column: Union[str, int]):
         col, ok = self._masked_col(column)
@@ -2514,6 +2628,8 @@ class Table:
             info = jnp.iinfo(d.dtype)
             big = jnp.asarray(info.max, d.dtype)
             small = jnp.asarray(info.min, d.dtype)
+        # lint: sync=device -- the np.asarray fetches the fused kernel's
+        # [2] result pair: the ONE deliberate host sync of this reducer
         both = np.asarray(_minmax_kernel(d, ok, big, small))
         return (
             self._decode_scalar(col, both[0]),
@@ -3342,8 +3458,15 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     ``shuffle.overlap_efficiency`` gauge = fraction of the exchange wall
     spent issuing overlapped work rather than blocked on the device.
     """
+    # a deferred-count input materializes UP FRONT: the shuffle is host-
+    # planned regardless (the count fetch below), and materialization
+    # applies the pending overshoot compaction — without it an uncompacted
+    # intermediate (e.g. a partial-aggregate feeding distributed_groupby's
+    # exchange) would pad every pack/sort pass to its stale capacity
+    for s in specs:
+        s.table._materialize()
     states = [_shuffle_state(s) for s in specs]
-    rows_total = sum(int(st["t"].row_count) for st in states)
+    rows_total = sum(st["t"]._rows_hint() or 0 for st in states)
 
     # phase 0: counts — dispatch every table's count kernel before fetching
     # any, so a pair's two count programs overlap on the device. Semi-
@@ -3355,7 +3478,7 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         dp = (st["flat"], st["khash"], st["t"].counts_dev)
         if spec.sketch is not None:
             dp = dp + (spec.sketch,)
-        with span("shuffle.count", rows=int(st["t"].row_count)):
+        with span("shuffle.count", rows=st["t"]._rows_hint()):
             st["counts_fut"] = get_kernel(
                 st["ctx"], st["key"] + ("count",), st["build_count"]
             )(dp, ())

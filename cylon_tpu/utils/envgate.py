@@ -225,6 +225,14 @@ TRACE = EnvKnob(
     "CYLON_TPU_TRACE", "0", kind="observability",
     note="=1 logs each tracing span as it closes; alters no program",
 )
+NO_EFFECT_LINT = EnvKnob(
+    "CYLON_TPU_NO_EFFECT_LINT", "0", kind="observability",
+    keyed_via="never reaches a compiled program: read only by "
+    "tools/graft_lint to skip the Layer-3 effect pass",
+    note="=1 skips graft-lint Layer 3 (effect/sync-freedom analysis) — "
+    "an escape hatch for a mid-incident CI unblock, never for merging "
+    "a signature drift (re-pin EFFECT_SIGNATURES instead)",
+)
 
 # -- native extension ---------------------------------------------------
 NATIVE_ASAN = EnvKnob(

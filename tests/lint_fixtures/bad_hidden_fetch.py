@@ -1,0 +1,21 @@
+"""Seeded known-bad fixture (graft-lint L3 rules ``sync-budget`` /
+``effect-drift``): the public entry point looks sync-free, but a helper
+two call hops down performs a device->host fetch. The effect pass must
+classify ``collect_stats`` as SYNC with the full call-path attribution
+(``collect_stats -> _tally -> _sum_counts``) and fail its 0-site sync
+budget. tests/test_analysis.py asserts exactly this.
+"""
+from cylon_tpu.table import _fetch
+
+
+def collect_stats(bufs):
+    """Public entry: 'just' delegates... to a hidden sync."""
+    return _tally(bufs)
+
+
+def _tally(bufs):
+    return _sum_counts(bufs)
+
+
+def _sum_counts(bufs):
+    return sum(int(_fetch(b)[0]) for b in bufs)

@@ -1,0 +1,13 @@
+"""Seeded known-bad fixture (graft-lint L3 rule
+``unguarded-shared-write``): a public entry point writes a module-level
+dict — cross-query shared state — with no dominating lock and no
+``# lint: guarded=`` declaration. Under concurrent query serving this is
+a data race; tests/test_analysis.py asserts the effect pass flags it.
+"""
+
+_RESULT_CACHE = {}
+
+
+def remember(key, value):
+    _RESULT_CACHE[key] = value
+    return value

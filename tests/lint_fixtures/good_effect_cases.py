@@ -1,0 +1,49 @@
+"""Known-good twins for the L3 effect pass (graft-lint ISSUE 7): the
+same shapes as the bad fixtures with the invariant HELD. The pass must
+report zero findings here — over-flagging these would train people to
+reach for exemptions.
+"""
+import threading
+
+_GOOD_CACHE = {}
+_cache_lock = threading.Lock()
+
+
+def remember_locked(key, value):
+    """The lock-dominated twin of bad_shared_write.remember: the write
+    is inside a ``with <lock>`` — guarded, not a finding."""
+    with _cache_lock:
+        _GOOD_CACHE[key] = value
+    return value
+
+
+def remember_published(key, value):
+    """The GIL-atomic create-or-get publish: ``dict.setdefault`` is the
+    sanctioned pattern for shared maps (engine.get_kernel), never a
+    finding."""
+    return _GOOD_CACHE.setdefault(key, value)
+
+
+def remember_declared(key, value):
+    # lint: guarded=gil -- single-word swap of an immutable value; the
+    # audited GIL-atomic publish (no torn read is observable)
+    _GOOD_CACHE[key] = value
+    return value
+
+
+def stage_host(rows):
+    """The ``# lint: sync=host`` reclassification twin: ``.item()`` on a
+    HOST value (a numpy scalar) is not a device sync."""
+    # lint: sync=host -- rows is a host numpy array; .item() is a plain
+    # python conversion, no device transfer involved
+    return [r.item() for r in rows]
+
+
+def dispatch_chain(table, mask):
+    """A genuinely dispatch-safe public entry: device-side delegation
+    only, no fetch, no shared write, no count read."""
+    return _narrow(table, mask)
+
+
+def _narrow(table, mask):
+    return table.filter(mask)

@@ -303,3 +303,140 @@ def test_full_plan_registry(ctx8, rng):
     results = plans.run_all(ctx=ctx8)
     bad = [v for r in results for v in r.violations]
     assert bad == [], bad
+
+
+# ----------------------------------------------------------------------
+# Layer 3: effect inference + sync-freedom certification (ISSUE 7)
+# ----------------------------------------------------------------------
+def _effect_findings(name, budgets=None, signatures=None):
+    from cylon_tpu.analysis.syncfree import run_effect_pass
+
+    return run_effect_pass(
+        FIXTURES,
+        files=[os.path.join(FIXTURES, name)],
+        budgets={} if budgets is None else budgets,
+        signatures=signatures,
+    )
+
+
+def test_bad_hidden_fetch_flagged():
+    """Seeded known-bad: a fetch hidden behind TWO call hops must fail
+    the entry's 0-site sync budget AND drift its pinned signature, with
+    the full call path in both messages."""
+    fs, reports = _effect_findings(
+        "bad_hidden_fetch.py",
+        budgets={"collect_stats": contracts.SyncBudget(0)},
+        signatures={"collect_stats": "DISPATCH_SAFE"},
+    )
+    assert sorted(f.rule for f in fs) == ["effect-drift", "sync-budget"], fs
+    for f in fs:
+        assert "collect_stats -> _tally -> _sum_counts" in f.message, f
+    assert reports["collect_stats"].signature == "SYNC"
+    [site] = reports["collect_stats"].sync_sites
+    assert site.kind == "fetch" and site.line == 21
+
+
+def test_bad_shared_write_flagged():
+    """Seeded known-bad: an unguarded module-dict write reachable from a
+    public entry is a finding (the concurrent-serving data race)."""
+    fs, _ = _effect_findings("bad_shared_write.py")
+    assert [f.rule for f in fs] == ["unguarded-shared-write"], fs
+    assert fs[0].name == "_RESULT_CACHE[...]"
+    assert fs[0].func.endswith("remember")
+
+
+def test_effect_good_twins_clean():
+    """The same shapes with the invariant held: lock-dominated write,
+    GIL-atomic setdefault publish, `# lint: guarded=` / `# lint:
+    sync=host` declarations, and a genuinely dispatch-safe chain."""
+    fs, reports = _effect_findings("good_effect_cases.py")
+    assert fs == [], fs
+    assert reports["dispatch_chain"].signature == "DISPATCH_SAFE"
+    assert reports["remember_locked"].signature == "DISPATCH_SAFE"
+
+
+def test_live_tree_effect_clean():
+    """The L3 acceptance gate: zero effect findings over cylon_tpu/ —
+    every public entry matches its pinned signature, every sync budget
+    holds exactly, no unguarded shared writes anywhere."""
+    from cylon_tpu.analysis.syncfree import run_effect_pass
+
+    fs, reports = run_effect_pass(TREE, package="cylon_tpu")
+    assert fs == [], "\n".join(str(f) for f in fs)
+    # every certified entry is pinned; no MUTATES_SHARED flag anywhere
+    assert set(reports) == set(contracts.EFFECT_SIGNATURES)
+    assert all("MUTATES_SHARED" not in r.signature for r in reports.values())
+
+
+def test_l3_contract_constants_pinned():
+    """The sync-budget numbers the runtime pins re-export."""
+    assert contracts.EAGER_OP_HOST_SYNCS == 0
+    assert contracts.Q3_DISPATCH_HOST_SYNCS == 1
+    assert contracts.Q3_DISPATCH_SYNC_SITES == ("_materialize_counts",)
+    for op in contracts.Q3_DISPATCH_OPS:
+        assert contracts.SYNC_SITE_BUDGETS[op].sites == 0, op
+    assert (
+        contracts.SYNC_SITE_BUDGETS["table._shuffle_many"].sites
+        == contracts.SHUFFLE_HOST_SYNCS_PER_TABLE
+    )
+    assert contracts.SYNC_SITE_BUDGETS["Table._materialize_counts"].amortized
+    # the flagship signatures: the q3 components are dispatch-async
+    assert contracts.EFFECT_SIGNATURES["Table.project"] == "DISPATCH_SAFE"
+    assert "SYNC" not in contracts.EFFECT_SIGNATURES["Table.filter"]
+    assert "SYNC" not in contracts.EFFECT_SIGNATURES["Table.groupby"]
+    assert contracts.CONTRACTS["q3_dispatch"].sync_sites == (
+        "_materialize_counts",
+    )
+
+
+def test_eager_sync_free_runtime(ctx8, rng):
+    """Runtime twin of the 0-site budgets: filter/groupby/unique
+    dispatch with ZERO monitored fetches."""
+    from cylon_tpu.analysis import plans
+
+    for res in plans.run_eager_sync_free(ctx8, rng):
+        assert res.violations == [], res.violations
+        assert res.sync_sites == []
+
+
+def test_q3_dispatch_runtime(ctx8, rng):
+    """THE ISSUE-7 acceptance pin at runtime: a fused q3 plan
+    dispatch()es with zero host syncs and materializes with exactly one,
+    attributed to _materialize_counts."""
+    from cylon_tpu.analysis import plans
+
+    for res in plans.run_q3_dispatch(ctx8, rng):
+        assert res.violations == [], res.violations
+        assert res.sync_sites == ["_materialize_counts"]
+
+
+def test_graft_lint_json_effects(capsys):
+    """--json emits one machine-readable object (the CI artifact)."""
+    import json as _json
+
+    from tools import graft_lint
+
+    rc = graft_lint.main(["--effects-only", "--json"])
+    out = capsys.readouterr().out
+    doc = _json.loads(out)
+    assert rc == 0 and doc["exit_status"] == 0
+    eff = doc["layers"]["effects"]
+    assert eff["findings"] == []
+    assert len(eff["signatures"]) == len(contracts.EFFECT_SIGNATURES)
+    assert (
+        eff["signatures"]["Table.project"]["signature"] == "DISPATCH_SAFE"
+    )
+
+
+def test_no_effect_lint_kill_switch(capsys, monkeypatch):
+    """CYLON_TPU_NO_EFFECT_LINT=1 skips Layer 3 (declared in envgate —
+    an incident escape hatch, surfaced loudly in the output)."""
+    import json as _json
+
+    from tools import graft_lint
+
+    monkeypatch.setenv("CYLON_TPU_NO_EFFECT_LINT", "1")
+    rc = graft_lint.main(["--effects-only", "--json"])
+    doc = _json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["layers"]["effects"] == {"skipped": True}
