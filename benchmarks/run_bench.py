@@ -49,16 +49,21 @@ def _vs_baseline(work_rows: int, seconds: float, world: int) -> float:
 
 
 def _bench(fn, reps: int):
-    """(best wall seconds, first-call seconds [compile])."""
+    """(best wall seconds, first-call seconds [compile], warm samples).
+
+    The per-rep samples feed the obs.metrics latency histograms (the
+    serving substrate, ISSUE 8) so every BENCH row carries p50/p99
+    columns from the SAME histogram implementation the plan-fingerprint
+    registry uses — quantiles over the warm reps, compile excluded."""
     t0 = time.perf_counter()
     fn()
     compile_s = time.perf_counter() - t0
-    best = float("inf")
+    samples = []
     for _ in range(reps):
         t0 = time.perf_counter()
         fn()
-        best = min(best, time.perf_counter() - t0)
-    return best, compile_s
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) if samples else float("inf")), compile_s, samples
 
 
 # the ONE tunnel-safe completion fence (dependent-scalar fetch; see its
@@ -169,7 +174,22 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     ncores = os.cpu_count() or 1
     is_cpu = mesh_devices[0].platform == "cpu"
 
-    def record(name, seconds, compile_s, work_rows, world, extra=None):
+    from cylon_tpu.obs import metrics as _obs_metrics
+
+    def record(name, seconds, compile_s, work_rows, world, extra=None,
+               samples=None):
+        # warm-rep latency quantiles through the obs.metrics histogram
+        # registry (keyed like a serving fingerprint: one distribution
+        # per row+world) — rows that measure through the REAL plan
+        # fingerprint (q3_lazy) put their own p50/p99 in extra instead
+        lat = {}
+        if samples:
+            key = f"bench:{name}@w{world}"
+            for dt in samples:
+                _obs_metrics.observe_latency(key, dt, label=name)
+            qq = _obs_metrics.latency_quantiles(key)
+            lat = {"p50_ms": round(qq["p50_s"] * 1e3, 2),
+                   "p99_ms": round(qq["p99_s"] * 1e3, 2)}
         rate = work_rows / seconds
         row = {
             "benchmark": name,
@@ -181,6 +201,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
             **({"host_cores": ncores,
                 "rows_per_sec_per_core": round(rate / ncores)}
                if is_cpu else {}),
+            **lat,
             **(extra or {}),
         }
         results.append(row)
@@ -200,7 +221,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         out = left.join(right, on="k", how="inner")
         _sync(out)
 
-    s, c = _bench(local_join, reps)
+    s, c, laps = _bench(local_join, reps)
     lj_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, 1)}
     if hbm > 0:
         import jax as _jax
@@ -228,7 +249,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
             sds((cap,), jnp.int32), sds((cap,), jnp.float32),
             sds((), jnp.int32), sds((), jnp.int32),
         )
-    record("local_inner_join", s, c, 2 * n_rows, 1, lj_extra)
+    record("local_inner_join", s, c, 2 * n_rows, 1, lj_extra, samples=laps)
 
     # ---- the distributed configs over the widest mesh ----------------------
     world = len(mesh_devices)
@@ -239,10 +260,10 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         out = left.distributed_join(right, on="k", how="inner")
         _sync(out)
 
-    s, c = _bench(dist_join, reps)
+    s, c, laps = _bench(dist_join, reps)
     dj_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _roofline_recorded(dj_extra, hbm, s, dist_join)
-    record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra)
+    record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra, samples=laps)
 
     # config 1b: the same join at ~10% selectivity with the semi-join
     # sketch filter (ops/sketch.py): both sides prune provably partnerless
@@ -263,7 +284,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         out = left_s.distributed_join(right_s, on="k", how="inner")
         _sync(out)
 
-    s, c = _bench(dist_join_semi, reps)
+    s, c, laps = _bench(dist_join_semi, reps)
     djs_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _treset()
     _roofline_recorded(djs_extra, hbm, s, dist_join_semi)
@@ -285,7 +306,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         _roofline_recorded(off_extra, hbm, s, dist_join_semi)
         if "collective_mb" in off_extra:
             djs_extra["coll_mb_unfiltered"] = off_extra["collective_mb"]
-    record("dist_inner_join_semi", s, c, 2 * n_rows, world, djs_extra)
+    record("dist_inner_join_semi", s, c, 2 * n_rows, world, djs_extra, samples=laps)
 
     # fused execution mode: whole shuffle->join chain as ONE XLA program
     # with a single host sync (vs one sync per op phase in eager mode) —
@@ -297,7 +318,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         out = left.distributed_join(right, on="k", how="inner", mode="fused")
         _sync(out)
 
-    s, c = _bench(dist_join_fused, reps)
+    s, c, laps = _bench(dist_join_fused, reps)
     reset_trace()
     dist_join()
     eager_syncs = get_count("host_sync")
@@ -343,7 +364,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         (left._flat_cols(), left.counts_dev,
          right._flat_cols(), right.counts_dev), (),
     )
-    record("dist_inner_join_fused", s, c, 2 * n_rows, world, djf_extra)
+    record("dist_inner_join_fused", s, c, 2 * n_rows, world, djf_extra, samples=laps)
 
     # config 2: join + groupby aggregate (TPC-H Q3-ish)
     def q3():
@@ -351,10 +372,10 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         g = out.distributed_groupby("k_x", {"v": "sum"})
         _sync(g)
 
-    s, c = _bench(q3, reps)
+    s, c, laps = _bench(q3, reps)
     q3_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _roofline_recorded(q3_extra, hbm, s, q3)
-    record("dist_join_groupby_q3", s, c, 2 * n_rows, world, q3_extra)
+    record("dist_join_groupby_q3", s, c, 2 * n_rows, world, q3_extra, samples=laps)
 
     # config 2a': the same chain with order propagation — the join emits
     # grouped-key order (emit_order='key', same kernel cost) and the
@@ -366,10 +387,50 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         g = out.distributed_groupby("k_x", {"v": "sum"})
         _sync(g)
 
-    s, c = _bench(q3_ordered, reps)
+    s, c, laps = _bench(q3_ordered, reps)
     q3o_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
     _roofline_recorded(q3o_extra, hbm, s, q3_ordered)
-    record("dist_join_groupby_q3_ordered", s, c, 2 * n_rows, world, q3o_extra)
+    record("dist_join_groupby_q3_ordered", s, c, 2 * n_rows, world, q3o_extra, samples=laps)
+
+    # config 2a'': the SERVING-substrate row (ISSUE 8): the same q3
+    # through the lazy plan layer over the cached executor. Its p50/p99
+    # come from the REAL plan-fingerprint histogram that every
+    # LazyFrame.dispatch() feeds (end time rides the deferred count
+    # materialization) — exactly what the compile-once-serve-many
+    # benchmark (ROADMAP 1) will read at scale.
+    right_rk = right.rename({"k": "rk"})
+    lf_q3 = (
+        left.lazy()
+        .join(right_rk.lazy(), left_on="k", right_on="rk")
+        .groupby("k", {"v": "sum"})
+    )
+
+    def q3_lazy():
+        lf_q3.collect()
+
+    # compile OUTSIDE the histogram window (its observation is reset
+    # away) so hist_count == the warm reps and p50/p99 are warm-query
+    # latency only, matching the _bench docstring's contract
+    t0 = time.perf_counter()
+    q3_lazy()
+    c = time.perf_counter() - t0
+    _obs_metrics.reset_latency()
+    laps = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        q3_lazy()
+        laps.append(time.perf_counter() - t0)
+    s = min(laps)
+    rep = _obs_metrics.latency_report()
+    fkey, ent = max(rep.items(), key=lambda kv: kv[1]["count"])
+    ql_extra = {
+        "vs_baseline": _vs_baseline(2 * n_rows, s, world),
+        "fingerprint": fkey,
+        "hist_count": ent["count"],
+        "p50_ms": round(ent["p50_s"] * 1e3, 2),
+        "p99_ms": round(ent["p99_s"] * 1e3, 2),
+    }
+    record("dist_join_groupby_q3_lazy", s, c, 2 * n_rows, world, ql_extra)
 
     # config 2b: the same chain fully fused (join + groupby + psum in one
     # program, parallel/pipeline.make_join_groupby_step — what the multichip
@@ -392,7 +453,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         jax.block_until_ready(out)
         _ = np.asarray(out[3])  # the single fetch
 
-    s, c = _bench(q3_fused, reps)
+    s, c, laps = _bench(q3_fused, reps)
     q3f_extra = {
         "vs_baseline": _vs_baseline(2 * n_rows, s, world),
         "host_syncs": 1,
@@ -402,17 +463,17 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         q3f_extra, hbm, s, step,
         (lflat, left.counts_dev, rflat, right.counts_dev), (),
     )
-    record("dist_join_groupby_q3_fused", s, c, 2 * n_rows, world, q3f_extra)
+    record("dist_join_groupby_q3_fused", s, c, 2 * n_rows, world, q3f_extra, samples=laps)
 
     # config 3: distributed sort (sample sort)
     def dsort():
         out = left.distributed_sort("k")
         _sync(out)
 
-    s, c = _bench(dsort, reps)
+    s, c, laps = _bench(dsort, reps)
     ds_extra = {"vs_baseline": _vs_baseline(n_rows, s, world)}
     _roofline_recorded(ds_extra, hbm, s, dsort)
-    record("dist_sort", s, c, n_rows, world, ds_extra)
+    record("dist_sort", s, c, n_rows, world, ds_extra, samples=laps)
 
     # config 3b: the 3-key narrow-lane local sort (ISSUE 5 lane packing):
     # the packed row vs the kill-switch row is the measured sort-word
@@ -428,15 +489,15 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         out = mt.sort(["a", "b", "c"])
         _sync(out)
 
-    s, c = _bench(msort, reps)
+    s, c, laps = _bench(msort, reps)
     mp_extra = {}
     _roofline_recorded(mp_extra, hbm, s, msort)
-    record("multikey_sort_packed", s, c, n_rows, world, mp_extra)
+    record("multikey_sort_packed", s, c, n_rows, world, mp_extra, samples=laps)
     with _lp_gate.disabled():
-        s, c = _bench(msort, reps)
+        s, c, laps = _bench(msort, reps)
         mn_extra = {}
         _roofline_recorded(mn_extra, hbm, s, msort)
-        record("multikey_sort_nopack", s, c, n_rows, world, mn_extra)
+        record("multikey_sort_nopack", s, c, n_rows, world, mn_extra, samples=laps)
 
     # config 4: set ops (shuffle on all columns + sorted dedup) — identical
     # schemas required, so pair ``left`` with a second (k, v) table
@@ -450,10 +511,10 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
             out = f()
             _sync(out)
 
-        s, c = _bench(setop, reps)
+        s, c, laps = _bench(setop, reps)
         so_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
         _roofline_recorded(so_extra, hbm, s, setop)
-        record(name, s, c, 2 * n_rows, world, so_extra)
+        record(name, s, c, 2 * n_rows, world, so_extra, samples=laps)
 
     # config 5: out-of-core join — both inputs stream through bounded device
     # memory (Grace-style partitioned dag join, parallel/ooc.py; the analog
@@ -481,7 +542,7 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
         runs.append((time.perf_counter() - t0, job.cost_split))
         return sink.rows
 
-    s, c = _bench(ooc, max(1, reps - 1))
+    s, c, laps = _bench(ooc, max(1, reps - 1))
     # gate_exempt: first-call time here is a full host-bound streaming run
     # (16 spills + 16 joins), not XLA compile tax — the compile gate would
     # misfire on runtime. cost_split: per-phase walls of the BEST rep (the
@@ -492,7 +553,8 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     # warm rep's split is the one warm_s describes
     best_split = min(runs[1:], key=lambda t: t[0])[1]
     record("ooc_join_16chunks", s, c, 2 * ooc_n, world,
-           {"chunk_rows": chunk_rows, "gate_exempt": True, **best_split})
+           {"chunk_rows": chunk_rows, "gate_exempt": True, **best_split},
+           samples=laps)
 
     # ---- scaling sweep: strong scaling of the distributed join -------------
     if scaling and world > 1:
@@ -507,10 +569,10 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 out = lw.distributed_join(rw, on="k", how="inner")
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
-            s, c = _bench(djw, reps)
+            s, c, laps = _bench(djw, reps)
             sc_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, w)}
             _roofline_recorded(sc_extra, hbm, s, djw)
-            record("dist_join_strong_scaling", s, c, 2 * n_rows, w, sc_extra)
+            record("dist_join_strong_scaling", s, c, 2 * n_rows, w, sc_extra, samples=laps)
             # weak scaling: n_rows per shard
             lww, rww = make_tables(ct, ctxw, n_rows * w // max(sizes), keyspace=n_rows)
 
@@ -518,18 +580,18 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
                 out = lww.distributed_join(rww, on="k", how="inner")
                 jax.block_until_ready([col.data for col in out._columns.values()])
 
-            s, c = _bench(djww, reps)
+            s, c, laps = _bench(djww, reps)
             wc_extra = {"vs_baseline": _vs_baseline(2 * len(lww), s, w)}
             _roofline_recorded(wc_extra, hbm, s, djww)
-            record("dist_join_weak_scaling", s, c, 2 * len(lww), w, wc_extra)
+            record("dist_join_weak_scaling", s, c, 2 * len(lww), w, wc_extra, samples=laps)
 
     return results
 
 
 def to_markdown(results, header: str) -> str:
     lines = [header, "",
-             "| benchmark | world | rows | warm s | compile s | rows/s | rows/s/core | vs_baseline | %membw | colls | coll MB | coll B/row | sort GB |",
-             "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+             "| benchmark | world | rows | warm s | p50 ms | p99 ms | compile s | rows/s | rows/s/core | vs_baseline | %membw | colls | coll MB | coll B/row | sort GB |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in results:
         # collective volume per world size: the quantity that predicts real
         # ICI scaling (VERDICT r3 weak point 6 — virtual-CPU-mesh wall time
@@ -544,6 +606,9 @@ def to_markdown(results, header: str) -> str:
         rpc = f"{rpc:,}" if isinstance(rpc, int) else ""
         lines.append(
             f"| {r['benchmark']} | {r['world']} | {r['rows']:,} | {r['warm_s']} "
+            # warm-rep latency quantiles from the obs.metrics histograms
+            # (the q3_lazy row reads the real plan-fingerprint histogram)
+            f"| {r.get('p50_ms', '')} | {r.get('p99_ms', '')} "
             f"| {r['compile_s']} | {r['rows_per_sec']:,} | {rpc} "
             f"| {r.get('vs_baseline', '')} "
             f"| {r.get('pct_membw', '')} | {r.get('collectives', '')} "

@@ -71,6 +71,7 @@ from . import compute  # noqa: E402
 from .series import Series  # noqa: E402
 from . import indexing  # noqa: E402
 from .join_config import JoinAlgorithm, JoinConfig  # noqa: E402
+from . import obs  # noqa: E402
 from . import plan  # noqa: E402
 from .plan import LazyFrame, col, lit  # noqa: E402
 from .indexing.index import (  # noqa: E402
@@ -122,6 +123,7 @@ __all__ = [
     "concat",
     "dtypes",
     "merge",
+    "obs",
     "read_csv",
     "read_parquet",
     "write_csv",

@@ -223,6 +223,22 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     "task.task_partition": SyncBudget(
         1, note="ONE sort+count fetch covers all T task splits"
     ),
+    # the telemetry layer (ISSUE 8): observability must NEVER sync. The
+    # span/bump/gauge surface, the deferred-timing resolution hook that
+    # rides _materialize_counts' existing fetch, and the histogram
+    # update all own 0 sync sites — so the instrumented q3 dispatch path
+    # provably keeps its exactly-1-host-sync budget (the runtime census
+    # twin under an ENABLED tracer runs in tools/trace_smoke.py).
+    "obs.trace.span": SyncBudget(
+        0, note="span timing is host perf_counter only"
+    ),
+    "obs.trace.resolve_table": SyncBudget(
+        0, note="stamps the deferred end time AFTER the count fetch the "
+        "engine already made; adds none",
+    ),
+    "obs.metrics.observe_latency": SyncBudget(
+        0, note="lock + dict bump, pure host"
+    ),
     # amortized machinery: paid once, cached
     "Table._materialize_counts": SyncBudget(
         1, amortized=True,
@@ -292,7 +308,11 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     "LazyFrame.collect": "SYNC",
     "LazyFrame.columns": "DISPATCH_SAFE",
     "LazyFrame.dispatch": "SYNC",
-    "LazyFrame.explain": "DISPATCH_SAFE",
+    # re-pinned with ISSUE 8: explain(analyze=True) EXECUTES the plan
+    # (per-node materialization is the point of EXPLAIN ANALYZE), so the
+    # static worst case over both paths is SYNC; the analyze-free path
+    # still performs no execution
+    "LazyFrame.explain": "SYNC",
     "LazyFrame.filter": "DISPATCH_SAFE",
     "LazyFrame.from_table": "DISPATCH_SAFE",
     "LazyFrame.groupby": "DISPATCH_SAFE",

@@ -19,8 +19,9 @@ from typing import Dict, Optional, Tuple
 # (function-qualname-suffix | "*", env var) -> audited reason
 EXEMPT: Dict[Tuple[str, str], str] = {
     ("*", "CYLON_TPU_TRACE"): (
-        "observability only: trace_enabled() gates span LOGGING in "
-        "utils/tracing.py; no traced program or key decision reads it"
+        "observability only: trace_enabled()/tracing_active() gate span "
+        "logging and query-trace recording in obs/trace.py; no traced "
+        "program or key decision reads it"
     ),
 }
 

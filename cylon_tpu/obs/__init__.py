@@ -1,0 +1,56 @@
+"""Query-scoped telemetry (ISSUE 8): span trees, sync-free device timing,
+a metrics registry with plan-fingerprint latency histograms, and exporters.
+
+Three modules, layered bottom-up:
+
+- :mod:`.metrics` — the process-global ROLLUP (the old ``utils/tracing``
+  aggregate: {name: count/total/max/rows}, always on, lock-serialized)
+  plus the latency-histogram registry keyed by plan fingerprint — the
+  substrate of the ROADMAP-1 serving benchmark's p50/p95/p99 columns.
+- :mod:`.trace` — the contextvar-based query trace: a structured span
+  TREE per query (eager op chain or ``LazyFrame.dispatch()``), with
+  per-query counters/gauges so concurrent queries never interleave, and
+  the deferred device-timing hook that rides the existing
+  ``_materialize_counts`` fetch (it never adds a host sync — graft-lint
+  L3 budgets pin that mechanically).
+- :mod:`.export` — the bounded flight-recorder ring of the last N query
+  traces and the Chrome trace-event (Perfetto-loadable) exporter, one
+  track per query.
+
+``utils/tracing.py`` is the thin compat shim over this package: every
+pre-existing call site (``span``/``bump``/``gauge``/``report``/...)
+keeps working, and the process-global rollup keeps feeding the
+graft-lint plan registry (``analysis/plans.py``) unchanged.
+"""
+from . import export, metrics, trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    fingerprint_key,
+    latency_quantiles,
+    latency_report,
+    observe_latency,
+)
+from .trace import (  # noqa: F401
+    QueryTrace,
+    Span,
+    annotate_add,
+    query_trace,
+    tracing_active,
+)
+from .export import traces, write_chrome  # noqa: F401
+
+__all__ = [
+    "QueryTrace",
+    "Span",
+    "annotate_add",
+    "export",
+    "fingerprint_key",
+    "latency_quantiles",
+    "latency_report",
+    "metrics",
+    "observe_latency",
+    "query_trace",
+    "trace",
+    "traces",
+    "tracing_active",
+    "write_chrome",
+]

@@ -1,0 +1,210 @@
+"""Exporters: the flight-recorder ring and Chrome trace-event JSON.
+
+FLIGHT RING
+    A bounded deque of the last N finished :class:`~.trace.QueryTrace`
+    objects (``CYLON_TPU_TRACE_RING`` caps N, default 64): the
+    "what just happened" buffer a serving process can dump after a p99
+    blip without having had full tracing persistence on.
+    ``tools/traceview.py`` summarizes a dumped ring.
+
+CHROME TRACE
+    :func:`write_chrome` renders traces as Chrome trace-event JSON
+    (the ``traceEvents`` array form) — loadable in Perfetto /
+    ``chrome://tracing``. One track (tid) per query, so an 8-thread
+    concurrent run shows 8 disjoint query span trees; spans are complete
+    ("X") events carrying rows / collective bytes / gate counters in
+    ``args``. Timestamps are microseconds on the shared
+    ``perf_counter`` clock, so tracks align across queries.
+
+``CYLON_TPU_TRACE_EXPORT=<path>`` writes the ring to ``<path>`` at
+interpreter exit (registered lazily on first recorded trace).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..utils import envgate as _eg
+
+_ring_lock = threading.Lock()
+_RING: "deque" = deque()
+_ATEXIT = [False]  # guarded by _ring_lock
+
+
+def ring_capacity() -> int:
+    """Flight-ring capacity from CYLON_TPU_TRACE_RING (>=1; default 64).
+    Read per record so a serving process can resize without restart."""
+    raw = _eg.TRACE_RING.get()
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 64
+    return max(n, 1)
+
+
+def record(q) -> None:
+    """Append a finished QueryTrace to the ring (evicting the oldest past
+    capacity) and lazily register the exit exporter."""
+    cap = ring_capacity()
+    with _ring_lock:
+        _RING.append(q)
+        while len(_RING) > cap:
+            _RING.popleft()
+        if not _ATEXIT[0]:
+            _ATEXIT[0] = True
+            atexit.register(_export_at_exit)
+
+
+def traces() -> List:
+    """Snapshot of the ring, oldest first."""
+    with _ring_lock:
+        return list(_RING)
+
+
+def reset_ring() -> None:
+    with _ring_lock:
+        _RING.clear()
+
+
+def _export_at_exit() -> None:  # pragma: no cover - exit hook
+    path = _eg.TRACE_EXPORT.get()
+    if not path:
+        return
+    try:
+        write_chrome(path)
+    except Exception as e:
+        import sys
+
+        print(f"[cylon_tpu] trace export to {path} failed: {e}",
+              file=sys.stderr)
+
+
+# ----------------------------------------------------------------------
+# Chrome trace-event rendering
+# ----------------------------------------------------------------------
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def _span_args(sp) -> Dict:
+    args: Dict = {}
+    if sp.rows is not None:
+        args["rows"] = int(sp.rows)
+    for k, v in sp.attrs.items():
+        args[k] = _json_safe(v)
+    for name, (count, rows) in sp.counters.items():
+        args[f"ctr:{name}"] = count if not rows else [count, rows]
+    return args
+
+
+def chrome_events(trace_list: Optional[List] = None) -> List[Dict]:
+    """The traceEvents array: per query one thread_name metadata event,
+    one query-level "X" event, and one "X" event per span."""
+    if trace_list is None:
+        trace_list = traces()
+    pid = os.getpid()
+    events: List[Dict] = []
+    for q in trace_list:
+        tid = q.qid
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": f"{q.kind}:{q.name} #{q.qid}"},
+        })
+        qargs: Dict = {"kind": q.kind, "thread": q.thread}
+        if q.hist_key:
+            qargs["fingerprint"] = q.hist_key
+        dev = q.device_resolved_s()
+        if dev is not None:
+            qargs["device_resolved_ms"] = round(dev * 1e3, 3)
+        for k, v in q.attrs.items():
+            qargs[k] = _json_safe(v)
+        for name, (count, rows) in q.counters.items():
+            qargs[f"ctr:{name}"] = count if not rows else [count, rows]
+        events.append({
+            "ph": "X", "name": f"query:{q.name}", "cat": q.kind,
+            "pid": pid, "tid": tid, "ts": q.t0 * 1e6,
+            "dur": max(q.wall_s() * 1e6, 0.0), "args": qargs,
+        })
+        for root in q.spans:
+            for sp in root.walk():
+                events.append({
+                    "ph": "X", "name": sp.name, "cat": "span",
+                    "pid": pid, "tid": tid, "ts": sp.t0 * 1e6,
+                    "dur": max(sp.dur_s() * 1e6, 0.0),
+                    "args": _span_args(sp),
+                })
+    return events
+
+
+def chrome_doc(trace_list: Optional[List] = None) -> Dict:
+    return {
+        "traceEvents": chrome_events(trace_list),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "cylon_tpu.obs"},
+    }
+
+
+def write_chrome(path: str, trace_list: Optional[List] = None) -> int:
+    """Write the Chrome trace JSON; returns the event count."""
+    doc = chrome_doc(trace_list)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
+def load_chrome(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate_chrome(doc: Dict) -> List[str]:
+    """Schema-check a Chrome trace document (the trace-smoke CI gate and
+    the round-trip test both run this). Returns problem strings."""
+    problems: List[str] = []
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents: missing or not a list"]
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        for k in ("ph", "name", "pid", "tid"):
+            if k not in e:
+                problems.append(f"event {i}: missing {k!r}")
+        if e.get("ph") == "X":
+            for k in ("ts", "dur"):
+                if not isinstance(e.get(k), (int, float)):
+                    problems.append(f"event {i}: X event needs numeric {k!r}")
+        if "args" in e and not isinstance(e["args"], dict):
+            problems.append(f"event {i}: args must be an object")
+    return problems
+
+
+def summarize(doc: Dict) -> Dict[int, Dict]:
+    """Per-track (tid) summary of a Chrome trace doc: query name, wall
+    ms, span count, and total-time-by-span-name — the substrate of
+    ``tools/traceview.py`` and of the round-trip assertions."""
+    tracks: Dict[int, Dict] = {}
+    for e in doc.get("traceEvents", []):
+        tid = e.get("tid")
+        t = tracks.setdefault(
+            tid, {"name": "", "query_ms": 0.0, "spans": 0, "by_name": {}}
+        )
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            t["name"] = e.get("args", {}).get("name", "")
+        elif e.get("ph") == "X":
+            if str(e.get("name", "")).startswith("query:"):
+                t["query_ms"] = e["dur"] / 1e3
+                t["args"] = e.get("args", {})
+            else:
+                t["spans"] += 1
+                agg = t["by_name"].setdefault(e["name"], [0, 0.0])
+                agg[0] += 1
+                agg[1] += e["dur"] / 1e3
+    return tracks

@@ -1,0 +1,259 @@
+"""The metrics registry: process-global rollup + latency histograms.
+
+Two stores, both lock-serialized and host-only (graft-lint pins that this
+module owns ZERO host-sync sites — telemetry must never touch the
+device):
+
+ROLLUP
+    The aggregate the old flat tracer kept: ``{name: {count, total_s,
+    max_s, rows}}``. Always on — the graft-lint plan registry
+    (``analysis/plans.py``), the benchmark gates and dozens of tests
+    assert on these counters, so the rollup survives the query-scoped
+    refactor unchanged. ``utils/tracing.report()`` / ``get_count()`` /
+    ``reset_trace()`` are shims over it.
+
+HISTOGRAMS
+    Latency distributions keyed by an arbitrary string — in production
+    the PLAN FINGERPRINT (:func:`fingerprint_key`), so every repeated
+    collect of one plan shape lands in one distribution and a serving
+    benchmark reads p50/p95/p99 per query shape straight from here
+    (ROADMAP item 1's "queries/sec at a fixed p99"). Buckets are
+    geometric (24/decade, ~10% relative resolution) so the registry is
+    O(buckets), never O(samples), no matter how many queries a serving
+    process answers. ``LazyFrame.dispatch()`` observes into this
+    registry unconditionally (tracing enabled or not): the histogram
+    update is one lock + one dict bump, and serving metrics must not
+    require the trace ring.
+
+Stable metric names: every engine counter/gauge/span family is declared
+in :data:`STABLE_METRICS` with its kind; docs/ARCHITECTURE.md renders
+the same table. New instrumentation starts there — an undeclared name is
+a review finding (``tests/test_obs.py`` enforces coverage for everything
+a q3 run emits).
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+from collections import defaultdict
+from typing import Dict, Optional, Tuple
+
+_lock = threading.Lock()
+
+_ROLLUP: Dict[str, Dict[str, float]] = defaultdict(
+    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "rows": 0}
+)
+
+
+# ----------------------------------------------------------------------
+# the process-global rollup (compat surface of utils/tracing.py)
+# ----------------------------------------------------------------------
+def rollup_span(name: str, dt: float, rows: Optional[int] = None) -> None:
+    with _lock:
+        s = _ROLLUP[name]
+        s["count"] += 1
+        s["total_s"] += dt
+        s["max_s"] = max(s["max_s"], dt)
+        if rows is not None:
+            s["rows"] += int(rows)
+
+
+def rollup_count(name: str, rows: Optional[int] = None) -> None:
+    with _lock:
+        s = _ROLLUP[name]
+        s["count"] += 1
+        if rows is not None:
+            s["rows"] += int(rows)
+
+
+def rollup_value(name: str, value: float) -> None:
+    with _lock:
+        s = _ROLLUP[name]
+        s["count"] += 1
+        s["total_s"] += float(value)
+        s["max_s"] = max(s["max_s"], float(value))
+
+
+def get_count(name: str) -> int:
+    with _lock:
+        return int(_ROLLUP[name]["count"]) if name in _ROLLUP else 0
+
+
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """Deep-copied rollup: {name: {count, total_s, max_s, rows}}."""
+    with _lock:
+        return {k: dict(v) for k, v in _ROLLUP.items()}
+
+
+def report(prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    stats = snapshot()
+    if prefix is None:
+        return stats
+    return {k: v for k, v in stats.items() if k.startswith(prefix)}
+
+
+def reset_rollup() -> None:
+    with _lock:
+        _ROLLUP.clear()
+
+
+# ----------------------------------------------------------------------
+# latency histograms keyed by plan fingerprint
+# ----------------------------------------------------------------------
+#: geometric bucket resolution: 24 buckets per decade ~= 10% per step —
+#: coarse enough to stay O(1) memory per key, fine enough that a p99
+#: read-off is within one resolution step of the true sample quantile
+BUCKETS_PER_DECADE = 24
+
+
+class Histogram:
+    """Geometric-bucket latency histogram (seconds). NOT thread-safe on
+    its own — every registry access serializes under the module lock."""
+
+    __slots__ = ("buckets", "n", "total_s", "min_s", "max_s")
+
+    def __init__(self) -> None:
+        self.buckets: Dict[int, int] = {}
+        self.n = 0
+        self.total_s = 0.0
+        self.min_s = math.inf
+        self.max_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        s = max(float(seconds), 1e-9)
+        b = int(math.floor(math.log10(s) * BUCKETS_PER_DECADE))
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+        self.n += 1
+        self.total_s += s
+        self.min_s = min(self.min_s, s)
+        self.max_s = max(self.max_s, s)
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile sample,
+        clamped to the observed [min, max] (exact at the extremes)."""
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for b in sorted(self.buckets):
+            acc += self.buckets[b]
+            if acc >= target:
+                edge = 10.0 ** ((b + 1) / BUCKETS_PER_DECADE)
+                return min(max(edge, self.min_s), self.max_s)
+        return self.max_s
+
+
+_HISTS: Dict[str, Histogram] = {}
+_HIST_LABELS: Dict[str, str] = {}
+
+
+def fingerprint_key(fingerprint) -> str:
+    """Stable short key for a plan fingerprint (any reprable value):
+    12 hex chars of blake2s over the repr — the histogram / trace-track
+    identity of one plan shape within a process."""
+    return hashlib.blake2s(
+        repr(fingerprint).encode(), digest_size=6
+    ).hexdigest()
+
+
+def observe_latency(key: str, seconds: float, label: str = "") -> None:
+    """Record one query latency under ``key`` (a fingerprint_key, or any
+    caller-chosen stable name, e.g. a benchmark row)."""
+    with _lock:
+        h = _HISTS.get(key)
+        if h is None:
+            h = _HISTS[key] = Histogram()
+        if label and key not in _HIST_LABELS:
+            _HIST_LABELS[key] = label
+        h.record(seconds)
+
+
+def latency_quantiles(key: str) -> Optional[Dict[str, float]]:
+    """{count, mean_s, p50_s, p95_s, p99_s, max_s} or None (no samples)."""
+    with _lock:
+        h = _HISTS.get(key)
+        if h is None or not h.n:
+            return None
+        return {
+            "count": h.n,
+            "mean_s": h.total_s / h.n,
+            "p50_s": h.quantile(0.50),
+            "p95_s": h.quantile(0.95),
+            "p99_s": h.quantile(0.99),
+            "max_s": h.max_s,
+        }
+
+
+def latency_report() -> Dict[str, Dict[str, float]]:
+    """All keys: {key: {label, count, p50_s, p95_s, p99_s, ...}}."""
+    with _lock:
+        keys = list(_HISTS)
+        labels = dict(_HIST_LABELS)
+    out = {}
+    for k in keys:
+        q = latency_quantiles(k)
+        if q is not None:
+            q["label"] = labels.get(k, "")
+            out[k] = q
+    return out
+
+
+def reset_latency() -> None:
+    with _lock:
+        _HISTS.clear()
+        _HIST_LABELS.clear()
+
+
+# ----------------------------------------------------------------------
+# the documented stable names (docs/ARCHITECTURE.md "Observability")
+# ----------------------------------------------------------------------
+#: name-or-prefix -> (kind, meaning). Prefixes end with "."; a metric is
+#: DECLARED when it matches an exact name or starts with a prefix. The
+#: names are a compatibility surface: benchmarks, CI gates and the
+#: graft-lint plan registry assert on them, so renames are breaking
+#: changes made only with their consumers.
+STABLE_METRICS: Dict[str, Tuple[str, str]] = {
+    "host_sync": ("counter", "device->host count fetches (the sync census)"),
+    "sort": ("span", "local sort dispatch"),
+    "unique": ("span", "local unique dispatch"),
+    "bucket_pack": ("span", "hash-bucket pack kernel"),
+    "stats.measure": ("span", "on-demand column range-stats kernel"),
+    "join.": ("span", "join phases: speculative/fused/pallas_pk/sum_pushdown"),
+    "setop.": ("span", "union/subtract/intersect dispatch"),
+    "groupby.": ("span", "groupby phases (emit)"),
+    "shuffle.count": ("span", "shuffle count-phase kernel + fetch"),
+    "shuffle.exchange": ("span", "whole K-round exchange wall"),
+    "shuffle.round.": ("span", "per-round pack/collective/compact dispatch"),
+    "shuffle.rounds": ("counter", "round count K per shuffle (rows=K)"),
+    "shuffle.overlap_efficiency": (
+        "gauge", "fraction of exchange wall spent issuing overlapped work"),
+    "shuffle.semi_filter.": (
+        "mixed", "semi-join gate: selectivity gauge, applied/gate_skipped/"
+        "pruned_rows counters, sketch span"),
+    "semi_filter.sketch_bytes": ("counter", "sketch collective wire bytes"),
+    "lane_pack.": (
+        "mixed", "bit-width packing: stats_kernel/sort_fused/join_fused/"
+        "groupby_fused counters, wire.* gate counters + ratio gauge"),
+    "ordering.": (
+        "counter", "order-property consumers: sort_elided/dist_sort_elided/"
+        "sort_suffix/join_presorted_probe/join_key_order_emit/"
+        "setop_sorted_probe/unique_run_detect/groupby_run_detect"),
+    "plan.optimize": ("span", "rule rewriting"),
+    "plan.lower": ("span", "detach + executor build"),
+    "plan.execute": ("span", "lowered plan execution"),
+    "plan.node.": ("span", "per-plan-node execution (node_id attr)"),
+    "plan.rule.": ("counter", "one bump per optimizer rule firing"),
+    "plan.cache.": ("counter", "plan-fingerprint executable cache hit/miss"),
+    "query.": ("mixed", "query-level rollup: query.traces recorded"),
+    "overhead.": ("span", "trace_smoke calibration probes (tools only)"),
+}
+
+
+def is_declared(name: str) -> bool:
+    """Is a metric name covered by the stable-name table?"""
+    if name in STABLE_METRICS:
+        return True
+    return any(
+        name.startswith(p) for p in STABLE_METRICS if p.endswith(".")
+    )

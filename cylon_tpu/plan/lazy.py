@@ -11,9 +11,12 @@ rules fired.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
 
 from ..engine import plan_executable
+from ..obs import metrics as _obsmetrics
+from ..obs import trace as _obstrace
 from ..utils.tracing import bump, span
 from . import lower as _lower
 from . import rules as _rules
@@ -157,27 +160,32 @@ class LazyFrame:
         return self.limit(n)
 
     # -- execution ---------------------------------------------------------
-    def explain(self) -> str:
+    def explain(self, analyze: bool = False) -> str:
         """Pre-rewrite plan, post-rewrite plan, and the rules that fired.
 
         Each node line carries its derived order property (``-- order:
         [k asc] @shard`` — ``Node.ordering()``, the sortedness analog of
         partitioning); an ``order_reuse`` firing shows up as a dropped Sort
         or a ``Join ... emit=key-order`` + ``GroupBy ... [input
-        key-ordered: groupby lexsort elided]`` pair."""
+        key-ordered: groupby lexsort elided]`` pair.
+
+        ``analyze=True`` RUNS the plan (through the same cached executor
+        the production path uses) under a forced query trace and prints
+        the optimized tree annotated per node with measured wall time
+        (total and self), rows in/out, collective MB shipped, and which
+        adaptive gates engaged (semi-filter, wire narrowing, ordering
+        elisions, plan-cache hit/miss) — the EXPLAIN ANALYZE of this
+        engine. Diagnostic by design: every node's result is
+        materialized for exact row counts, so an analyzed run performs
+        per-node host syncs the production ``dispatch()`` path never
+        does (that path stays pinned at exactly 1 — graft-lint's
+        ``q3_dispatch`` contract)."""
+        if analyze:
+            return self._explain_analyze()
         opt, fired = _rules.optimize(self._plan, self._ctx.world_size)
         lines = ["== Logical plan ==", self._plan.render(), "",
                  "== Optimized plan ==", opt.render(), ""]
-        if fired:
-            counts: Dict[str, int] = {}
-            for f in fired:
-                counts[f] = counts.get(f, 0) + 1
-            lines.append(
-                "Rewrites fired: "
-                + ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
-            )
-        else:
-            lines.append("Rewrites fired: (none)")
+        lines.append(_fired_line(fired))
         return "\n".join(lines)
 
     def collect(self):
@@ -188,20 +196,11 @@ class LazyFrame:
         t._materialize()
         return t
 
-    def dispatch(self):
-        """Execute the plan WITHOUT the result-count host sync — the
-        ``collect_async`` precursor for concurrent query serving.
-
-        Every lowered single-dispatch eager op defers its count fetch, so
-        the whole chain is queued on the device with ZERO host syncs (for
-        sync-free plan shapes, e.g. the fused q3 join->groupby-SUM) and
-        the returned Table's buffers may still be in flight. Its row
-        counts materialize — the ONE host sync, attributed to
-        ``_materialize_counts`` — on first access (``row_counts`` /
-        ``to_pydict`` / ...). graft-lint pins this: the ``q3_dispatch``
-        contract (analysis/contracts.py) requires exactly one sync, at
-        result fetch, both statically (L3 sync budgets) and at runtime
-        (the monitored fetch census)."""
+    def _executable(self):
+        """Optimize+lower through the plan-fingerprint cache: returns
+        ``(tables, fingerprint, (opt, fired, fn), hit)`` — the ONE copy
+        of the compile/cache recipe shared by ``dispatch()`` and
+        ``explain(analyze=True)``."""
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
         from ..ops.sketch import enabled as _semi_enabled
@@ -229,18 +228,183 @@ class LazyFrame:
             return opt, tuple(fired), fn
 
         entry, hit = plan_executable(ctx, fingerprint, compile_plan)
+        return tables, fingerprint, entry, hit
+
+    def dispatch(self):
+        """Execute the plan WITHOUT the result-count host sync — the
+        ``collect_async`` precursor for concurrent query serving.
+
+        Every lowered single-dispatch eager op defers its count fetch, so
+        the whole chain is queued on the device with ZERO host syncs (for
+        sync-free plan shapes, e.g. the fused q3 join->groupby-SUM) and
+        the returned Table's buffers may still be in flight. Its row
+        counts materialize — the ONE host sync, attributed to
+        ``_materialize_counts`` — on first access (``row_counts`` /
+        ``to_pydict`` / ...). graft-lint pins this: the ``q3_dispatch``
+        contract (analysis/contracts.py) requires exactly one sync, at
+        result fetch, both statically (L3 sync budgets) and at runtime
+        (the monitored fetch census).
+
+        Telemetry: each dispatch opens a query trace (when tracing is
+        enabled — two concurrent dispatches build two DISJOINT span
+        trees via the contextvar context) and ALWAYS observes its
+        dispatch-to-count-fetch latency into the plan-fingerprint
+        histogram (``obs.metrics``) — the end time rides the deferred
+        materialization, never an extra sync."""
+        t_q = _time.perf_counter()
+        with _obstrace.query_trace(
+            type(self._plan).__name__, kind="plan"
+        ):
+            tables, fingerprint, entry, hit = self._executable()
+            opt, fired, fn = entry
+            if hit:
+                # cached optimize+lower: emit the spans anyway so every
+                # collect is visible in tracing.report() (at ~zero cost)
+                with span("plan.optimize"):
+                    pass
+                with span("plan.lower"):
+                    pass
+            for f in fired:
+                bump(f"plan.rule.{f}")
+            with span("plan.execute"):
+                out = fn(tables)
+            _obstrace.attach_result(
+                out, fingerprint=fingerprint, label=opt.label(), t0=t_q
+            )
+            return out
+
+    def _explain_analyze(self) -> str:
+        """Run the plan through the cached executor under a forced query
+        trace with per-node materialization, then render the optimized
+        tree annotated from the measured span tree."""
+        t_q = _time.perf_counter()
+        tables, fingerprint, entry, hit = self._executable()
         opt, fired, fn = entry
-        if hit:
-            # cached optimize+lower: emit the spans anyway so every collect
-            # is visible in tracing.report() (at ~zero cost)
-            with span("plan.optimize"):
-                pass
-            with span("plan.lower"):
-                pass
-        for f in fired:
-            bump(f"plan.rule.{f}")
-        with span("plan.execute"):
-            return fn(tables)
+        with _obstrace.analyze_mode():
+            with _obstrace.query_trace(
+                type(self._plan).__name__, kind="explain", force=True,
+            ) as q:
+                with span("plan.execute"):
+                    out = fn(tables)
+                # fingerprint deliberately NOT passed: an analyzed run's
+                # per-node diagnostic syncs (+ compile on a cache miss)
+                # must never land a sample in the fingerprint histogram
+                # that serving p50/p99 reads — only the trace end time
+                # rides the deferred resolution here
+                _obstrace.attach_result(out, label=opt.label(), t0=t_q)
+                out._materialize()
+        lines = [
+            "== Logical plan ==", self._plan.render(), "",
+            "== Analyzed plan (executed) ==",
+            _render_analyzed(opt, q), "",
+            _fired_line(fired),
+            f"Plan fingerprint: {_obsmetrics.fingerprint_key(fingerprint)}"
+            f"  plan-cache {'hit' if hit else 'miss'}"
+            f"  total {q.wall_s() * 1e3:.1f} ms"
+            f"  rows out {out.row_count}",
+        ]
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# explain(analyze=True) rendering helpers
+# ----------------------------------------------------------------------
+#: counter families rendered as per-node "gates": the engine's adaptive
+#: decisions, attributable to the node whose execution made them
+_GATE_PREFIXES = (
+    "ordering.", "shuffle.semi_filter.", "lane_pack.", "plan.cache.",
+)
+
+
+def _fired_line(fired) -> str:
+    if not fired:
+        return "Rewrites fired: (none)"
+    counts: Dict[str, int] = {}
+    for f in fired:
+        counts[f] = counts.get(f, 0) + 1
+    return "Rewrites fired: " + ", ".join(
+        f"{k} x{v}" for k, v in sorted(counts.items())
+    )
+
+
+def _node_exclusive(sp) -> Dict:
+    """Per-node EXCLUSIVE aggregation over one ``plan.node.*`` span's
+    subtree, stopping at nested ``plan.node.*`` spans (their bytes and
+    gate decisions belong to the child's rendered line): collective
+    bytes shipped, gate-decision counters, and the summed wall of the
+    direct child-node spans (for self-time)."""
+    agg = {"coll": 0, "gates": {}, "child_wall": 0.0}
+
+    def fold(s, top: bool) -> None:
+        if not top and s.name.startswith("plan.node."):
+            agg["child_wall"] += s.dur_s()
+            return
+        v = s.attrs.get("coll_bytes")
+        if isinstance(v, (int, float)):
+            agg["coll"] += int(v)
+        for name, cr in s.counters.items():
+            if name.startswith(_GATE_PREFIXES):
+                agg["gates"][name] = agg["gates"].get(name, 0) + cr[0]
+        for c in s.children:
+            fold(c, False)
+
+    fold(sp, True)
+    return agg
+
+
+def _render_analyzed(root, q) -> str:
+    """The optimized tree, each line annotated from its measured
+    ``plan.node`` span: wall/self ms, rows in->out, coll MB, gates."""
+    order = _lower.plan_order(root)
+    by_id: Dict[int, object] = {}
+    for sp in q.all_spans():
+        nid = sp.attrs.get("node_id")
+        if nid is not None and sp.name.startswith("plan.node."):
+            by_id[nid] = sp
+    lines: List[str] = []
+
+    def walk(n, indent: int) -> None:
+        prefix = "  " * indent + n.line()
+        sp = by_id.get(order[id(n)])
+        if sp is None:
+            lines.append(prefix)
+        else:
+            agg = _node_exclusive(sp)
+            wall = sp.dur_s() * 1e3
+            self_ms = max(wall - agg["child_wall"] * 1e3, 0.0)
+            parts = [f"{wall:.1f} ms (self {self_ms:.1f})"]
+            rows_out = sp.attrs.get("rows_out")
+            if rows_out is not None:
+                if n.children:
+                    # a span-less child (e.g. a Shuffle peeled into the
+                    # join recipe) contributes its own spanned inputs
+                    def rows_of(c) -> int:
+                        csp = by_id.get(order[id(c)])
+                        if csp is not None:
+                            return int(csp.attrs.get("rows_out") or 0)
+                        return sum(rows_of(g) for g in c.children)
+
+                    rows_in = sum(rows_of(c) for c in n.children)
+                    parts.append(f"rows={rows_in}->{rows_out}")
+                else:
+                    parts.append(f"rows={rows_out}")
+            if agg["coll"]:
+                parts.append(f"coll={agg['coll'] / 1e6:.2f} MB")
+            if agg["gates"]:
+                parts.append(
+                    "gates["
+                    + ", ".join(
+                        f"{k} x{v}" if v > 1 else k
+                        for k, v in sorted(agg["gates"].items())
+                    )
+                    + "]"
+                )
+            lines.append(prefix + "  ** " + "  ".join(parts))
+        for c in n.children:
+            walk(c, indent + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
 
 
 class LazyGroupBy:

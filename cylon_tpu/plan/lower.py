@@ -17,6 +17,8 @@ from typing import Callable, Dict, List, Sequence
 
 import numpy as np
 
+from ..obs import trace as _obstrace
+from ..utils.tracing import span
 from .expr import filter_mask
 from .nodes import (
     Filter,
@@ -127,8 +129,37 @@ def _prepare_join_inputs(
     return lt, rt
 
 
+def plan_order(root: Node) -> Dict[int, int]:
+    """Stable pre-order numbering of a plan's nodes: the ``node_id`` a
+    per-node span carries, and the id ``explain(analyze=True)`` joins
+    spans back to rendered tree lines with. Computed identically here
+    and in the renderer because both walk the SAME detached plan object
+    the cached executor closed over."""
+    order: Dict[int, int] = {}
+
+    def number(n: Node) -> None:
+        if id(n) in order:
+            return  # shared subplan (DAG): keep the first-visit id
+        order[id(n)] = len(order)
+        for c in n.children:
+            number(c)
+
+    number(root)
+    return order
+
+
 def build_executor(root: Node) -> Callable[[List], "object"]:
-    """Compile the plan into ``fn(tables) -> Table``."""
+    """Compile the plan into ``fn(tables) -> Table``.
+
+    Every node executes under a ``plan.node.<Type>`` span carrying its
+    pre-order ``node_id`` — with tracing off that is one disabled-path
+    span call per node (rollup bump only); with a query trace active the
+    spans nest into the query's tree and ``explain(analyze=True)`` joins
+    them back to plan lines. Under ``obs.trace.analyze_mode()`` (set
+    ONLY by explain(analyze=True) — never the production dispatch path)
+    each node's result is materialized so rows in/out are exact; that is
+    a diagnostic per-node sync by design."""
+    order = plan_order(root)
 
     def run(tables: List):
         memo: Dict[int, object] = {}
@@ -137,7 +168,17 @@ def build_executor(root: Node) -> Callable[[List], "object"]:
             got = memo.get(id(node))
             if got is not None:
                 return got
-            out = _lower_one(node, ex, tables)
+            with span(
+                "plan.node." + type(node).__name__,
+                node_id=order[id(node)],
+            ) as sp:
+                out = _lower_one(node, ex, tables)
+                if _obstrace.analyze_active():
+                    out._materialize()
+                if sp is not None:
+                    rows = out._rows_hint()
+                    if rows is not None:
+                        sp.attrs["rows_out"] = rows
             memo[id(node)] = out
             return out
 

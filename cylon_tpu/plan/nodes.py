@@ -90,8 +90,11 @@ class Node:
         """One-line description for ``.explain()``."""
         return type(self).__name__
 
-    def render(self, indent: int = 0) -> str:
-        line = "  " * indent + self.label()
+    def line(self) -> str:
+        """The node's single rendered line (label + derived properties)
+        — shared by :meth:`render` and the ``explain(analyze=True)``
+        annotated renderer (plan/lazy.py)."""
+        line = self.label()
         o = self.ordering()
         if o is not None:
             line += f"  -- order: {o.describe()}"
@@ -103,7 +106,10 @@ class Node:
                 f"{n}:{field_bits(v)}b" for n, v in sorted(stats.items())
             )
             line += f"  -- stats: {widths}"
-        lines = [line]
+        return line
+
+    def render(self, indent: int = 0) -> str:
+        lines = ["  " * indent + self.line()]
         for c in self.children:
             lines.append(c.render(indent + 1))
         return "\n".join(lines)

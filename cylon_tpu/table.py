@@ -51,7 +51,8 @@ from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
 from .ops import stats as _st
 from .parallel import shuffle as _sh
-from .utils.tracing import bump, gauge, span
+from .obs import trace as _obstrace
+from .utils.tracing import annotate_add, bump, gauge, span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
 
@@ -307,6 +308,12 @@ class Table:
             # only after, so _counts_raw never observes both None
             self._counts_host = got
             self._counts_fut = None
+            # deferred span-end resolution rides THIS fetch: stamp the
+            # device-resolved end time of any trace pending on this
+            # result and feed the fingerprint latency histogram — zero
+            # additional syncs (obs.trace.resolve_table owns a 0-site
+            # budget in analysis/contracts.py)
+            _obstrace.resolve_table(self)
 
     @property
     def world_size(self) -> int:
@@ -3580,6 +3587,21 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                     )
                 else:
                     bump("lane_pack.wire.gate_skipped")
+        # per-exchange wire accounting for the active query trace: total
+        # shipped bytes = K rounds x world^2 bucket blocks x effective
+        # (possibly wire-narrowed) row bytes. Attaches to the innermost
+        # open span — the owning plan.node.* during lowered execution —
+        # so explain(analyze=True) prints per-node coll MB. Host
+        # arithmetic only; adds no sync and no dispatch.
+        rb_eff = (
+            row_bytes if st["wire"] is None
+            else _g_pack.wire_row_bytes(st["wire"])
+        )
+        annotate_add(
+            coll_bytes=int(st["n_rounds"]) * st["world"] * st["world"]
+            * int(st["bucket_cap"]) * int(rb_eff),
+            shuffle_rounds=int(st["n_rounds"]),
+        )
         st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
         bump("shuffle.rounds", rows=st["n_rounds"])
         st["rounds_out"] = []
@@ -3764,6 +3786,7 @@ def _pair_sketches(
     with span("shuffle.semi_filter.sketch", rows=wire):
         gsk = get_kernel(ctx, key, builder)(dp, ())
     bump("semi_filter.sketch_bytes", rows=wire)
+    annotate_add(coll_bytes=int(wire), sketch_bytes=int(wire))
     row_of = {name: i for i, (name, _t, _k) in enumerate(build)}
     probe = {}
     if sides in ("both", "a"):

@@ -221,9 +221,26 @@ COMPILE_CACHE = EnvKnob(
 )
 
 # -- observability ------------------------------------------------------
+# All three trace knobs are host-only by declared contract (the L1
+# trace-time-read rule): they gate span logging/recording/export and can
+# never reach a kernel body or a cache key — an instrumented q3 dispatch
+# keeps its EXACT 1-host-sync budget (analysis/contracts.py
+# Q3_DISPATCH_HOST_SYNCS; runtime census in tools/trace_smoke.py).
 TRACE = EnvKnob(
     "CYLON_TPU_TRACE", "0", kind="observability",
-    note="=1 logs each tracing span as it closes; alters no program",
+    note="=1 logs each span as it closes AND records query span trees; "
+    "any other truthy value (e.g. 'tree') records the structured traces "
+    "without the per-span stderr log; alters no program",
+)
+TRACE_RING = EnvKnob(
+    "CYLON_TPU_TRACE_RING", "64", kind="observability",
+    note="flight-recorder capacity: the last N finished query traces "
+    "kept in memory (obs/export.py); read per record, host-only",
+)
+TRACE_EXPORT = EnvKnob(
+    "CYLON_TPU_TRACE_EXPORT", "", kind="observability",
+    note="when set, the flight ring is written to this path as Chrome "
+    "trace-event JSON (Perfetto-loadable) at interpreter exit",
 )
 NO_EFFECT_LINT = EnvKnob(
     "CYLON_TPU_NO_EFFECT_LINT", "0", kind="observability",

@@ -1,129 +1,53 @@
-"""Op-phase tracing and profiling.
+"""Compat shim over :mod:`cylon_tpu.obs` (the query-scoped telemetry
+subsystem, ISSUE 8).
 
-Reference analog: the pervasive ad-hoc ``std::chrono`` spans logged via glog —
-shuffle timings (table.cpp:166-176), partition/split timing
-(partition/partition.cpp:58-60,113-114), join phase breakdown
-setup/build/probe (join/hash_join.cpp:286-304), op-level timers
-(ops/partition_op.cpp:78-83) — plus the CYLON_DEBUG compile-time phase timers
-(table.cpp:925-980).
+This module used to BE the tracer: a flat module-global
+counter/gauge/span dict with wall-clock-only timing. That registry (and
+this module's entire API) survives as the process-global ROLLUP inside
+``cylon_tpu/obs/metrics.py`` — every pre-existing consumer
+(``analysis/plans.py``'s census checks, the benchmark gates,
+``tests/test_tracing.py``) keeps importing from here unchanged — while
+the structured layer (per-query span trees, contextvar isolation,
+deferred device timing, fingerprint histograms, exporters) lives in
+``cylon_tpu/obs/``. See docs/ARCHITECTURE.md "Observability".
 
-Here the spans are first-class: a process-wide registry aggregates
-(count, total_s, max_s, rows) per span name, ``CYLON_TPU_TRACE=1`` additionally
-logs each span as it closes (glog-style), and :func:`profile` wraps
-``jax.profiler.trace`` so the same run can emit a Perfetto/XPlane device trace
-(SURVEY.md §5: "TPU equivalent: jax.profiler traces + Perfetto, plus the same
-op-phase spans").
-
-Span timings are HOST wall-clock around dispatch, like the reference's
-timers around its (synchronous) kernels. JAX dispatch is async, so a span
-covers trace+dispatch unless the op syncs — exactly the op boundaries where
-the framework syncs (count fetches) are the ones worth seeing.
+Reference analog: the pervasive ad-hoc ``std::chrono`` spans logged via
+glog — shuffle timings (table.cpp:166-176), join phase breakdown
+(join/hash_join.cpp:286-304) — except here spans are first-class and
+query-attributed.
 """
 from __future__ import annotations
 
-import contextlib
-import os
-import sys
-import threading
-import time
-from collections import defaultdict
-from typing import Dict, Iterator, Optional
+from typing import Dict, Optional
 
-_lock = threading.Lock()
-_stats: Dict[str, Dict[str, float]] = defaultdict(
-    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "rows": 0}
+from ..obs.metrics import get_count, report, reset_rollup, snapshot
+from ..obs.trace import (  # noqa: F401  (the instrumentation surface)
+    annotate_add,
+    bump,
+    gauge,
+    profile,
+    span,
+    trace_enabled,
+    tracing_active,
 )
 
-
-def trace_enabled() -> bool:
-    from .envgate import TRACE
-
-    return TRACE.get() == "1"
-
-
-@contextlib.contextmanager
-def span(name: str, rows: Optional[int] = None) -> Iterator[None]:
-    """Time one op phase; aggregate into the registry (+ log when enabled)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            s = _stats[name]
-            s["count"] += 1
-            s["total_s"] += dt
-            s["max_s"] = max(s["max_s"], dt)
-            if rows is not None:
-                s["rows"] += int(rows)
-        if trace_enabled():
-            extra = f" rows={rows}" if rows is not None else ""
-            print(f"[cylon_tpu] {name}: {dt * 1e3:.2f} ms{extra}", file=sys.stderr)
-
-
-def bump(name: str, rows: Optional[int] = None) -> None:
-    """Count an event (no timing) in the same registry — e.g. ``host_sync``,
-    bumped at every device->host count fetch so eager-vs-fused dispatch
-    behavior is measurable (the reference logs row counts after collectives
-    the same way, table.cpp:118-123)."""
-    with _lock:
-        s = _stats[name]
-        s["count"] += 1
-        if rows is not None:
-            s["rows"] += int(rows)
-
-
-def gauge(name: str, value: float) -> None:
-    """Record a measured VALUE (not a duration) in the registry: count is
-    the sample count, total_s accumulates the values (mean = total_s/count)
-    and max_s tracks the peak. Used for the shuffle's per-op
-    ``shuffle.overlap_efficiency`` ratio (fraction of the exchange wall
-    spent issuing overlapped round work rather than blocked on the device)
-    so :func:`report` exposes it next to the phase spans."""
-    with _lock:
-        s = _stats[name]
-        s["count"] += 1
-        s["total_s"] += float(value)
-        s["max_s"] = max(s["max_s"], float(value))
-    if trace_enabled():
-        print(f"[cylon_tpu] {name} = {value:.4f}", file=sys.stderr)
-
-
-def get_count(name: str) -> int:
-    with _lock:
-        return int(_stats[name]["count"]) if name in _stats else 0
+__all__ = [
+    "annotate_add", "bump", "gauge", "get_count", "get_trace_report",
+    "profile", "report", "reset_trace", "span", "trace_enabled",
+    "tracing_active",
+]
 
 
 def get_trace_report() -> Dict[str, Dict[str, float]]:
     """Aggregated span stats: {name: {count, total_s, max_s, rows}}."""
-    with _lock:
-        return {k: dict(v) for k, v in _stats.items()}
-
-
-def report(prefix: Optional[str] = None) -> Dict[str, Dict[str, float]]:
-    """Aggregated span/counter stats as a plain dict, optionally filtered by
-    name prefix — e.g. ``report("plan.rule.")`` tells a benchmark exactly
-    which optimizer rewrites fired (and how often) since the last
-    :func:`reset_trace`."""
-    stats = get_trace_report()
-    if prefix is None:
-        return stats
-    return {k: v for k, v in stats.items() if k.startswith(prefix)}
+    return snapshot()
 
 
 def reset_trace() -> None:
-    with _lock:
-        _stats.clear()
+    """Clear the process-global rollup (query traces, the flight ring
+    and the latency histograms are separate stores — reset via
+    ``obs.export.reset_ring()`` / ``obs.metrics.reset_latency()``)."""
+    reset_rollup()
 
 
-@contextlib.contextmanager
-def profile(log_dir: str) -> Iterator[None]:
-    """Capture a device-level profiler trace (Perfetto/XPlane via
-    jax.profiler) around a block, alongside the host-side spans."""
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+_ = (get_count, report)  # re-exported verbatim

@@ -160,3 +160,59 @@ def test_hammer_with_eager_dispatch_mix(ctx8, rng):
             _assert_identical(
                 snap, oracle_plan if kind == "plan" else oracle_eager
             )
+
+
+def test_hammer_traced_eight_disjoint_trees(ctx8, rng, monkeypatch, tmp_path):
+    """ISSUE-8 acceptance under the hammer: 8 threads collecting the
+    cached q3 plan concurrently with the tracer ON must record 8
+    DISJOINT query span trees (per-thread contextvar isolation — the
+    flat tracer interleaved them into one blob), the exported Chrome
+    trace must carry 8 tracks, and the process-global rollup must remain
+    exactly the cross-query sum."""
+    from cylon_tpu.obs import export as obs_export
+
+    monkeypatch.setenv("CYLON_TPU_TRACE", "tree")
+    ta, tb = _mk_tables(ctx8, rng, n=1200)
+    q3 = (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(col("w") > 0.0)
+        .groupby("k", {"v": "sum"})
+    )
+    oracle = q3.collect().to_pydict()  # warm: hammer runs the hit path
+    obs_export.reset_ring()
+    tracing.reset_trace()
+    barrier = threading.Barrier(8)
+
+    def worker(_):
+        barrier.wait()
+        return q3.collect().to_pydict()
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        for snap in ex.map(worker, range(8)):
+            _assert_identical(snap, oracle)
+
+    qs = [q for q in obs_export.traces() if q.kind == "plan"]
+    assert len(qs) == 8, f"expected 8 query traces, got {len(qs)}"
+    # disjoint trees: no span object shared between any two traces, and
+    # every trace carries its own full plan pipeline
+    seen_spans = set()
+    for q in qs:
+        ids = set(map(id, q.all_spans()))
+        assert not (ids & seen_spans), "traces share span nodes"
+        seen_spans |= ids
+        names = {sp.name for sp in q.all_spans()}
+        assert "plan.execute" in names
+        assert any(n.startswith("plan.node.") for n in names)
+        assert q.counters["plan.cache.hit"][0] == 1
+        assert q.device_resolved_s() is not None
+    # rollup preserved: the global counter is exactly the per-trace sum
+    assert tracing.get_count("plan.cache.hit") == 8
+    # the Chrome export carries 8 tracks, one per query
+    path = str(tmp_path / "hammer.json")
+    obs_export.write_chrome(path, qs)
+    doc = obs_export.load_chrome(path)
+    assert obs_export.validate_chrome(doc) == []
+    tracks = obs_export.summarize(doc)
+    assert len(tracks) == 8
+    assert all(t["spans"] > 0 for t in tracks.values())

@@ -1,0 +1,393 @@
+"""Query-scoped telemetry (ISSUE 8): cylon_tpu/obs/.
+
+Covers the tentpole surface end to end:
+
+- span-TREE shape of a traced q3 collect (plan.node spans nested under
+  plan.execute, node ids, per-query counters, device-resolved end);
+- ``explain(analyze=True)`` golden assertions (per-node ms / rows /
+  coll MB / gate decisions on the fused q3 shape);
+- Chrome trace-event export: schema-validates and round-trips;
+- DISABLED tracer allocates nothing (no Span / QueryTrace objects) and
+  leaves the flight ring untouched;
+- flight-recorder ring eviction under CYLON_TPU_TRACE_RING;
+- fingerprint latency histograms (quantile math + the always-on
+  dispatch observation path);
+- every metric a q3 run emits is covered by the documented stable-name
+  table (obs.metrics.STABLE_METRICS);
+- two concurrent traced queries build DISJOINT trees while the
+  process-global rollup keeps the cross-query sum;
+- ``utils/tracing.profile()`` smoke (the jax.profiler passthrough).
+"""
+import gc
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import col
+from cylon_tpu.obs import export as obs_export
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.obs import trace as obs_trace
+from cylon_tpu.utils import tracing
+
+
+def _q3(ctx, rng, n=3000, salt=0.0):
+    ta = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 40, n).astype(np.int32),
+         "v": rng.normal(size=n).astype(np.float32)},
+    )
+    tb = ct.Table.from_pydict(
+        ctx,
+        {"rk": rng.integers(0, 40, n).astype(np.int32),
+         "w": rng.normal(size=n).astype(np.float32)},
+    )
+    return (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(col("w") > salt)
+        .groupby("k", {"v": "sum"})
+    )
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    """Structured tracing on (no stderr log), fresh ring."""
+    monkeypatch.setenv("CYLON_TPU_TRACE", "tree")
+    obs_export.reset_ring()
+    yield
+    obs_export.reset_ring()
+
+
+# ----------------------------------------------------------------------
+# span trees
+# ----------------------------------------------------------------------
+def test_q3_span_tree_shape(ctx8, rng, traced):
+    lf = _q3(ctx8, rng)
+    lf.collect()  # compile outside the assertion run
+    obs_export.reset_ring()
+    lf.collect()
+    qs = [q for q in obs_export.traces() if q.kind == "plan"]
+    assert len(qs) == 1
+    q = qs[0]
+    roots = [sp.name for sp in q.spans]
+    assert roots == ["plan.optimize", "plan.lower", "plan.execute"]
+    execute = q.spans[-1]
+    # per-node spans nest under plan.execute, parent/child links intact:
+    # the fused q3 node is the root, its Filter input nested below it
+    names = [sp.name for sp in execute.walk()]
+    assert "plan.node.FusedJoinGroupBySum" in names
+    assert "plan.node.Filter" in names
+    fused = next(
+        sp for sp in execute.walk()
+        if sp.name == "plan.node.FusedJoinGroupBySum"
+    )
+    assert any(
+        c.name == "plan.node.Filter" for c in fused.walk()
+    ), "input node must be a descendant of its consumer's span"
+    assert isinstance(fused.attrs.get("node_id"), int)
+    # per-query counters: the cache hit of this collect is attributed to
+    # THIS query, not just the global blob
+    assert q.counters["plan.cache.hit"][0] == 1
+    # the span carries collective accounting from the pair shuffle
+    assert fused.attrs.get("coll_bytes", 0) > 0
+    assert q.hist_key, "dispatch must label the trace with the fingerprint"
+    # device-resolved end time rode the deferred count fetch
+    assert q.device_resolved_s() is not None
+    assert q.resolved >= q.t0
+
+
+def test_eager_chain_implicit_trace(ctx8, rng, traced):
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 10, 512).astype(np.int32)}
+    )
+    obs_export.reset_ring()
+    t.shuffle(["k"])
+    ops = [q for q in obs_export.traces() if q.kind == "op"]
+    assert ops, "an outermost eager span must open an implicit trace"
+    names = {sp.name for q in ops for sp in q.all_spans()}
+    assert "shuffle.exchange" in names
+
+
+# ----------------------------------------------------------------------
+# explain(analyze=True)
+# ----------------------------------------------------------------------
+def test_explain_analyze_golden_q3(ctx8, rng):
+    lf = _q3(ctx8, rng, salt=0.111)
+    text = lf.explain(analyze=True)
+    assert "== Analyzed plan (executed) ==" in text
+    # the fused node line carries measured time, rows in->out and coll MB
+    fused_line = next(
+        ln for ln in text.splitlines() if "FusedJoinGroupBySum" in ln
+    )
+    assert " ms (self " in fused_line
+    assert "rows=" in fused_line and "->" in fused_line
+    assert "coll=" in fused_line and "MB" in fused_line
+    # gate decisions are printed per node; the plan-cache decision rides
+    # the summary line (it fires before the trace opens)
+    assert "gates[" in text
+    assert "plan-cache hit" in text or "plan-cache miss" in text
+    # scan rows are exact (analyze materializes every node)
+    scan_line = next(
+        ln for ln in text.splitlines()
+        if "Scan [k, v]" in ln and "**" in ln
+    )
+    assert "rows=3000" in scan_line
+    assert "Plan fingerprint: " in text
+    assert "Rewrites fired: " in text
+    # the default path is unchanged (no measurements, both plans shown)
+    plain = lf.explain()
+    assert "== Optimized plan ==" in plain and "**" not in plain
+    # the analyzed run is diagnostic: its (per-node-synced, possibly
+    # compile-laden) wall must NOT land in the fingerprint histogram
+    # that serving p50/p99 reads
+    obs_metrics.reset_latency()
+    lf.explain(analyze=True)
+    assert obs_metrics.latency_report() == {}
+
+
+def test_explain_analyze_keeps_dispatch_sync_contract(devices, rng):
+    """The analyzed run is diagnostic; the PRODUCTION dispatch path must
+    still perform zero syncs at dispatch + one at materialization — the
+    q3_dispatch contract shape: a 1-device mesh (serving: many
+    concurrent single-replica queries), where the fused plan has no
+    shuffle and the whole chain defers its count fetch."""
+    from cylon_tpu.analysis.hostsync import sync_monitor
+
+    ctx1 = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:1])
+    )
+    lf = _q3(ctx1, rng, salt=0.222)
+    lf.explain(analyze=True)  # warm + analyzed (per-node syncs allowed)
+    lf.collect()
+    with sync_monitor() as events:
+        t = lf.dispatch()
+    assert events == [], [e.site for e in events]
+    with sync_monitor() as events:
+        t._materialize()
+    assert [e.site for e in events] == ["_materialize_counts"]
+
+
+# ----------------------------------------------------------------------
+# Chrome export
+# ----------------------------------------------------------------------
+def test_chrome_export_schema_and_roundtrip(ctx8, rng, traced, tmp_path):
+    lf = _q3(ctx8, rng)
+    lf.collect()
+    obs_export.reset_ring()
+    lf.collect()
+    lf.collect()
+    qs = obs_export.traces()
+    n_spans = sum(len(list(q.all_spans())) for q in qs)
+    path = tmp_path / "trace.json"
+    n_events = obs_export.write_chrome(str(path))
+    doc = obs_export.load_chrome(str(path))
+    assert obs_export.validate_chrome(doc) == []
+    # per query: one thread_name metadata + one query event + its spans
+    assert n_events == len(doc["traceEvents"]) == n_spans + 2 * len(qs)
+    tracks = obs_export.summarize(doc)
+    plan_tracks = [t for t in tracks.values() if t["name"].startswith("plan:")]
+    assert len(plan_tracks) == 2
+    for t in plan_tracks:
+        assert t["spans"] > 0 and t["query_ms"] > 0
+        assert t["args"].get("fingerprint")
+    # raw-JSON round trip: what we wrote is what a Perfetto load parses
+    assert json.loads(path.read_text())["displayTimeUnit"] == "ms"
+
+
+# ----------------------------------------------------------------------
+# disabled-tracer pins
+# ----------------------------------------------------------------------
+def test_disabled_tracer_allocates_nothing(ctx8, rng, monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+    lf = _q3(ctx8, rng, salt=0.333)
+    lf.collect()  # warm
+    obs_export.reset_ring()
+    gc.collect()
+    before = sum(
+        isinstance(o, (obs_trace.Span, obs_trace.QueryTrace))
+        for o in gc.get_objects()
+    )
+    lf.collect()
+    gc.collect()
+    after = sum(
+        isinstance(o, (obs_trace.Span, obs_trace.QueryTrace))
+        for o in gc.get_objects()
+    )
+    assert after == before, "disabled tracer must allocate no trace objects"
+    assert obs_export.traces() == []
+    assert obs_trace.current() is None
+
+
+def test_disabled_span_still_feeds_rollup(local_ctx):
+    tracing.reset_trace()
+    with tracing.span("unit.disabled", rows=7):
+        pass
+    rep = tracing.get_trace_report()
+    assert rep["unit.disabled"]["count"] == 1
+    assert rep["unit.disabled"]["rows"] == 7
+
+
+# ----------------------------------------------------------------------
+# flight ring
+# ----------------------------------------------------------------------
+def test_ring_eviction(ctx8, rng, traced, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_TRACE_RING", "4")
+    lf = _q3(ctx8, rng)
+    lf.collect()
+    obs_export.reset_ring()
+    for _ in range(6):
+        lf.collect()
+    qs = obs_export.traces()
+    assert len(qs) == 4, "ring must hold exactly CYLON_TPU_TRACE_RING traces"
+    qids = [q.qid for q in qs]
+    assert qids == sorted(qids), "oldest-first order"
+    # the evicted traces are the two oldest (strictly increasing qids)
+    assert qids[0] > 0 and len(set(qids)) == 4
+
+
+# ----------------------------------------------------------------------
+# latency histograms (the serving substrate)
+# ----------------------------------------------------------------------
+def test_histogram_quantiles_unit():
+    h = obs_metrics.Histogram()
+    for ms in range(1, 101):  # 1..100 ms uniform
+        h.record(ms / 1e3)
+    assert h.n == 100
+    # geometric buckets: ~10% relative resolution at any quantile
+    assert h.quantile(0.50) == pytest.approx(0.050, rel=0.15)
+    assert h.quantile(0.99) == pytest.approx(0.099, rel=0.15)
+    assert h.quantile(1.0) == pytest.approx(h.max_s)
+    assert obs_metrics.Histogram().quantile(0.5) == 0.0
+
+
+def test_dispatch_observes_fingerprint_histogram(ctx8, rng, monkeypatch):
+    """Latency histograms fill WITHOUT tracing enabled: the serving
+    metrics path is always on, and the end time rides the deferred
+    materialization (no extra sync)."""
+    monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+    obs_metrics.reset_latency()
+    lf = _q3(ctx8, rng, salt=0.444)
+    for _ in range(3):
+        lf.collect()
+    rep = obs_metrics.latency_report()
+    [(key, ent)] = [
+        (k, v) for k, v in rep.items() if "FusedJoinGroupBySum" in v["label"]
+    ]
+    assert ent["count"] == 3
+    assert 0 < ent["p50_s"] <= ent["p95_s"] <= ent["p99_s"]
+    assert obs_metrics.latency_quantiles(key)["count"] == 3
+    assert obs_metrics.latency_quantiles("no-such-key") is None
+
+
+# ----------------------------------------------------------------------
+# stable metric names
+# ----------------------------------------------------------------------
+def test_q3_metrics_all_declared(ctx8, rng):
+    """Everything a q3 run (and a shuffle) emits into the rollup is
+    covered by the documented stable-name table."""
+    tracing.reset_trace()
+    lf = _q3(ctx8, rng, salt=0.555)
+    lf.collect()
+    lf.collect()
+    undeclared = [
+        name for name in tracing.get_trace_report()
+        if not obs_metrics.is_declared(name)
+    ]
+    assert undeclared == [], undeclared
+
+
+# ----------------------------------------------------------------------
+# concurrent isolation (the 8-thread acceptance twin lives in
+# tests/test_concurrent_dispatch.py)
+# ----------------------------------------------------------------------
+def test_two_threads_two_disjoint_trees(ctx8, rng, traced):
+    lf = _q3(ctx8, rng)
+    lf.collect()  # warm: the hammer exercises the lock-free hit path
+    obs_export.reset_ring()
+    tracing.reset_trace()
+    barrier = threading.Barrier(2)
+
+    def worker(_):
+        barrier.wait()
+        return lf.collect().to_pydict()
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        a, b = list(ex.map(worker, range(2)))
+    assert list(a) == list(b)
+    qs = [q for q in obs_export.traces() if q.kind == "plan"]
+    assert len(qs) == 2, "two threads must record two disjoint traces"
+    assert qs[0].thread != qs[1].thread
+    s0 = set(map(id, qs[0].all_spans()))
+    s1 = set(map(id, qs[1].all_spans()))
+    assert not (s0 & s1), "span trees must not share nodes"
+    for q in qs:
+        assert any(
+            sp.name == "plan.execute" for sp in q.all_spans()
+        )
+        assert q.counters["plan.cache.hit"][0] == 1
+    # the process-global rollup is preserved as the cross-query sum
+    assert tracing.get_count("plan.cache.hit") == sum(
+        q.counters["plan.cache.hit"][0] for q in qs
+    )
+
+
+# ----------------------------------------------------------------------
+# review-hardening regressions
+# ----------------------------------------------------------------------
+def test_plan_order_unique_ids_on_shared_subplan(ctx8, rng):
+    """A reused LazyFrame shares Node objects between branches (a DAG);
+    plan_order must keep the first-visit id, never collapse a revisited
+    subtree onto a colliding id (which mapped one node's measured span
+    onto another node's rendered line)."""
+    from cylon_tpu.plan import lower as _lower
+
+    t = ct.Table.from_pydict(
+        ctx8,
+        {"k": rng.integers(0, 9, 128).astype(np.int32),
+         "v": rng.normal(size=128).astype(np.float32)},
+    )
+    base = t.lazy().filter(col("v") > 0)
+    lf = base.union(base)
+    ids = list(_lower.plan_order(lf._plan).values())
+    assert len(ids) == len(set(ids)), f"colliding node ids: {ids}"
+    text = lf.explain(analyze=True)
+    assert "== Analyzed plan (executed) ==" in text
+
+
+def test_pending_records_chain_on_passthrough(ctx8, rng, traced):
+    """A plan whose output is a passthrough of a still-deferred table
+    (bare Scan root) attaches a second pending record to the SAME table;
+    the one count fetch must resolve BOTH queries' traces, not clobber
+    the first."""
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 9, 64).astype(np.int32)}
+    )
+    obs_export.reset_ring()
+    d1 = t.lazy().filter(col("k") > 2).dispatch()  # counts deferred
+    d2 = d1.lazy().dispatch()  # Scan root: passthrough of d1
+    assert d2 is d1
+    d1._materialize()
+    qs = [q for q in obs_export.traces() if q.kind == "plan"]
+    assert len(qs) == 2, [q.name for q in obs_export.traces()]
+    assert all(q.device_resolved_s() is not None for q in qs)
+
+
+# ----------------------------------------------------------------------
+# device profiler passthrough
+# ----------------------------------------------------------------------
+def test_profile_smoke(local_ctx, tmp_path):
+    import os
+
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "prof")
+    with tracing.profile(d):
+        (jnp.arange(128) * 3).block_until_ready()
+    produced = [
+        os.path.join(r, f) for r, _dirs, fs in os.walk(d) for f in fs
+    ]
+    assert produced, "jax.profiler must have written a trace"

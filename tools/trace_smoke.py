@@ -1,0 +1,151 @@
+"""trace-smoke: the CI observability gate (ISSUE 8).
+
+Runs the plan-bench q3 shape (filter -> join -> groupby-SUM) on the
+8-virtual-device CPU mesh and asserts, in one process:
+
+1. EXPORT   — a traced run produces a Chrome trace that schema-validates
+   (``obs.export.validate_chrome``) and contains the per-node plan spans;
+   the JSON is written to ``--out`` (uploaded as a CI artifact, loadable
+   in Perfetto).
+2. CENSUS   — with the tracer ENABLED, the q3 ``dispatch()`` path still
+   performs exactly the contract's host syncs (1, at result fetch,
+   attributed to ``_materialize_counts``): the runtime twin of the
+   graft-lint L3 budgets, re-using ``analysis/plans.run_q3_dispatch``
+   under ``CYLON_TPU_TRACE``.
+3. OVERHEAD — the DISABLED tracer costs < 2% of the q3 collect wall:
+   measured as (per-disabled-span cost x instrumentation events per
+   query), where the event count comes from a traced run of the same
+   query and the per-span cost from a calibration loop. This form is
+   deterministic where a direct A/B wall-clock diff on a CI box is
+   noise-bound.
+
+Usage: python tools/trace_smoke.py [--rows 50000] [--out trace_q3.json]
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+
+def _fail(msg: str) -> None:
+    print(f"TRACE SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=50_000)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", type=str, default="trace_q3.json")
+    ap.add_argument("--overhead-gate", type=float, default=0.02)
+    args = ap.parse_args()
+
+    devices = ge._force_cpu_mesh(args.world)
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu import col
+    from cylon_tpu.analysis import plans
+    from cylon_tpu.obs import export as obs_export
+    from cylon_tpu.utils import tracing
+
+    os.environ.pop("CYLON_TPU_TRACE", None)  # start disabled
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[: args.world])
+    )
+    rng = np.random.default_rng(0)
+    n = args.rows
+    ta = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, n // 20 or 1, n).astype(np.int32),
+         "v": rng.normal(size=n).astype(np.float32),
+         "extra": rng.normal(size=n).astype(np.float32)},
+    )
+    tb = ct.Table.from_pydict(
+        ctx,
+        {"rk": rng.integers(0, n // 20 or 1, n // 2).astype(np.int32),
+         "w": rng.normal(size=n // 2).astype(np.float32)},
+    )
+    lf = (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(col("w") > 0.0)
+        .groupby("k", {"v": "sum"})
+    )
+
+    # ---- baseline: warm tracer-DISABLED collect wall ------------------
+    lf.collect()  # compile
+    t_query = float("inf")
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        lf.collect()
+        t_query = min(t_query, time.perf_counter() - t0)
+
+    # ---- 1. traced run + Chrome export --------------------------------
+    os.environ["CYLON_TPU_TRACE"] = "tree"  # structured, no stderr log
+    obs_export.reset_ring()
+    try:
+        lf.collect()
+        plan_traces = [q for q in obs_export.traces() if q.kind == "plan"]
+        if not plan_traces:
+            _fail("traced collect produced no plan query trace")
+        q = plan_traces[-1]
+        spans = list(q.all_spans())
+        node_spans = [s for s in spans if s.name.startswith("plan.node.")]
+        if not node_spans:
+            _fail("plan trace has no per-node spans")
+        if q.device_resolved_s() is None:
+            _fail("plan trace end time was not device-resolved")
+        n_events = len(spans) + sum(c[0] for c in q.counters.values())
+        n_ev = obs_export.write_chrome(args.out)
+        doc = obs_export.load_chrome(args.out)
+        problems = obs_export.validate_chrome(doc)
+        if problems:
+            _fail("export schema: " + "; ".join(problems[:5]))
+        print(f"# export ok: {n_ev} events -> {args.out} "
+              f"({len(spans)} spans, {len(node_spans)} plan nodes)")
+
+        # ---- 2. sync census under the ENABLED tracer ------------------
+        for res in plans.run_q3_dispatch(ctx, np.random.default_rng(7)):
+            if res.violations:
+                _fail("q3 dispatch census under tracer: "
+                      + "; ".join(res.violations))
+            if res.sync_sites != ["_materialize_counts"]:
+                _fail(f"q3 dispatch sync sites {res.sync_sites} != "
+                      "['_materialize_counts']")
+        print("# census ok: q3 dispatch = exactly 1 host sync at "
+              "_materialize_counts with the tracer enabled")
+    finally:
+        os.environ.pop("CYLON_TPU_TRACE", None)
+
+    # ---- 3. disabled-tracer overhead gate -----------------------------
+    calib = 20_000
+    t0 = time.perf_counter()
+    for _ in range(calib):
+        with tracing.span("overhead.probe"):
+            pass
+    per_span = (time.perf_counter() - t0) / calib
+    overhead = per_span * n_events
+    ratio = overhead / max(t_query, 1e-9)
+    print(f"# overhead: {n_events} instrumentation events/query x "
+          f"{per_span * 1e6:.2f} us disabled-span cost = "
+          f"{overhead * 1e3:.3f} ms = {100 * ratio:.3f}% of the "
+          f"{t_query * 1e3:.1f} ms q3 collect")
+    if ratio >= args.overhead_gate:
+        _fail(f"disabled-tracer overhead {100 * ratio:.2f}% >= "
+              f"{100 * args.overhead_gate:.0f}% gate")
+    print("# trace smoke ok")
+
+
+if __name__ == "__main__":
+    main()

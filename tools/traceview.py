@@ -1,0 +1,105 @@
+"""traceview: summarize an exported cylon_tpu Chrome trace.
+
+The flight-recorder ring (``cylon_tpu/obs/export.py``) dumps the last N
+query traces as Chrome trace-event JSON — Perfetto-loadable for the
+visual timeline; this tool is the terminal summary for the same file::
+
+    python -m tools.traceview trace.json            # per-query summary
+    python -m tools.traceview trace.json --tree     # span trees
+    python -m tools.traceview trace.json --top 10   # widen the hot list
+
+Produce a file with ``CYLON_TPU_TRACE_EXPORT=trace.json`` (written at
+interpreter exit) or programmatically via
+``cylon_tpu.obs.write_chrome("trace.json")``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_args(args: dict) -> str:
+    keep = []
+    for k in ("rows", "rows_out", "coll_bytes", "shuffle_rounds",
+              "fingerprint", "device_resolved_ms", "node_id"):
+        if k in args:
+            keep.append(f"{k}={args[k]}")
+    gates = [k[4:] for k in args if k.startswith("ctr:")]
+    if gates:
+        keep.append("ctr[" + ", ".join(sorted(gates)[:6]) + "]")
+    return ("  " + " ".join(keep)) if keep else ""
+
+
+def _print_tree(events, tid) -> None:
+    """Reconstruct span nesting from ts/dur containment (events come out
+    in tree pre-order, so a stack pass suffices)."""
+    spans = [
+        e for e in events
+        if e.get("tid") == tid and e.get("ph") == "X"
+        and not str(e.get("name", "")).startswith("query:")
+    ]
+    stack = []  # (end_ts)
+    for e in spans:
+        ts, dur = e["ts"], e["dur"]
+        while stack and ts >= stack[-1] - 1e-3:
+            stack.pop()
+        indent = "  " * (len(stack) + 1)
+        print(f"{indent}{e['name']}: {dur / 1e3:.2f} ms"
+              f"{_fmt_args(e.get('args', {}))}")
+        stack.append(ts + dur)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace JSON (obs.write_chrome)")
+    ap.add_argument("--tree", action="store_true", help="print span trees")
+    ap.add_argument("--top", type=int, default=5,
+                    help="hottest span names per query (default 5)")
+    args = ap.parse_args(argv)
+
+    from cylon_tpu.obs import export as ex
+
+    doc = ex.load_chrome(args.trace)
+    problems = ex.validate_chrome(doc)
+    if problems:
+        for p in problems[:20]:
+            print(f"SCHEMA: {p}", file=sys.stderr)
+        return 1
+    tracks = ex.summarize(doc)
+    if not tracks:
+        print("(no traces)")
+        return 0
+    print(f"{len(tracks)} query trace(s) in {args.trace}")
+    for tid in sorted(tracks):
+        t = tracks[tid]
+        qargs = t.get("args", {})
+        fp = qargs.get("fingerprint", "")
+        dev = qargs.get("device_resolved_ms")
+        line = (f"\n[{tid}] {t['name']}: {t['query_ms']:.2f} ms, "
+                f"{t['spans']} span(s)")
+        if fp:
+            line += f", fingerprint {fp}"
+        if dev is not None:
+            line += f", device-resolved {dev:.2f} ms"
+        print(line)
+        hot = sorted(
+            t["by_name"].items(), key=lambda kv: -kv[1][1]
+        )[: args.top]
+        for name, (count, ms) in hot:
+            print(f"    {name}: {ms:.2f} ms over {count} span(s)")
+        gates = sorted(k[4:] for k in qargs if k.startswith("ctr:"))
+        if gates:
+            print(f"    counters: {', '.join(gates[:12])}")
+        if args.tree:
+            _print_tree(doc["traceEvents"], tid)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `traceview ... | head` is a normal use
+        os._exit(0)
