@@ -74,6 +74,8 @@ from .join_config import JoinAlgorithm, JoinConfig  # noqa: E402
 from . import obs  # noqa: E402
 from . import plan  # noqa: E402
 from .plan import LazyFrame, col, lit  # noqa: E402
+from . import serve  # noqa: E402
+from .serve import QueryFuture, ServeOverloadError  # noqa: E402
 from .indexing.index import (  # noqa: E402
     CategoricalIndex,
     HashIndex,
@@ -119,6 +121,9 @@ __all__ = [
     "LocalConfig",
     "MPIConfig",
     "TPUConfig",
+    "QueryFuture",
+    "ServeOverloadError",
+    "serve",
     "Table",
     "concat",
     "dtypes",

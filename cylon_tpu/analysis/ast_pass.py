@@ -52,7 +52,7 @@ from .registry import EXEMPT, exemption_reason
 
 ENV_PREFIX = "CYLON_TPU_"
 # engine entry points whose second argument IS the cache key / fingerprint
-KEY_FUNCS = {"get_kernel", "run", "plan_executable"}
+KEY_FUNCS = {"get_kernel", "run", "plan_executable", "serve_batch_executable"}
 # callables that trace their function argument (kernel-body markers)
 JIT_WRAPPERS = {"jit", "shard_map", "make_jaxpr", "pmap"}
 # kinds whose reads must be threaded into a reachable cache key

@@ -239,6 +239,15 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     "obs.metrics.observe_latency": SyncBudget(
         0, note="lock + dict bump, pure host"
     ),
+    # the serving layer (ISSUE 9): the scheduler worker and the whole
+    # submit path own ZERO sync sites — a served query's single sync is
+    # QueryFuture.result, whose one budgeted site is the audited blocking
+    # wait on the worker's fulfillment (the count fetch itself is the
+    # table's amortized materialization, reached through it)
+    "QueryFuture.result": SyncBudget(
+        1, note="THE per-query sync point: blocks on fulfillment, then "
+        "forces the deferred count fetch in the caller's thread",
+    ),
     # amortized machinery: paid once, cached
     "Table._materialize_counts": SyncBudget(
         1, amortized=True,
@@ -263,6 +272,9 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     "DataFrame.add_suffix": "DISPATCH_SAFE",
     "DataFrame.applymap": "SYNC",
     "DataFrame.astype": "SYNC",
+    # serving submit (ISSUE 9): enqueue-only, provably sync-free — the
+    # acceptance pin "submit path = exactly 0 host syncs"
+    "DataFrame.collect_async": "DISPATCH_SAFE",
     "DataFrame.columns": "DISPATCH_SAFE",
     "DataFrame.concat": "SYNC",
     "DataFrame.context": "DISPATCH_SAFE",
@@ -306,6 +318,8 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     "DataFrame.to_table": "DISPATCH_SAFE",
     "DataFrame.where": "MATERIALIZE",
     "LazyFrame.collect": "SYNC",
+    # the serving submit path (ISSUE 9): enqueue-only — zero host syncs
+    "LazyFrame.collect_async": "DISPATCH_SAFE",
     "LazyFrame.columns": "DISPATCH_SAFE",
     "LazyFrame.dispatch": "SYNC",
     # re-pinned with ISSUE 8: explain(analyze=True) EXECUTES the plan
@@ -323,6 +337,20 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     "LazyFrame.select": "DISPATCH_SAFE",
     "LazyFrame.sort": "DISPATCH_SAFE",
     "LazyFrame.union": "DISPATCH_SAFE",
+    # the serving layer (ISSUE 9): submit/admission is DISPATCH_SAFE;
+    # QueryFuture.result is the single per-query SYNC point; the drain
+    # entry points that EXECUTE plans classify like dispatch (SYNC —
+    # distributed lowering delegates to the shuffle's budgeted fetches)
+    "QueryFuture.done": "DISPATCH_SAFE",
+    "QueryFuture.exception": "DISPATCH_SAFE",
+    "QueryFuture.result": "SYNC",
+    "ServeScheduler.close": "DISPATCH_SAFE",
+    "ServeScheduler.drain": "DISPATCH_SAFE",
+    "ServeScheduler.pause": "DISPATCH_SAFE",
+    "ServeScheduler.resume": "DISPATCH_SAFE",
+    "ServeScheduler.run_pending": "SYNC",
+    "ServeScheduler.stats": "DISPATCH_SAFE",
+    "ServeScheduler.submit": "DISPATCH_SAFE",
     "Table.add_column": "DISPATCH_SAFE",
     "Table.add_prefix": "DISPATCH_SAFE",
     "Table.add_suffix": "DISPATCH_SAFE",

@@ -58,6 +58,10 @@ from .effects import (
 _EXTRA_ENTRY_CLASSES = {
     ("cylon_tpu.frame", "DataFrame"),
     ("cylon_tpu.plan.lazy", "LazyFrame"),
+    # the serving surface (ISSUE 9): submit must certify DISPATCH_SAFE,
+    # QueryFuture.result is the SYNC point
+    ("cylon_tpu.serve.scheduler", "ServeScheduler"),
+    ("cylon_tpu.serve.future", "QueryFuture"),
 }
 
 _DUNDER = "__"

@@ -19,7 +19,7 @@ one XLA program per (shapes, statics) combination, compiled once and reused.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import numpy as np
@@ -166,6 +166,20 @@ def run(ctx: CylonContext, key: Tuple, builder, dp_args, rep_args=()):
 _PLAN_CACHE_MAX = 256
 
 
+class PlanEntry(NamedTuple):
+    """One cached optimize+lower product. ``hist_key`` is the plan's
+    latency-histogram key (``obs.metrics.fingerprint_key``), hoisted here
+    so the serving hot loop hashes each fingerprint exactly once — at
+    compile time — instead of re-deriving it on every collect
+    (``plan.fingerprint.hash`` counts the hashes; test_serving pins it
+    flat across cached collects)."""
+
+    opt: Any                  # the optimized (detached) plan
+    fired: Tuple[str, ...]    # optimizer rule firings
+    fn: Callable              # executor: fn(tables) -> Table
+    hist_key: str             # fingerprint_key(fingerprint), precomputed
+
+
 def plan_executable(ctx: CylonContext, fingerprint, compile_fn):
     """Per-context cache of optimized+lowered plan executables, keyed by the
     plan's structural fingerprint (node shapes + schemas + world size; NOT
@@ -184,30 +198,40 @@ def plan_executable(ctx: CylonContext, fingerprint, compile_fn):
     lock, so a cache stampede (many threads racing the first compile of
     one fingerprint) compiles exactly once — the losers block, then hit.
     """
-    cache = ctx.__dict__.get("_plan_cache")
+    return _cached_compile(
+        ctx, "_plan_cache", fingerprint, compile_fn, "plan.cache",
+        _PLAN_CACHE_MAX,
+    )
+
+
+def _cached_compile(ctx, attr: str, key, compile_fn, counter: str, cap: int):
+    """The ONE copy of the executable-cache discipline shared by the
+    plan tier and the serve batch tier: lazy ``ctx.__dict__`` cache
+    creation, lock-free hits of fully-published entries, stampedes
+    compiling exactly once under the per-context lock, and bounded FIFO
+    eviction (literal values ride fingerprints, so a literal sweep must
+    not grow an entry per value — dropping one only costs a re-optimize,
+    the jitted kernels stay cached). Counted as ``<counter>.hit`` /
+    ``<counter>.miss``."""
+    cache = ctx.__dict__.get(attr)
     if cache is None:
         with cache_lock(ctx):
-            cache = ctx.__dict__.setdefault("_plan_cache", {})
-    entry = cache.get(fingerprint)
+            cache = ctx.__dict__.setdefault(attr, {})
+    entry = cache.get(key)
     if entry is not None:
-        bump("plan.cache.hit")
+        bump(counter + ".hit")
         return entry, True
     with cache_lock(ctx):
-        entry = cache.get(fingerprint)
+        entry = cache.get(key)
         if entry is not None:
             # stampede loser: the winner compiled while we waited
-            bump("plan.cache.hit")
+            bump(counter + ".hit")
             return entry, True
-        bump("plan.cache.miss")
+        bump(counter + ".miss")
         entry = compile_fn()
-        # bounded: literal values are part of the fingerprint, so a literal
-        # sweep (filter(col('v') > t) for many t) would otherwise grow one
-        # entry per value for the context's lifetime. FIFO eviction —
-        # dropping an entry only costs a re-optimize, the jitted kernels
-        # stay cached.
-        if len(cache) >= _PLAN_CACHE_MAX:
+        if len(cache) >= cap:
             cache.pop(next(iter(cache)))
-        cache[fingerprint] = entry
+        cache[key] = entry
     return entry, False
 
 
@@ -219,3 +243,30 @@ def plan_cache_stats() -> dict:
         "hits": get_count("plan.cache.hit"),
         "misses": get_count("plan.cache.miss"),
     }
+
+
+# ----------------------------------------------------------------------
+# batched-executor tier (cylon_tpu/serve): compile-once, serve-many over
+# B same-fingerprint parameter bindings stacked into ONE device program
+# ----------------------------------------------------------------------
+_BATCH_CACHE_MAX = 64
+
+
+def serve_batch_executable(ctx: CylonContext, key, compile_fn):
+    """Per-context cache of BATCHED plan executors, keyed by
+    ``(fingerprint..., B-bucket)`` — the serving scheduler's second
+    executor tier above :func:`plan_executable`.
+
+    The scheduler buckets batch sizes to powers of two (padding the tail
+    of a batch with zero-row binding slots), so one fingerprint grows at
+    most log2(CYLON_TPU_SERVE_BATCH_MAX) entries here no matter how the
+    arrival process mixes batch sizes. Same locking discipline as the
+    plan cache (``_cached_compile``): lock-free hits of fully-published
+    entries, stampedes compile exactly once under the per-context lock,
+    bounded FIFO. Counted as ``serve.batch_cache.hit`` /
+    ``serve.batch_cache.miss`` (the test_serving cache pin: B bindings
+    -> 1 compile per (fingerprint, B-bucket))."""
+    return _cached_compile(
+        ctx, "_serve_batch_cache", key, compile_fn, "serve.batch_cache",
+        _BATCH_CACHE_MAX,
+    )

@@ -133,6 +133,23 @@ class DataFrame:
         ``df.lazy().filter(...).join(...).groupby(...).collect()``."""
         return self._table.lazy()
 
+    def collect_async(self, block: bool = True):
+        """Submit this frame's (identity) plan to the serving scheduler;
+        returns a :class:`~cylon_tpu.serve.QueryFuture` whose
+        ``result()`` is a DataFrame. Enqueue-only — zero host syncs at
+        submit (graft-lint pins DISPATCH_SAFE); the single deferred
+        materialize happens in ``result()``. See
+        ``LazyFrame.collect_async`` for the serving semantics."""
+        from .serve.scheduler import submit as _serve_submit
+
+        # _table= keyword path: wrapping must never touch the default-
+        # context machinery (DataFrame(data=...) would resolve
+        # _local_ctx() before noticing the value is already a Table)
+        return _serve_submit(
+            self._table.lazy(), block=block,
+            wrap=lambda t: DataFrame(_table=t),
+        )
+
     @property
     def columns(self) -> List[str]:
         return self._table.column_names

@@ -151,7 +151,13 @@ _HIST_LABELS: Dict[str, str] = {}
 def fingerprint_key(fingerprint) -> str:
     """Stable short key for a plan fingerprint (any reprable value):
     12 hex chars of blake2s over the repr — the histogram / trace-track
-    identity of one plan shape within a process."""
+    identity of one plan shape within a process.
+
+    The repr walk over a deep plan tuple is NOT free, so the cached
+    executor entry hoists its key (``engine.PlanEntry.hist_key``) and the
+    serving hot loop never re-hashes; ``plan.fingerprint.hash`` counts
+    every hash performed so tests can pin the hot loop at zero."""
+    rollup_count("plan.fingerprint.hash")
     return hashlib.blake2s(
         repr(fingerprint).encode(), digest_size=6
     ).hexdigest()
@@ -245,6 +251,14 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "plan.node.": ("span", "per-plan-node execution (node_id attr)"),
     "plan.rule.": ("counter", "one bump per optimizer rule firing"),
     "plan.cache.": ("counter", "plan-fingerprint executable cache hit/miss"),
+    "plan.fingerprint.hash": (
+        "counter", "fingerprint_key hashes performed (hoisted onto the "
+        "cached executor entry: flat across cached collects)"),
+    "serve.": (
+        "mixed", "query serving (cylon_tpu/serve): queue_depth / "
+        "inflight_bytes / batch_occupancy gauges; submitted / completed / "
+        "shed / backpressure.wait / budget_overflow / batches / singles "
+        "counters; batch_cache.hit/miss; serve.stack span"),
     "query.": ("mixed", "query-level rollup: query.traces recorded"),
     "overhead.": ("span", "trace_smoke calibration probes (tools only)"),
 }

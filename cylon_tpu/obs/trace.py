@@ -317,16 +317,27 @@ def query_trace(
         _maybe_finish(q)
 
 
-def attach_result(table, fingerprint=None, label: str = "", t0: Optional[float] = None) -> None:
+def attach_result(
+    table,
+    fingerprint=None,
+    label: str = "",
+    t0: Optional[float] = None,
+    hist_key: Optional[str] = None,
+) -> None:
     """Bind a dispatched result Table to the active trace / the latency
     histogram. The table's deferred count fetch (``_materialize_counts``)
     will call :func:`resolve_table`, stamping the device-resolved end
     time and observing ``fetch-time - t0`` into the fingerprint-keyed
     histogram — with NO additional host sync (the fetch already
-    happened). Counts already host-known resolve immediately."""
+    happened). Counts already host-known resolve immediately.
+
+    Hot callers (``LazyFrame.dispatch``, the serving scheduler) pass the
+    PRECOMPUTED ``hist_key`` hoisted onto the cached executor entry
+    (``engine.PlanEntry``); ``fingerprint=`` hashes per call and remains
+    for one-shot diagnostic callers only."""
     q = _ACTIVE.get()
-    key = None
-    if fingerprint is not None:
+    key = hist_key
+    if key is None and fingerprint is not None:
         key = _metrics.fingerprint_key(fingerprint)
     if q is not None:
         q.pending = True

@@ -14,7 +14,7 @@ from __future__ import annotations
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
 
-from ..engine import plan_executable
+from ..engine import PlanEntry, plan_executable
 from ..obs import metrics as _obsmetrics
 from ..obs import trace as _obstrace
 from ..utils.tracing import bump, span
@@ -38,6 +38,25 @@ def _as_list(x) -> List[str]:
     if isinstance(x, str):
         return [x]
     return list(x)
+
+
+def gated_fingerprint(plan: Node) -> tuple:
+    """The executable identity of a plan: its structural fingerprint plus
+    the ordering / semi-filter / lane-packing escape-hatch gate states.
+    The gates change which rewrites fire and which kernels the lowered
+    ops pick, so they are part of the identity — a mid-process env flip
+    must re-optimize, never reuse a cached executor built under the
+    other gate state. The ONE copy of this recipe: ``_executable`` keys
+    the plan cache with it and the serving scheduler groups/keys batches
+    with it (graft-lint L1 sees the gate reads threaded into both cache
+    keys through this carrier)."""
+    from ..ops.sketch import enabled as _semi_enabled
+    from ..ops.stats import enabled as _pack_enabled
+    from ..ordering import enabled as _ord_enabled
+
+    return (
+        plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
+    )
 
 
 def _normalize_aggs(agg: Dict[str, TUnion[str, Sequence[str]]]) -> List[Tuple[str, str]]:
@@ -196,26 +215,39 @@ class LazyFrame:
         t._materialize()
         return t
 
+    def collect_async(self, block: bool = True):
+        """Submit this plan to the context's serving scheduler; returns a
+        :class:`~cylon_tpu.serve.QueryFuture` immediately.
+
+        The submit path only enqueues — it performs ZERO host syncs and
+        ZERO execution (graft-lint pins ``LazyFrame.collect_async`` =
+        DISPATCH_SAFE); the scheduler's worker runs the sync-free
+        ``dispatch()`` machinery, batching same-fingerprint plans over
+        different parameter bindings into one stacked device program, and
+        ``QueryFuture.result()`` is the single deferred materialize. So a
+        caller overlaps N in-flight queries on one device stream::
+
+            futs = [q.collect_async() for q in queries]   # admission-gated
+            tables = [f.result() for f in futs]           # one sync each
+
+        ``block=False`` sheds with :class:`~cylon_tpu.serve
+        .ServeOverloadError` instead of waiting when admission control
+        (``CYLON_TPU_SERVE_INFLIGHT_BYTES`` / ``_QUEUE_DEPTH``) is at
+        capacity."""
+        from ..serve.scheduler import submit as _serve_submit
+
+        return _serve_submit(self, block=block)
+
     def _executable(self):
         """Optimize+lower through the plan-fingerprint cache: returns
-        ``(tables, fingerprint, (opt, fired, fn), hit)`` — the ONE copy
-        of the compile/cache recipe shared by ``dispatch()`` and
-        ``explain(analyze=True)``."""
+        ``(tables, fingerprint, PlanEntry, hit)`` — the ONE copy of the
+        compile/cache recipe shared by ``dispatch()`` and
+        ``explain(analyze=True)``. The entry carries the precomputed
+        histogram key (``PlanEntry.hist_key``), so a cache hit performs
+        zero fingerprint hashing."""
         ctx = self._ctx
         tables = _lower.scan_tables(self._plan)
-        from ..ops.sketch import enabled as _semi_enabled
-        from ..ops.stats import enabled as _pack_enabled
-        from ..ordering import enabled as _ord_enabled
-
-        # the ordering, semi-filter and lane-packing escape hatches change
-        # which rewrites fire / which kernels the lowered ops pick, so all
-        # three are part of the executable's identity — a mid-process env
-        # flip must re-optimize, never reuse a cached executor built under
-        # the other gate state
-        fingerprint = (
-            self._plan.fingerprint(), _ord_enabled(), _semi_enabled(),
-            _pack_enabled(),
-        )
+        fingerprint = gated_fingerprint(self._plan)
 
         def compile_plan():
             with span("plan.optimize"):
@@ -225,7 +257,10 @@ class LazyFrame:
                 # ordinals and no table references (lower.detach_scans)
                 opt = _lower.detach_scans(opt)
                 fn = _lower.build_executor(opt)
-            return opt, tuple(fired), fn
+            return PlanEntry(
+                opt, tuple(fired), fn,
+                _obsmetrics.fingerprint_key(fingerprint),
+            )
 
         entry, hit = plan_executable(ctx, fingerprint, compile_plan)
         return tables, fingerprint, entry, hit
@@ -256,7 +291,7 @@ class LazyFrame:
             type(self._plan).__name__, kind="plan"
         ):
             tables, fingerprint, entry, hit = self._executable()
-            opt, fired, fn = entry
+            opt, fired, fn = entry.opt, entry.fired, entry.fn
             if hit:
                 # cached optimize+lower: emit the spans anyway so every
                 # collect is visible in tracing.report() (at ~zero cost)
@@ -269,7 +304,7 @@ class LazyFrame:
             with span("plan.execute"):
                 out = fn(tables)
             _obstrace.attach_result(
-                out, fingerprint=fingerprint, label=opt.label(), t0=t_q
+                out, hist_key=entry.hist_key, label=opt.label(), t0=t_q
             )
             return out
 
@@ -279,7 +314,7 @@ class LazyFrame:
         tree annotated from the measured span tree."""
         t_q = _time.perf_counter()
         tables, fingerprint, entry, hit = self._executable()
-        opt, fired, fn = entry
+        opt, fired, fn = entry.opt, entry.fired, entry.fn
         with _obstrace.analyze_mode():
             with _obstrace.query_trace(
                 type(self._plan).__name__, kind="explain", force=True,
@@ -298,7 +333,7 @@ class LazyFrame:
             "== Analyzed plan (executed) ==",
             _render_analyzed(opt, q), "",
             _fired_line(fired),
-            f"Plan fingerprint: {_obsmetrics.fingerprint_key(fingerprint)}"
+            f"Plan fingerprint: {entry.hist_key}"
             f"  plan-cache {'hit' if hit else 'miss'}"
             f"  total {q.wall_s() * 1e3:.1f} ms"
             f"  rows out {out.row_count}",

@@ -1,0 +1,31 @@
+"""cylon_tpu.serve: the concurrent query-serving engine.
+
+Compile-once/serve-many under load (ROADMAP item 1): ``collect_async``
+submission with zero host syncs, a scheduler that fuses same-fingerprint
+plans over different parameter bindings into one stacked device program,
+and admission control that bounds in-flight bytes so concurrency
+degrades into queueing instead of OOM. See docs/ARCHITECTURE.md
+"Query serving".
+"""
+from .batch import QID, BatchTemplate, Unbatchable, is_batchable, stack_tables
+from .future import QueryFuture, ServeOverloadError
+from .scheduler import (
+    ServeScheduler,
+    estimate_query_bytes,
+    scheduler,
+    submit,
+)
+
+__all__ = [
+    "QID",
+    "BatchTemplate",
+    "QueryFuture",
+    "ServeOverloadError",
+    "ServeScheduler",
+    "Unbatchable",
+    "estimate_query_bytes",
+    "is_batchable",
+    "scheduler",
+    "stack_tables",
+    "submit",
+]

@@ -1,0 +1,108 @@
+"""QueryFuture: the handle for one in-flight served query.
+
+Lifecycle::
+
+    submit (collect_async / ServeScheduler.submit)
+        -> queued (admission-gated)
+        -> dispatched by the scheduler worker (batched or single; ZERO
+           host syncs — the result Table's count lane is still in flight)
+        -> fulfilled (this future holds the dispatched handle)
+    result()
+        -> waits for fulfillment, then performs THE one deferred
+           materialize (``Table._materialize``) in the CALLER's thread
+
+The split matters: fulfillment is sync-free, so the scheduler worker
+never blocks on the device and keeps issuing batches; the single host
+sync of each query is paid by whoever asks for the answer. graft-lint
+pins ``QueryFuture.result`` = SYNC (a 1-site budget: the audited wait
+below plus the table's amortized count fetch) and everything else on
+this class DISPATCH_SAFE.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class ServeOverloadError(RuntimeError):
+    """Admission control shed this query instead of queueing it.
+
+    Raised AT SUBMIT (never from ``result()``) when the query cannot be
+    admitted: its estimated bytes alone exceed the in-flight budget, or
+    the queue is at ``CYLON_TPU_SERVE_QUEUE_DEPTH`` and the caller asked
+    not to wait (``block=False``). The shed is counted under
+    ``serve.shed`` and sheds nothing already admitted — a loaded server
+    degrades by rejecting new work, not by OOMing the work it accepted.
+    """
+
+
+class QueryFuture:
+    """Future for a query submitted through the serving scheduler."""
+
+    __slots__ = (
+        "_event", "_table", "_error", "_wrap", "_release_cb", "t_submit",
+        "est_bytes", "hist_key", "__weakref__",
+    )
+
+    def __init__(
+        self,
+        t_submit: float,
+        est_bytes: int,
+        wrap: Optional[Callable] = None,
+    ):
+        self._event = threading.Event()
+        self._table = None
+        self._error: Optional[BaseException] = None
+        self._wrap = wrap
+        # set by the scheduler: returns this query's bytes to the
+        # admission budget (idempotent; also fired by a GC finalizer if
+        # the caller drops the future without consuming it)
+        self._release_cb: Optional[Callable] = None
+        self.t_submit = t_submit
+        self.est_bytes = int(est_bytes)
+        self.hist_key: Optional[str] = None
+
+    # -- scheduler side (sync-free) ------------------------------------
+    def _fulfill(self, table) -> None:
+        self._table = table
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    # -- caller side ----------------------------------------------------
+    def done(self) -> bool:
+        """True once the scheduler dispatched (or failed) this query —
+        the result may still be in flight on the device."""
+        return self._event.is_set()
+
+    def exception(self, timeout: Optional[float] = None):
+        """The execution error, or None. Waits for fulfillment."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("query not fulfilled within timeout")
+        return self._error
+
+    def result(self, timeout: Optional[float] = None):
+        """Wait for the dispatched result and materialize it: the single
+        deferred host sync of this query's whole lifetime, paid here in
+        the caller's thread (the scheduler worker never syncs)."""
+        # lint: sync=device -- result() IS this query's sync point: it
+        # blocks on the worker's fulfillment event and then forces the
+        # table's deferred count fetch (amortized; the detector cannot
+        # see the blocking wait)
+        if not self._event.wait(timeout):
+            raise TimeoutError("query not fulfilled within timeout")
+        if self._error is not None:
+            raise self._error
+        t = self._table
+        t._materialize()
+        # consumed: return this query's bytes to the admission budget
+        # (failure paths release in the scheduler; an unconsumed dropped
+        # future releases via its GC finalizer)
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            cb()
+        if self._wrap is not None:
+            return self._wrap(t)
+        return t

@@ -1,0 +1,534 @@
+"""The multi-query serving scheduler: admission, batching, dispatch.
+
+One scheduler per context (``scheduler(ctx)``; ``LazyFrame
+.collect_async`` routes here). Three stages, each deliberately cheap on
+the submit path:
+
+ADMISSION (caller thread, ``submit``)
+    Every query carries a bytes estimate derived from its bound input
+    tables' device buffers (capacity-based, so a deferred-count handle
+    estimates without syncing). The estimate is held against the budget
+    from admission until the query is CONSUMED — released when
+    ``QueryFuture.result()`` materializes it, when it fails, or when an
+    unconsumed future is garbage-collected — so the bound covers queued
+    work, executing batches, AND fulfilled-but-unread result buffers. A
+    query whose estimate alone exceeds
+    ``CYLON_TPU_SERVE_INFLIGHT_BYTES`` is shed with
+    :class:`~.future.ServeOverloadError`; otherwise the submitter waits
+    (backpressure) while held bytes would overflow the budget or the
+    queue sits at ``CYLON_TPU_SERVE_QUEUE_DEPTH`` (``block=False`` — or
+    any submit on a worker-less scheduler, where blocking could never
+    make progress — sheds instead of waiting). When nothing is queued or
+    executing, every held byte belongs to results only the caller (or
+    the GC) can release, so blocking would deadlock the submit-
+    everything-then-consume pattern: admission instead proceeds on soft
+    overshoot (counted ``serve.budget_overflow``) up to a HARD cap of 2x
+    the budget, beyond which it sheds. A thousand concurrent q3-shaped
+    queries therefore degrade into bounded memory (~2x budget worst
+    case) + queueing + shed-with-error, never an OOM.
+
+BATCH FORMATION (worker thread)
+    The queue head's fingerprint (``plan.lazy.gated_fingerprint`` — the
+    same identity the plan-executable cache keys on) pulls every queued
+    query with the SAME fingerprint, up to ``CYLON_TPU_SERVE_BATCH_MAX``,
+    into one group: same plan shape, different parameter bindings (the
+    Scan-stub detachment makes bindings swappable). Groups of one — or
+    unbatchable shapes — run the ordinary cached single-plan executor.
+
+EXECUTION (worker thread, sync-free)
+    Batches stack their bindings per Scan ordinal (``batch
+    .stack_tables``), run ONE device program through the
+    ``engine.serve_batch_executable`` tier (keyed ``(fingerprint,
+    pow2-B-bucket)``), split per binding, and fulfill futures with
+    deferred-count handles. The worker performs no host sync anywhere on
+    this path — every query's single sync happens in
+    ``QueryFuture.result()`` in the caller's thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable, List, Optional
+
+from .. import engine as _engine
+from ..obs import metrics as _obsmetrics
+from ..obs import trace as _obstrace
+from ..plan import lazy as _lazy
+from ..plan import lower as _plan_lower
+from ..plan import rules as _plan_rules
+from ..utils import envgate as _eg
+from ..utils.tracing import bump, gauge, span
+from . import batch as _batch
+from .future import QueryFuture, ServeOverloadError
+
+_DEFAULT_INFLIGHT_BYTES = 1 << 30  # 1 GiB
+_EST_FLOOR = 1024  # bytes; keeps zero-size queries countable in the budget
+
+
+def _knob_int(knob, default: int) -> int:
+    raw = knob.get()
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def estimate_query_bytes(tables) -> int:
+    """Admission estimate for one query: the device bytes of its bound
+    input tables (data + validity buffers, capacity-resident — correct
+    for deferred-count handles without any sync). Intermediates are
+    bounded by the same capacities, so the estimate tracks peak footprint
+    to within a small constant factor."""
+    total = 0
+    for t in tables:
+        for col in t._columns.values():
+            total += int(col.data.nbytes)
+            if col.valid is not None:
+                total += int(col.valid.nbytes)
+    return max(total, _EST_FLOOR)
+
+
+class _Lease:
+    """One admitted query's hold on the in-flight byte budget. Released
+    exactly once — by consumption (``QueryFuture.result``), failure, or
+    the dropped-future GC finalizer — whichever comes first. Deliberately
+    holds NO reference to the future, so the finalizer can fire."""
+
+    __slots__ = ("est", "released")
+
+    def __init__(self, est: int):
+        self.est = est
+        self.released = False
+
+
+class _Record:
+    """One admitted query waiting for (or in) execution."""
+
+    __slots__ = (
+        "fut", "lf", "tables", "fingerprint", "lease", "label", "batchable",
+    )
+
+    def __init__(self, fut, lf, tables, fingerprint, lease, label, batchable):
+        self.fut = fut
+        self.lf = lf
+        self.tables = tables
+        self.fingerprint = fingerprint
+        self.lease = lease
+        self.label = label
+        self.batchable = batchable
+
+
+class _BatchEntry:
+    """One compiled batched executor (cached in engine's batch tier)."""
+
+    __slots__ = ("template", "fn", "hist_key", "label")
+
+    def __init__(self, template, fn, hist_key, label):
+        self.template = template
+        self.fn = fn
+        self.hist_key = hist_key
+        self.label = label
+
+
+class ServeScheduler:
+    """Per-context serving front-end. All knobs are read per call, so
+    env flips take effect on the next submit / drain cycle."""
+
+    def __init__(self, ctx, auto_start: bool = True):
+        self._ctx = ctx
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._space = threading.Condition(self._lock)
+        self._queue: List[_Record] = []
+        self._inflight_bytes = 0
+        self._executing = 0  # groups currently being dispatched
+        self._batchable: dict = {}  # structural fingerprint -> bool
+        self._paused = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True, name="cylon-tpu-serve"
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # submit path (DISPATCH_SAFE: enqueue only, zero host syncs)
+    # ------------------------------------------------------------------
+    def submit(
+        self, lf, block: bool = True, wrap: Optional[Callable] = None
+    ) -> QueryFuture:
+        """Admit one LazyFrame query; returns its future immediately
+        (or sheds with :class:`ServeOverloadError`). Performs no
+        execution and no host sync — graft-lint pins this entry
+        DISPATCH_SAFE."""
+        plan = lf.plan
+        tables = _plan_lower.scan_tables(plan)
+        fingerprint = _lazy.gated_fingerprint(plan)
+        est = estimate_query_bytes(tables)
+        fut = QueryFuture(time.perf_counter(), est, wrap=wrap)
+        # batchability is structure-determined, i.e. a function of the
+        # fingerprint: memoize so the hot submit path skips the
+        # template-construction walk after a shape's first submission
+        batchable = self._batchable.get(fingerprint[0])
+        if batchable is None:
+            batchable = _batch.is_batchable(plan)
+        lease = _Lease(est)
+        rec = _Record(
+            fut, lf, tables, fingerprint, lease, type(plan).__name__,
+            batchable,
+        )
+        cap = _knob_int(_eg.SERVE_INFLIGHT_BYTES, _DEFAULT_INFLIGHT_BYTES)
+        depth = max(_knob_int(_eg.SERVE_QUEUE_DEPTH, 256), 1)
+        with self._lock:
+            if len(self._batchable) >= 256:
+                self._batchable.pop(next(iter(self._batchable)))
+            self._batchable[fingerprint[0]] = batchable
+            if est > cap:
+                bump("serve.shed")
+                raise ServeOverloadError(
+                    f"query estimate {est} B exceeds the in-flight budget "
+                    f"CYLON_TPU_SERVE_INFLIGHT_BYTES={cap}"
+                )
+            while not self._closed:
+                over = self._inflight_bytes + est > cap
+                if len(self._queue) < depth and not over:
+                    break
+                if not over and len(self._queue) >= depth:
+                    pass  # queue full: backpressure below
+                elif over and not (self._queue or self._executing > 0):
+                    # only unconsumed results hold bytes: blocking could
+                    # deadlock a submit-then-consume caller (nothing in
+                    # the pipeline will ever release). Soft overshoot is
+                    # allowed up to the HARD cap (2x the budget), beyond
+                    # which admission sheds — the graceful-degradation
+                    # bound: memory tops out at ~2x budget, never OOM.
+                    if self._inflight_bytes + est > 2 * cap:
+                        bump("serve.shed")
+                        raise ServeOverloadError(
+                            f"unconsumed results hold "
+                            f"{self._inflight_bytes} B (> 2x the "
+                            f"CYLON_TPU_SERVE_INFLIGHT_BYTES={cap} "
+                            "budget) and nothing queued can release "
+                            "them — consume or drop QueryFutures"
+                        )
+                    bump("serve.budget_overflow")
+                    break
+                if not block or self._thread is None:
+                    # a worker-less scheduler must never block: only
+                    # run_pending() in THIS thread could make progress
+                    bump("serve.shed")
+                    raise ServeOverloadError(
+                        f"serving at capacity (queue {len(self._queue)}, "
+                        f"in-flight {self._inflight_bytes} B) and "
+                        + ("block=False" if not block
+                           else "no worker thread (auto_start=False: "
+                           "drain with run_pending instead of blocking)")
+                    )
+                bump("serve.backpressure.wait")
+                self._space.wait()
+            if self._closed:
+                raise RuntimeError("ServeScheduler is closed")
+            self._queue.append(rec)
+            self._inflight_bytes += est
+            bump("serve.submitted")
+            gauge("serve.queue_depth", len(self._queue))
+            gauge("serve.inflight_bytes", self._inflight_bytes)
+            self._work.notify()
+        # the lease outlives dispatch: consumption (result()) releases
+        # it; a future dropped unconsumed releases via GC (the finalizer
+        # holds the lease, never the future, so collection can happen)
+        fut._release_cb = lambda: self._release(lease)
+        weakref.finalize(fut, self._release, lease)
+        return fut
+
+    # -- budget release (consumption / failure / GC) --------------------
+    def _release(self, lease: _Lease) -> None:
+        with self._lock:
+            self._release_locked(lease)
+
+    def _release_locked(self, lease: _Lease) -> None:
+        if lease.released:
+            return
+        lease.released = True
+        self._inflight_bytes -= lease.est
+        gauge("serve.inflight_bytes", self._inflight_bytes)
+        self._space.notify_all()
+
+    def _fail_rec(self, rec: _Record, error: BaseException) -> None:
+        rec.fut._fail(error)
+        self._release(rec.lease)
+
+    # ------------------------------------------------------------------
+    # drain / lifecycle
+    # ------------------------------------------------------------------
+    def run_pending(self) -> int:
+        """Synchronously execute everything currently queued, in the
+        CALLER's thread (deterministic batch formation: the whole queue
+        is visible before the first group forms). Returns the number of
+        queries executed. Tests and single-threaded batch loops use this;
+        online serving uses the worker thread."""
+        done = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return done
+                group = self._take_group_locked()
+                self._executing += 1
+            self._run_group(group)
+            done += len(group)
+            del group  # a lingering frame ref would pin futures past GC
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every admitted query has been dispatched (their
+        futures fulfilled — results may still await consumption). True on
+        success, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._queue or self._executing > 0:
+                left = None
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return False
+                if not self._space.wait(left):
+                    return False
+        return True
+
+    def close(self) -> None:
+        """Stop the worker after it finishes the queued work; subsequent
+        submits raise. A worker-less scheduler (``auto_start=False``)
+        fails anything still queued — a future must never hang on a
+        scheduler nobody will drain."""
+        with self._lock:
+            self._closed = True
+            orphans = [] if self._thread is not None else self._queue
+            if self._thread is None:
+                self._queue = []
+            for rec in orphans:
+                rec.fut._fail(RuntimeError(
+                    "ServeScheduler closed with the query still queued"
+                ))
+                self._release_locked(rec.lease)
+            self._work.notify_all()
+            self._space.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+
+    def stats(self) -> dict:
+        """Point-in-time admission state (host counters only).
+        ``inflight_bytes`` counts admitted-but-unconsumed queries —
+        queued, executing, or fulfilled with the result not yet read."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "inflight_bytes": self._inflight_bytes,
+                "executing": self._executing,
+                "closed": self._closed,
+            }
+
+    def pause(self) -> None:
+        """Freeze batch formation (submits still admit and queue). With
+        an offered backlog, ``pause() -> submit all -> resume()`` makes
+        the worker see the WHOLE queue before the first group forms, so
+        every batch fills to CYLON_TPU_SERVE_BATCH_MAX — the
+        deterministic-batching mode the benchmark and tests use; online
+        serving leaves the drain free-running and accepts whatever group
+        sizes the arrival process yields."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        """Unfreeze batch formation after :meth:`pause`."""
+        with self._lock:
+            self._paused = False
+            self._work.notify_all()
+
+    # ------------------------------------------------------------------
+    # worker side
+    # ------------------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                while not self._closed and (not self._queue or self._paused):
+                    self._work.wait()
+                if not self._queue:
+                    if self._closed:
+                        return
+                    continue
+                group = self._take_group_locked()
+                self._executing += 1
+            self._run_group(group)
+            # drop the frame's reference BEFORE parking in _work.wait():
+            # an idle worker must not pin the last group's futures, or
+            # their dropped-unconsumed GC lease release never fires
+            del group
+
+    def _take_group_locked(self) -> List[_Record]:
+        """Pop the head query plus every same-fingerprint sibling (up to
+        CYLON_TPU_SERVE_BATCH_MAX), preserving arrival order for the
+        rest. Caller holds the lock."""
+        head = self._queue[0]
+        limit = max(_knob_int(_eg.SERVE_BATCH_MAX, 16), 1)
+        group: List[_Record] = []
+        rest: List[_Record] = []
+        for rec in self._queue:
+            if (
+                len(group) < limit
+                and rec.fingerprint == head.fingerprint
+                and rec.batchable == head.batchable
+            ):
+                group.append(rec)
+            else:
+                rest.append(rec)
+        self._queue = rest
+        gauge("serve.queue_depth", len(self._queue))
+        return group
+
+    def _run_group(self, group: List[_Record]) -> None:
+        try:
+            if len(group) > 1 and group[0].batchable:
+                self._run_batch(group)
+            else:
+                for rec in group:
+                    try:
+                        self._run_single(rec)
+                    except BaseException as e:  # noqa: BLE001 - must not kill the worker
+                        self._fail_rec(rec, e)
+        except BaseException as e:  # noqa: BLE001
+            for rec in group:
+                if not rec.fut.done():
+                    self._fail_rec(rec, e)
+        finally:
+            with self._lock:
+                self._executing -= 1
+                for _ in group:
+                    bump("serve.completed")
+                # fulfilled queries keep their byte lease until the
+                # caller consumes (or drops) the result; waiters still
+                # re-check here because the pipeline emptying is itself
+                # an admission condition (the liveness carve-out)
+                self._space.notify_all()
+
+    def _run_single(self, rec: _Record) -> None:
+        """One query, the ordinary cached single-plan executor — still
+        fully async: dispatch without the count sync, the future holds a
+        deferred handle."""
+        with _obstrace.query_trace(rec.label, kind="serve"):
+            tables, fingerprint, entry, hit = rec.lf._executable()
+            with span("plan.execute"):
+                out = entry.fn(rec.tables)
+            _obstrace.attach_result(
+                out, hist_key=entry.hist_key, label=rec.label,
+                t0=rec.fut.t_submit,
+            )
+            rec.fut.hist_key = entry.hist_key
+            bump("serve.singles")
+            rec.fut._fulfill(out)
+
+    def _run_batch(self, group: List[_Record]) -> None:
+        """B same-fingerprint bindings as ONE stacked device program:
+        stack per Scan ordinal, execute the cached batched executor,
+        split per binding — zero host syncs end to end."""
+        ctx = self._ctx
+        b = len(group)
+        bucket = 1 << (b - 1).bit_length()
+        head = group[0]
+        # re-assign Scan ordinals BEFORE keying: live Scans are shared
+        # with the user's LazyFrame and a concurrent collect of another
+        # plan sharing one could have renumbered them since submit —
+        # Scan._params (hence the fingerprint below AND the template's
+        # frozen stub ordinals) must see the deterministic DFS assignment
+        # rec.tables was captured under
+        _plan_lower.scan_tables(head.lf.plan)
+        # DRAIN-time fingerprint, deliberately not rec.fingerprint: the
+        # executor compiles under the gate state in force NOW, and a
+        # serial collect racing this batch keys its plan-cache entry (and
+        # histogram) the same way — submit-time fingerprints are only the
+        # grouping identity. (Also the L1 carrier: the gate reads reached
+        # from this key-builder are threaded through gated_fingerprint.)
+        orig_fp = _lazy.gated_fingerprint(head.lf.plan)
+        key = orig_fp + ("serve_batch", bucket)
+
+        def compile_batch():
+            template = _batch.build_batched_template(
+                head.lf.plan, len(head.tables)
+            )
+            with span("plan.optimize"):
+                opt, fired = _plan_rules.optimize(
+                    template.root, ctx.world_size
+                )
+            with span("plan.lower"):
+                fn = _plan_lower.build_executor(opt)
+            # per-query latency samples land in the ORIGINAL plan shape's
+            # histogram: batched and serial collects of one fingerprint
+            # share a distribution (hashed once, at compile time)
+            return _BatchEntry(
+                template, fn, _obsmetrics.fingerprint_key(orig_fp),
+                opt.label(),
+            )
+
+        entry, hit = _engine.serve_batch_executable(ctx, key, compile_batch)
+        with _obstrace.query_trace(entry.label, kind="serve") as q:
+            stacked = [
+                _batch.stack_tables(
+                    ctx, [rec.tables[s] for rec in group], bucket
+                )
+                for s in range(len(head.tables))
+            ]
+            with span("plan.execute"):
+                out = entry.fn(stacked)
+            if q is not None:
+                q.hist_key = entry.hist_key
+                q.attrs["serve.batch_b"] = b
+                q.attrs["serve.batch_bucket"] = bucket
+            # charge the split's transient burst (each slice holds the
+            # full stacked capacity until its materialize-time
+            # compaction) to the queries' admission leases, so admission
+            # sees the batch's real footprint, not just its inputs
+            surcharge = _batch.split_bytes_estimate(out, entry.template)
+            with self._lock:
+                for rec in group:
+                    if not rec.lease.released:
+                        rec.lease.est += surcharge
+                        self._inflight_bytes += surcharge
+                gauge("serve.inflight_bytes", self._inflight_bytes)
+            slices = _batch.split_batch(out, entry.template, b, bucket)
+            for rec, sliced in zip(group, slices):
+                _obstrace.attach_result(
+                    sliced, hist_key=entry.hist_key, label=rec.label,
+                    t0=rec.fut.t_submit,
+                )
+                rec.fut.hist_key = entry.hist_key
+                rec.fut._fulfill(sliced)
+        gauge("serve.batch_occupancy", b / bucket)
+        bump("serve.batches", rows=b)
+
+
+# ----------------------------------------------------------------------
+# the per-context scheduler + module-level submit funnel
+# ----------------------------------------------------------------------
+def scheduler(ctx) -> ServeScheduler:
+    """The context's shared scheduler, created (with its worker thread)
+    on first use. A closed scheduler is replaced on the next call — one
+    workload's ``close()`` must not poison the context's serving surface
+    forever."""
+    s = ctx.__dict__.get("_serve_sched")
+    if s is not None and not s._closed:
+        return s
+    with _engine.cache_lock(ctx):
+        s = ctx.__dict__.get("_serve_sched")
+        if s is None or s._closed:
+            s = ServeScheduler(ctx)
+            ctx.__dict__["_serve_sched"] = s
+    return s
+
+
+def submit(
+    lf, block: bool = True, wrap: Optional[Callable] = None
+) -> QueryFuture:
+    """Submit a LazyFrame to its context's shared scheduler (the
+    ``collect_async`` funnel)."""
+    return scheduler(lf._ctx).submit(lf, block=block, wrap=wrap)

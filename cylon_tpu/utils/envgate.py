@@ -202,6 +202,38 @@ SKETCH_BITS = EnvKnob(
     note="semi-join sketch bit cap (config.py)",
 )
 
+# -- query serving (cylon_tpu/serve) -----------------------------------
+# All three are host-resolved admission/batching knobs read per call in
+# the scheduler (flips take effect on the next submit/drain cycle); none
+# is ever read at trace time. BATCH_MAX is the only one that reaches
+# compiled programs at all — through the batch size, which lands in both
+# the stacked operand shapes (jit shape specialization) and the
+# (fingerprint, B-bucket) batched-executor cache key.
+SERVE_INFLIGHT_BYTES = EnvKnob(
+    "CYLON_TPU_SERVE_INFLIGHT_BYTES", "", kind="tuning",
+    keyed_via="admission control only: bounds the estimated bytes of "
+    "admitted-but-unCONSUMED queries (leases released at result "
+    "materialization / failure / future GC); never reaches a compiled "
+    "program",
+    note="serving in-flight byte budget (default 1 GiB); a single query "
+    "estimated above it is shed with ServeOverloadError",
+)
+SERVE_BATCH_MAX = EnvKnob(
+    "CYLON_TPU_SERVE_BATCH_MAX", "16", kind="tuning",
+    keyed_via="batch size -> pow2 B bucket -> the (fingerprint, B) "
+    "serve_batch_executable cache key + stacked operand shapes (jit "
+    "shape specialization)",
+    note="max same-fingerprint bindings fused into one stacked device "
+    "program (pow2-bucketed; 1 disables batching, keeping async submit)",
+)
+SERVE_QUEUE_DEPTH = EnvKnob(
+    "CYLON_TPU_SERVE_QUEUE_DEPTH", "256", kind="tuning",
+    keyed_via="host-side admission only: bounds the pending-query queue; "
+    "never reaches a compiled program",
+    note="pending-query cap per scheduler: a full queue backpressures "
+    "blocking submitters and sheds nowait submitters",
+)
+
 # -- import/init-time configuration ------------------------------------
 NO_X64 = EnvKnob(
     "CYLON_TPU_NO_X64", "", kind="startup",

@@ -808,6 +808,79 @@ def packing_round_once(seed) -> bool:
     return ok
 
 
+def serve_round_once(seed) -> bool:
+    """Serving-batch oracle round (ISSUE 9): a random set of
+    same-fingerprint parameter bindings (random per-binding sizes, shared
+    random shape/dtype/null density/world/batch cap) executed through the
+    ServeScheduler's stacked batch program and checked binding-by-binding
+    against the serial ``collect()`` oracle. Payload values are
+    integer-valued f32 so the batch's different reduction order cannot
+    perturb sums — the oracle stays exact equality."""
+    from cylon_tpu import col
+    from cylon_tpu.serve import ServeScheduler
+
+    rng = np.random.default_rng(seed)
+    nb = int(rng.integers(2, 9))
+    keyspace = int(rng.integers(1, 40))
+    dtype = str(rng.choice(["int32", "int64", "string"]))
+    null_p = float(rng.choice([0.0, 0.15]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    how = str(rng.choice(["inner", "left", "right"]))
+    filt = bool(rng.integers(0, 2))
+    tail = str(rng.choice(["groupby", "sort", "project"]))
+    agg_op = str(rng.choice(["sum", "min", "max", "count", "mean"]))
+    batch_max = int(rng.choice([2, 4, 8, 16]))
+    params = dict(seed=seed, profile="serve", nb=nb, keyspace=keyspace,
+                  dtype=dtype, null_p=null_p, world=world, how=how,
+                  filt=filt, tail=tail, agg=agg_op, batch_max=batch_max)
+    ctx = ctx_for(world)
+
+    def binding_frames():
+        n_l = int(rng.integers(2, MAX_N))
+        n_r = int(rng.integers(2, MAX_N))
+        ldf = rand_frame(rng, n_l, keyspace, dtype, null_p, "v")
+        rdf = rand_frame(rng, n_r, keyspace, dtype, null_p, "w").rename(
+            columns={"k": "rk"})
+        ldf["v"] = rng.integers(-50, 50, n_l).astype(np.float32)
+        rdf["w"] = rng.integers(-50, 50, n_r).astype(np.float32)
+        return ldf, rdf
+
+    def build(lt, rt):
+        lazy = lt.lazy().join(rt.lazy(), left_on="k", right_on="rk", how=how)
+        if filt:
+            lazy = lazy.filter(col("v") > 0.0)
+        if tail == "groupby":
+            return lazy.groupby("k", {"v": agg_op})
+        if tail == "sort":
+            return lazy.sort("k")
+        return lazy.select(["k", "v"])
+
+    plans = []
+    for _ in range(nb):
+        ldf, rdf = binding_frames()
+        plans.append(build(
+            ct.Table.from_pandas(ctx, ldf), ct.Table.from_pandas(ctx, rdf)
+        ))
+    oracle = [p.collect().to_pandas() for p in plans]
+
+    prev = os.environ.get("CYLON_TPU_SERVE_BATCH_MAX")
+    os.environ["CYLON_TPU_SERVE_BATCH_MAX"] = str(batch_max)
+    try:
+        sched = ServeScheduler(ctx, auto_start=False)
+        futs = [sched.submit(p) for p in plans]
+        sched.run_pending()
+        got = [f.result(timeout=300).to_pandas() for f in futs]
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_SERVE_BATCH_MAX", None)
+        else:
+            os.environ["CYLON_TPU_SERVE_BATCH_MAX"] = prev
+    ok = True
+    for i, (g, w) in enumerate(zip(got, oracle)):
+        ok &= check(g, w, f"serve/{how}/{tail}[{i}/{nb}]", params)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -817,7 +890,7 @@ def main():
                          "respill/overflow/capacity-retry paths)")
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
-                             "ordering", "semi", "packing"],
+                             "ordering", "semi", "packing", "serve"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -830,7 +903,10 @@ def main():
                          "with CYLON_TPU_NO_ORDERING=1; 'semi': semi-join "
                          "sketch filter (random selectivity / dtype / "
                          "sketch bits / world) vs the "
-                         "CYLON_TPU_NO_SEMI_FILTER=1 oracle")
+                         "CYLON_TPU_NO_SEMI_FILTER=1 oracle; 'serve': "
+                         "random binding sets / batch sizes through the "
+                         "stacked serving batch path vs the serial "
+                         "collect() oracle")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -838,7 +914,8 @@ def main():
           "shuffle": shuffle_round_once,
           "ordering": ordering_round_once,
           "semi": semi_round_once,
-          "packing": packing_round_once}.get(args.profile, round_once)
+          "packing": packing_round_once,
+          "serve": serve_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
@@ -863,6 +940,7 @@ def main():
         if rounds % (3 if args.profile == "skew" else 10) == 0:
             for c in CTXS.values():
                 c.__dict__.get("_plan_cache", {}).clear()
+                c.__dict__.get("_serve_batch_cache", {}).clear()
             import jax
 
             jax.clear_caches()

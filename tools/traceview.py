@@ -7,6 +7,11 @@ visual timeline; this tool is the terminal summary for the same file::
     python -m tools.traceview trace.json            # per-query summary
     python -m tools.traceview trace.json --tree     # span trees
     python -m tools.traceview trace.json --top 10   # widen the hot list
+    python -m tools.traceview trace.json --serving  # per-fingerprint
+        # serving rollup: a flight ring dumped from a LOADED server holds
+        # hundreds of near-identical query tracks — this groups them by
+        # plan fingerprint and shows counts, wall quantiles, batch
+        # occupancy and the serve.* admission counters instead
 
 Produce a file with ``CYLON_TPU_TRACE_EXPORT=trace.json`` (written at
 interpreter exit) or programmatically via
@@ -52,12 +57,67 @@ def _print_tree(events, tid) -> None:
         stack.append(ts + dur)
 
 
+def _pct(vals, q: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return vals[min(int(q * len(vals)), len(vals) - 1)]
+
+
+def _print_serving(tracks) -> None:
+    """Per-fingerprint rollup of a loaded server's ring: query counts,
+    wall quantiles, batch occupancy and the serve.* counters."""
+    groups = {}
+    for t in tracks.values():
+        qargs = t.get("args", {})
+        fp = qargs.get("fingerprint") or "(no fingerprint)"
+        g = groups.setdefault(
+            fp, {"n": 0, "walls": [], "kinds": {}, "b": [], "ctrs": {}}
+        )
+        g["n"] += 1
+        g["walls"].append(t["query_ms"])
+        kind = qargs.get("kind", "?")
+        g["kinds"][kind] = g["kinds"].get(kind, 0) + 1
+        if "serve.batch_b" in qargs:
+            g["b"].append(
+                (qargs["serve.batch_b"], qargs.get("serve.batch_bucket"))
+            )
+        for k, v in qargs.items():
+            if k.startswith("ctr:serve."):
+                n = v[0] if isinstance(v, list) else v
+                g["ctrs"][k[4:]] = g["ctrs"].get(k[4:], 0) + n
+    print(f"serving summary: {len(groups)} plan shape(s)")
+    for fp, g in sorted(groups.items(), key=lambda kv: -kv[1]["n"]):
+        kinds = ", ".join(f"{k} x{v}" for k, v in sorted(g["kinds"].items()))
+        print(
+            f"\n  fingerprint {fp}: {g['n']} trace(s) [{kinds}]  wall "
+            f"p50 {_pct(g['walls'], 0.50):.2f} ms  "
+            f"p99 {_pct(g['walls'], 0.99):.2f} ms  "
+            f"max {max(g['walls']):.2f} ms"
+        )
+        if g["b"]:
+            occ = [b / bucket for b, bucket in g["b"] if bucket]
+            bs = ", ".join(f"{b}/{bucket}" for b, bucket in g["b"][:8])
+            more = " ..." if len(g["b"]) > 8 else ""
+            mean_occ = sum(occ) / len(occ) if occ else 0.0
+            print(
+                f"    batches: {len(g['b'])} (B/bucket: {bs}{more}), "
+                f"mean occupancy {mean_occ:.2f}"
+            )
+        for k, v in sorted(g["ctrs"].items()):
+            print(f"    {k}: {v}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", help="Chrome trace JSON (obs.write_chrome)")
     ap.add_argument("--tree", action="store_true", help="print span trees")
     ap.add_argument("--top", type=int, default=5,
                     help="hottest span names per query (default 5)")
+    ap.add_argument("--serving", action="store_true",
+                    help="aggregate by plan fingerprint (loaded-server "
+                    "rings: counts, wall quantiles, batch occupancy, "
+                    "serve.* counters)")
     args = ap.parse_args(argv)
 
     from cylon_tpu.obs import export as ex
@@ -71,6 +131,9 @@ def main(argv=None) -> int:
     tracks = ex.summarize(doc)
     if not tracks:
         print("(no traces)")
+        return 0
+    if args.serving:
+        _print_serving(tracks)
         return 0
     print(f"{len(tracks)} query trace(s) in {args.trace}")
     for tid in sorted(tracks):
