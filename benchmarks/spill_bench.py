@@ -1,0 +1,249 @@
+"""Spill-tier + skew-split benchmark and the CI ``spill-smoke`` gates.
+
+Measures the unified budget-driven round planner (ISSUE 10) on a virtual
+8-device CPU mesh and, under ``--smoke``, exits 1 unless both acceptance
+gates hold:
+
+gate (a) — skew bytes
+    A one-hot-skew 8-way shuffle under the skew-adaptive schedule must
+    ship >= GATE (default 40%) fewer bytes than the padded plan
+    (``CYLON_TPU_NO_SKEW_SPLIT=1`` oracle). "Shipped" charges the
+    adaptive plan for BOTH its collective rounds and its host-relay
+    tail (``shuffle.exchanged_bytes`` + ``shuffle.spill.relay_bytes``),
+    while the padded oracle is charged its collective rounds only — the
+    reduction is net of the relay's cost. Outputs must be identical.
+
+gate (b) — tier-1 join under budget
+    A distributed join FORCED through tier 1 whose inputs exceed the
+    per-shard staged-output budget must (1) stream its rounds through
+    the host arenas (``shuffle.spill.staged_rounds``), (2) keep the
+    engine's peak-device accounting strictly below the tier-0 run of the
+    same join AND below the staged bytes a tier-0 run would have held,
+    and (3) match the in-core oracle's rows exactly.
+
+Usage:
+  python benchmarks/spill_bench.py --rows 40000 --smoke
+  python benchmarks/spill_bench.py --rows 1000000        # report only
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+DEVICES = ge._force_cpu_mesh(8)
+
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import shuffle as _sh
+from cylon_tpu.utils.tracing import report, reset_trace
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    prev = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, p in prev.items():
+            if p is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = p
+
+
+def _counter_rows(r, name):
+    return int(r[name]["rows"]) if name in r else 0
+
+
+def bench_skew(ctx, rows):
+    """gate (a): one-hot shuffle, adaptive vs padded-plan oracle."""
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": np.zeros(rows, np.int32),
+         "v": np.arange(rows, dtype=np.float32)},
+    )
+
+    def run(padded):
+        reset_trace()
+        cm = (
+            _env(CYLON_TPU_NO_SKEW_SPLIT=1)
+            if padded
+            else contextlib.nullcontext()
+        )
+        with cm:
+            t0 = time.perf_counter()
+            s = t.shuffle(["k"])
+            got = np.sort(s.to_pandas()["v"].to_numpy())
+            dt = time.perf_counter() - t0
+        r = report("shuffle.")
+        shipped = _counter_rows(r, "shuffle.exchanged_bytes") + _counter_rows(
+            r, "shuffle.spill.relay_bytes"
+        )
+        return {
+            "shipped_bytes": shipped,
+            "relay_rows": _counter_rows(r, "shuffle.skew_split"),
+            "rounds": _counter_rows(r, "shuffle.rounds"),
+            "wall_s": round(dt, 4),
+            "_content": got,
+        }
+
+    padded = run(padded=True)
+    adaptive = run(padded=False)
+    equal = np.array_equal(padded.pop("_content"), adaptive.pop("_content"))
+    reduction = 1.0 - adaptive["shipped_bytes"] / max(
+        padded["shipped_bytes"], 1
+    )
+    return {
+        "benchmark": "one_hot_skew_shuffle",
+        "rows": rows,
+        "world": ctx.world_size,
+        "padded": padded,
+        "adaptive": adaptive,
+        "bytes_reduction": round(reduction, 4),
+        "outputs_equal": bool(equal),
+    }
+
+
+def bench_tier1_join(ctx, rows):
+    """gate (b): forced tier-1 join vs the in-core oracle. The device
+    byte budget is set at 75% of the MEASURED in-core peak — i.e. the
+    inputs (whose staged exchange output the tier-0 engine holds
+    device-resident in full) exceed it by construction — and the spilled
+    run's peak accounting must land back under it."""
+    rng = np.random.default_rng(42)
+    data = {
+        "k": rng.integers(0, rows, rows).astype(np.int32),
+        "v": rng.normal(size=rows).astype(np.float32),
+    }
+    rdata = {
+        "k": rng.integers(0, rows, rows).astype(np.int32),
+        "w": rng.normal(size=rows).astype(np.float32),
+    }
+    lt = ct.Table.from_pydict(ctx, data)
+    rt = ct.Table.from_pydict(ctx, rdata)
+    # a shuffle budget several times under the table forces real chunking
+    row_bytes = _sh.exchange_row_bytes(lt._flat_cols())
+    budget = _sh.budget_for_rounds(
+        max(rows // (ctx.world_size ** 2), 64), 16, ctx.world_size, row_bytes
+    )
+
+    def run(tier):
+        reset_trace()
+        env = {"CYLON_TPU_SHUFFLE_BUDGET": budget}
+        if tier == 1:
+            env["CYLON_TPU_SPILL_TIER"] = 1
+        with _env(**env):
+            t0 = time.perf_counter()
+            out = lt.distributed_join(rt, on="k", how="inner")
+            n = out.row_count
+            dt = time.perf_counter() - t0
+        r = report("shuffle.")
+        return {
+            "rows_out": int(n),
+            "rounds": _counter_rows(r, "shuffle.rounds"),
+            "staged_rounds": (
+                int(r["shuffle.spill.staged_rounds"]["count"])
+                if "shuffle.spill.staged_rounds" in r
+                else 0
+            ),
+            "peak_device_bytes": int(
+                r["shuffle.spill.peak_device_bytes"]["max_s"]
+            ),
+            "wall_s": round(dt, 4),
+        }
+
+    in_core = run(tier=0)
+    device_budget = int(0.75 * in_core["peak_device_bytes"])
+    spilled = run(tier=1)
+    expect = len(
+        pd.DataFrame(data).merge(pd.DataFrame(rdata), on="k", how="inner")
+    )
+    return {
+        "benchmark": "tier1_join_under_budget",
+        "rows": rows,
+        "world": ctx.world_size,
+        "device_budget_bytes": device_budget,
+        "in_core": in_core,
+        "tier1": spilled,
+        "oracle_rows": expect,
+    }
+
+
+def run(rows, smoke, gate):
+    ctx = ct.CylonContext.init_distributed(ct.TPUConfig(devices=DEVICES[:8]))
+    skew = bench_skew(ctx, rows)
+    join = bench_tier1_join(ctx, max(rows // 2, 4096) // 2048 * 2048)
+    out = {"skew": skew, "tier1_join": join}
+    print(json.dumps(out, indent=2))
+    if not smoke:
+        return 0
+    failures = []
+    if not skew["outputs_equal"]:
+        failures.append("skew-split output differs from the padded oracle")
+    if skew["adaptive"]["relay_rows"] <= 0:
+        failures.append("skew split never engaged on the one-hot profile")
+    if skew["bytes_reduction"] < gate:
+        failures.append(
+            f"one-hot shipped-bytes reduction {skew['bytes_reduction']:.2%}"
+            f" < gate {gate:.0%}"
+        )
+    j = join
+    if j["tier1"]["rows_out"] != j["oracle_rows"] or (
+        j["in_core"]["rows_out"] != j["oracle_rows"]
+    ):
+        failures.append(
+            f"tier-1 join rows {j['tier1']['rows_out']} != oracle "
+            f"{j['oracle_rows']}"
+        )
+    if j["tier1"]["staged_rounds"] <= 0:
+        failures.append("tier-1 join never staged a round through the arena")
+    if j["tier1"]["peak_device_bytes"] > j["device_budget_bytes"]:
+        failures.append(
+            "tier-1 peak device accounting "
+            f"{j['tier1']['peak_device_bytes']} exceeds the device budget "
+            f"{j['device_budget_bytes']} (in-core peak "
+            f"{j['in_core']['peak_device_bytes']})"
+        )
+    for f in failures:
+        print(f"SPILL GATE FAIL: {f}", file=sys.stderr)
+    print(
+        "spill-smoke: "
+        + ("FAIL" if failures else "PASS")
+        + f" (one-hot bytes -{skew['bytes_reduction']:.0%}, tier-1 peak "
+        f"{j['tier1']['peak_device_bytes']} vs in-core "
+        f"{j['in_core']['peak_device_bytes']} bytes)"
+    )
+    return 1 if failures else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40000)
+    ap.add_argument("--smoke", action="store_true",
+                    help="apply the CI gates; exit 1 on regression")
+    ap.add_argument("--gate", type=float,
+                    default=float(os.environ.get("SPILL_SKEW_GATE", 0.40)),
+                    help="minimum one-hot shipped-bytes reduction")
+    args = ap.parse_args()
+    sys.exit(run(args.rows, args.smoke, args.gate))
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,19 @@ def fused_q3_collectives(respill: int, num_slices: int = 1) -> int:
 #: ``_shuffle_many``, and K-independent by construction
 SHUFFLE_HOST_SYNCS_PER_TABLE = 2
 
+#: a SPILLED shuffle (tier >= 1, parallel/spill.py) adds exactly one
+#: staging fetch per round on top of SHUFFLE_HOST_SYNCS_PER_TABLE — the
+#: round's compacted output crossing into the host arena. This is the
+#: ONE sanctioned K-DEPENDENT sync family: spilling trades syncs for
+#: device memory by design, and the budget below pins the trade to the
+#: spill module's owned sites so the in-HBM round loop stays sync-free.
+SPILL_STAGE_HOST_SYNCS_PER_ROUND = 1
+
+#: a skew-split schedule (spill.plan_schedule with a relay) adds exactly
+#: ONE relay fetch per shuffle, K-independent — the heavy-bucket tails
+#: ride a single extraction program and one host crossing
+SKEW_RELAY_HOST_SYNCS = 1
+
 #: the functions allowed to fetch during a shuffle: the whitelisted
 #: deferred count fetch, plus the up-front materialization of a deferred-
 #: count INPUT (applies the pending overshoot compaction before the pack
@@ -213,7 +226,6 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
         "stats fetch + the pallas_pk stats fetch — each a packed single "
         "fetch; the emit phases reuse the probe counts",
     ),
-    "Table.bucket_pack": SyncBudget(1, note="bucket-count fetch"),
     "Table._fused_join": SyncBudget(1, note="fused-step stats fetch"),
     "table._shuffle_many": SyncBudget(
         2,
@@ -222,6 +234,26 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     ),
     "task.task_partition": SyncBudget(
         1, note="ONE sort+count fetch covers all T task splits"
+    ),
+    # the spill tiers (parallel/spill.py): staging and relay fetches are
+    # owned HERE, not by _shuffle_many — the in-HBM round loop keeps its
+    # 2-site budget and the spill module polices the sanctioned
+    # K-dependent staging syncs (SPILL_STAGE_HOST_SYNCS_PER_ROUND)
+    "spill.stage_table": SyncBudget(
+        2,
+        note="one packed lane-matrix fetch + one f64-passthrough fetch "
+        "per staged round (the spill-aware lane codec: 2 transfers for "
+        "ALL columns, not one per column)",
+    ),
+    "spill.fetch_relay": SyncBudget(
+        2,
+        note="the ONE skew-relay crossing per shuffle: packed lane "
+        "matrix + f64 passthroughs of every over-quota row",
+    ),
+    "spill.shards_to_table": SyncBudget(
+        2,
+        note="restaging host rows onto the mesh: from_encoded_shards' "
+        "per-shard device_put barriers (data + validity)",
     ),
     # the telemetry layer (ISSUE 8): observability must NEVER sync. The
     # span/bump/gauge surface, the deferred-timing resolution hook that
@@ -356,7 +388,6 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     "Table.add_suffix": "DISPATCH_SAFE",
     "Table.applymap": "SYNC",
     "Table.astype": "SYNC",
-    "Table.bucket_pack": "SYNC",
     "Table.build_index": "DISPATCH_SAFE",
     "Table.clear": "MATERIALIZE",
     "Table.column": "DISPATCH_SAFE",

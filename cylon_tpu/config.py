@@ -46,6 +46,22 @@ def shuffle_byte_budget(configured: Optional[object] = None) -> int:
 
 
 # ----------------------------------------------------------------------
+# spill tiers (parallel/spill.py; table._shuffle_many)
+# ----------------------------------------------------------------------
+# The unified spill-tiered round planner extends the byte budget above
+# with two more policy knobs, both resolved per shuffle from the measured
+# per-bucket counts: CYLON_TPU_SPILL_DEVICE_BUDGET (per-shard staged
+# bytes above which rounds stream into host arenas instead of staying
+# device-resident — unset keeps today's in-HBM behavior) and
+# CYLON_TPU_SPILL_HOST_BUDGET (live host-arena bytes above which arena
+# growth promotes to disk-backed memmaps under CYLON_TPU_SPILL_DIR).
+# CYLON_TPU_SPILL_TIER forces a tier for tests/differentials and
+# CYLON_TPU_NO_SKEW_SPLIT=1 disables skew-adaptive round splitting (the
+# padded-plan oracle). Resolvers live in parallel/spill.py beside their
+# consumer — this comment is the config map's pointer to them.
+
+
+# ----------------------------------------------------------------------
 # semi-join sketch filter (ops/sketch.py; table._shuffle_pair)
 # ----------------------------------------------------------------------
 # Cap on the blocked-Bloom size of ONE semi-join key sketch, in bits.

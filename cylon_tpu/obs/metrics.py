@@ -223,7 +223,6 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "host_sync": ("counter", "device->host count fetches (the sync census)"),
     "sort": ("span", "local sort dispatch"),
     "unique": ("span", "local unique dispatch"),
-    "bucket_pack": ("span", "hash-bucket pack kernel"),
     "stats.measure": ("span", "on-demand column range-stats kernel"),
     "join.": ("span", "join phases: speculative/fused/pallas_pk/sum_pushdown"),
     "setop.": ("span", "union/subtract/intersect dispatch"),
@@ -234,6 +233,17 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "shuffle.rounds": ("counter", "round count K per shuffle (rows=K)"),
     "shuffle.overlap_efficiency": (
         "gauge", "fraction of exchange wall spent issuing overlapped work"),
+    "shuffle.exchanged_bytes": (
+        "counter", "global collective payload bytes per shuffle (rows="
+        "K x world^2 x cap x effective row bytes)"),
+    "shuffle.skew_split": (
+        "counter", "skew-adaptive schedules applied (rows=heavy-bucket "
+        "tail rows relayed through the host instead of padded rounds)"),
+    "shuffle.spill.": (
+        "mixed", "spill tiers (parallel/spill.py): tier/peak_device_bytes/"
+        "host_bytes gauges; shuffles/staged_rounds/staged_bytes/"
+        "relay_bytes/tier2_promotions/ooc_joins counters; stage/ooc_* "
+        "spans"),
     "shuffle.semi_filter.": (
         "mixed", "semi-join gate: selectivity gauge, applied/gate_skipped/"
         "pruned_rows counters, sketch span"),

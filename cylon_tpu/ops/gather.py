@@ -371,6 +371,59 @@ def wire_unpack_cols(
     return out
 
 
+# ----------------------------------------------------------------------
+# spill-aware HOST lane codec (parallel/spill.py)
+#
+# Spilled shuffle rounds and skew-relay tails leave the device as the
+# ALREADY-PACKED [rows, L] int32 lane matrix — one transfer for every
+# int32-lane column (+ one per f64 passthrough) — and decode on the host
+# with these numpy mirrors of the device codec, instead of paying one
+# device round-trip per column. The encodings are bit-identical to
+# :func:`_from_lanes`, so a spilled row restages losslessly.
+# ----------------------------------------------------------------------
+
+def np_from_lanes(lanes: List[np.ndarray], tag: str) -> np.ndarray:
+    """numpy mirror of :func:`_from_lanes`: int32 host lanes -> physical
+    column values. Lanes must be contiguous (callers slice with
+    ``np.ascontiguousarray``) so the 32-bit bitcasts are pure views."""
+    if tag == "bool":
+        return lanes[0].astype(np.bool_)
+    if tag in ("float16", "bfloat16"):
+        f32 = lanes[0].view(np.float32)
+        out_dt = np.float16 if tag == "float16" else jnp.bfloat16
+        return f32.astype(out_dt)
+    dt = np.dtype(tag)
+    if dt.itemsize == 4:
+        return lanes[0] if tag == "int32" else lanes[0].view(dt)
+    if dt.itemsize < 4:
+        return lanes[0].astype(dt)
+    hi = lanes[0].view(np.uint32).astype(np.uint64)
+    lo = lanes[1].view(np.uint32).astype(np.uint64)
+    u = (hi << np.uint64(32)) | lo
+    return u.view(dt) if dt.kind in ("i", "u") else u.astype(dt)
+
+
+def host_unpack_cols(plan, lane_cols, handle_passthrough):
+    """Host twin of :func:`unpack_cols` over fetched numpy lanes:
+    ``lane_cols`` are contiguous int32 arrays in plan order;
+    ``handle_passthrough(ci)`` supplies an f64 column's fetched data.
+    Returns [(data, valid-or-None)] in physical encoding."""
+    out = []
+    pos = 0
+    for ci, (tag, nl, has_valid) in enumerate(plan):
+        if tag is None:
+            data = handle_passthrough(ci)
+        else:
+            data = np_from_lanes(lane_cols[pos : pos + nl], tag)
+            pos += nl
+        valid = None
+        if has_valid:
+            valid = lane_cols[pos].astype(np.bool_)
+            pos += 1
+        out.append((data, valid))
+    return out
+
+
 def pack_gather(
     cols: Sequence[KeyCol],
     idx: jax.Array,

@@ -1,264 +1,244 @@
-"""Out-of-core (beyond-HBM) streaming join over the op-DAG.
+"""Out-of-core join: a thin wrapper over the unified spill-tiered shuffle.
 
 Reference analog: the byte-chunked streaming shuffle
 (arrow/arrow_all_to_all.cpp:83-141) exists precisely so tables larger than
-one node's memory can move through fixed-size buffers, and the streaming
-DisJoinOP graph (ops/dis_join_op.cpp:26-71) rides it. XLA programs are
-static-shaped and HBM-resident, so the TPU-native equivalent restructures
-the problem instead of streaming bytes: a **Grace-style partitioned join**.
+one node's memory can move through fixed-size buffers. This module used to
+carry its own Grace-style spill rounds (bucket_pack + hand-sliced host
+arenas + a private dag) that saw none of the chunked engine's header
+fusion, byte budgets, lane packing or skew splitting. Per Exoshuffle
+(PAPERS.md) — and ROADMAP item 2 — spill is POLICY of the one shuffle
+composition, not a second engine, so the join is now three thin pieces
+over ``parallel/spill.py``:
 
-- Each host-staged input chunk is hash-partitioned into K buckets ON DEVICE
-  (vectorized murmur3 — the same family every shuffle uses, so bucket
-  assignment is consistent across chunks and across the two inputs);
-- buckets spill back to the HOST arena immediately (chunk-sized device
-  footprint);
-- after both streams drain, bucket i of the left joins bucket i of the
-  right (equal hash => co-partitioned), at most TWO bucket pairs
-  device-resident at a time (the next pair's uploads are dispatched while
-  the current join blocks on its count fetch), each bucket-join running
-  as a normal mesh-distributed join;
-- results leave the device through a chunked host sink, never concatenated
-  on device.
+ingest
+    Each host-staged chunk is uploaded, stamped with a rider sub-bucket
+    lane (high murmur bits, the same family every shuffle uses — bucket
+    assignment is consistent across chunks and across the two inputs),
+    and pushed through the SAME ``_shuffle_many`` engine with a
+    :class:`_BucketSink`: rows hash-route to their owner shard through
+    the chunked, header-fused, budget-bounded rounds (inheriting lane
+    packing and skew-adaptive splitting for free) and each received
+    round streams into per-(bucket, shard) host arenas. Device footprint
+    per chunk: the chunk plus the engine's bounded round buffers.
+join
+    After both streams drain, bucket b of the left joins bucket b of the
+    right (equal hash => co-partitioned, and already shard-co-located by
+    the ingest shuffle, so the bucket join's own exchange moves ~nothing).
+    One-ahead staging + a bounded drain thread double-buffer the phase:
+    at most two bucket pairs + two undrained results device-resident.
+sink
+    Results leave the device through the spill-aware lane fetch into ONE
+    preallocated :class:`~cylon_tpu.parallel.spill.HostArena` sized from
+    each result's already-known counts — no per-bucket host concat, and
+    peak host bytes ride the ``shuffle.spill.host_bytes`` gauge.
 
-Device memory is bounded by max(chunk, 2 x bucket-pair + 1 result table
-+ join intermediates), never by table size: with K buckets a table of N
-rows needs ~4N/K input device rows (+ one bucket-join's output) at the
-join stage, so any table fits by raising K. Result tables do NOT
-accumulate: each bucket's result is drained to the host sink before the
-next join.
+Device memory is bounded by max(chunk + round buffers, one bucket pair +
+its result), never by table size: with K buckets a table of N rows needs
+~2N/K device rows at the join stage, so any table fits by raising K.
 """
 from __future__ import annotations
 
 import concurrent.futures
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
-from ..table import Table
-from .dag import Op, RootOp, RoundRobinExecution
+from ..column import Column
+from ..dtypes import DataType, Type
+from ..engine import get_kernel
+from ..ops import partition as _p
+from ..table import Table, _ShuffleSpec, _shuffle_many
+from ..utils.tracing import bump, span
+from . import spill as _spill
 
-__all__ = ["OutOfCoreJoin", "SpillPartitionOp", "HostSink"]
+__all__ = ["OutOfCoreJoin", "HostSink"]
+
+#: rider lane carrying each row's grace sub-bucket through the exchange
+_SUBPART = "__cylon_subpart"
 
 
-def _host_concat(parts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
-    names = list(parts[0].keys())
-    return {n: np.concatenate([p[n] for p in parts]) for n in names}
+def _promote(a: np.dtype, b: np.dtype) -> np.dtype:
+    """Common decoded dtype of two batches (object dominates — decoded
+    dictionary values / nullable bools are object arrays)."""
+    if a == np.dtype(object) or b == np.dtype(object):
+        return np.dtype(object)
+    return np.promote_types(a, b)
 
 
-class SpillPartitionOp(Op):
-    """Hash-partition each chunk into K buckets and spill them to host
-    (reference PartitionOp + the spill role of the chunked shuffle). The
-    device footprint per quantum is one chunk + its K filtered buckets."""
+class _BucketSink:
+    """Ingestion sink for one side: rows arrive from ``_shuffle_many``
+    already hash-routed to their owner shard; this sink bins them by the
+    rider sub-bucket lane into per-(bucket, shard) arenas. Values are
+    stored DECODED (each chunk encodes its own dictionary, so logical
+    values — not codes — are the stable host representation; bucket
+    staging re-encodes and re-unifies)."""
 
-    def __init__(self, op_id: str, keys: Sequence[str], k: int):
-        super().__init__(op_id, 1)
-        self.keys = list(keys)
+    def __init__(self, k: int, world: int, backing: int) -> None:
         self.k = k
-        self.spill: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(k)]
-        self.max_device_cap = 0  # observability: largest device table built
-        self.fetch_s = 0.0  # cost split: device->host spill fetch wall
-        self._pending = None  # one-deep pipelined (packed, bc) fetch
+        self.world = world
+        self.backing = backing
+        self.arenas: Dict[tuple, _spill.HostArena] = {}
+        self.names: Optional[List[str]] = None
+        self.device_rows_peak = 0  # engine-reported ingest residency
+        self.fetch_s = 0.0
 
-    def _fetch_spill(self, packed: Table, bc: np.ndarray) -> None:
-        """Fetch one packed chunk to host and slice its buckets into the
-        spill arena."""
+    def accept(self, table, shard_cols, counts) -> None:
         t0 = time.perf_counter()
-        host = packed.to_pydict()
-        self.fetch_s += time.perf_counter() - t0
-        names = list(host.keys())
-        shard_rows = packed.row_counts
-        shard_base = np.concatenate([[0], np.cumsum(shard_rows)])
-        for s in range(bc.shape[0]):
-            offs = shard_base[s] + np.concatenate([[0], np.cumsum(bc[s])])
-            for p in range(self.k):
-                lo, hi = int(offs[p]), int(offs[p + 1])
-                if hi > lo:
-                    self.spill[p].append(
-                        {n: host[n][lo:hi] for n in names}
-                    )
-
-    def process(self, chunk: Table, edge: int) -> None:
-        # ONE packing kernel + one fetch per column lane (Table.bucket_pack
-        # + to_pydict), then slice buckets out of the packed host copy — K
-        # filter kernels + K count syncs + K x C per-bucket fetches made
-        # device round-trips the dominant spill cost on a remote-attached
-        # TPU (16 chunks x 16 buckets: 30.5 s vs 241.7 s measured)
-        # hash_shift=16: buckets use HIGH murmur bits so the bucket-pair
-        # join's own low-bit mesh shuffle still spreads each bucket across
-        # all shards (same bits would pin bucket b to shard b mod world)
-        #
-        # The big device->host fetch is deferred ONE chunk: chunk k's fetch
-        # runs only after chunk k+1's pack kernel is dispatched (async), so
-        # the transfer rides under the next pack instead of serializing
-        # with it — the spill-side mirror of the join-side prefetch. Device
-        # residency: current chunk + one pending packed chunk.
-        packed, bc = chunk.bucket_pack(self.keys, self.k, hash_shift=16)
-        # peak spill residency: the incoming chunk, its fresh packed copy,
-        # AND the previous pending packed chunk coexist until the fetch below
-        pend_cap = self._pending[0].shard_cap if self._pending else 0
-        self.max_device_cap = max(
-            self.max_device_cap,
-            chunk.shard_cap + packed.shard_cap + pend_cap,
-        )
-        prev, self._pending = self._pending, (packed, bc)
-        if prev is not None:
-            self._fetch_spill(*prev)
-        return None
-
-    def on_finalize(self) -> None:
-        if self._pending is not None:
-            prev, self._pending = self._pending, None
-            self._fetch_spill(*prev)
-        return None
-
-
-class BucketJoinOp(Op):
-    """At finalize, join spilled bucket i of the left with bucket i of the
-    right — at most two bucket pairs on device at a time (one-ahead
-    prefetch) — and emit each bucket's result downstream (reference
-    JoinOp, but without the all-chunks concat that would defeat
-    out-of-core)."""
-
-    def __init__(
-        self,
-        op_id: str,
-        ctx,
-        left_spill: SpillPartitionOp,
-        right_spill: SpillPartitionOp,
-        **join_kwargs,
-    ):
-        super().__init__(op_id, 2)
-        self.ctx = ctx
-        self.left_spill = left_spill
-        self.right_spill = right_spill
-        self.join_kwargs = join_kwargs
-        self.max_device_cap = 0
-        self.join_s = 0.0   # cost split: join dispatch + count-sync wall
-        self.stage_s = 0.0  # cost split: host->device upload dispatch wall
-        self.drain_s = 0.0  # cost split: result download wall (drain thread)
-
-    def process(self, table: Table, edge: int) -> None:
-        return None  # data arrives via the spills, not the queues
-
-    def _stage_pair(self, b: int):
-        """Upload bucket pair b to the device (async dispatch), or None if
-        either side is empty (inner join of an empty side is empty)."""
-        lparts = self.left_spill.spill[b]
-        rparts = self.right_spill.spill[b]
-        if not lparts or not rparts:
-            return None
-        t0 = time.perf_counter()
-        lt = Table.from_pydict(self.ctx, _host_concat(lparts))
-        rt = Table.from_pydict(self.ctx, _host_concat(rparts))
-        self.stage_s += time.perf_counter() - t0
-        return lt, rt
-
-    def _drain_one(self) -> None:
-        """Drain queued downstream quanta (the HostSink fetch). Runs on the
-        single drainer thread so result downloads overlap the NEXT bucket
-        join's device compute instead of sitting between the previous count
-        sync and the next dispatch (they used to: round-3 ooc throughput was
-        ~100x below the in-core join, dominated by serialized transfers)."""
-        t0 = time.perf_counter()
-        for child in self.children:
-            while child.execute_one():
-                pass
-        self.drain_s += time.perf_counter() - t0
-
-    def on_finalize(self) -> Optional[Table]:
-        k = self.left_spill.k
-        # one-ahead prefetch: pair b+1's host->device uploads are dispatched
-        # BEFORE pair b's join blocks on its count fetch, so the transfer
-        # rides under the sync instead of after it. Result downloads run on
-        # a single drainer thread (jax device_get is thread-safe), bounded
-        # by a 2-slot semaphore so at most two undrained result tables are
-        # ever device-resident. Device residency bound: TWO bucket pairs +
-        # TWO result tables (+ join intermediates) — still ~total/K, the
-        # out-of-core guarantee, just double-buffered on both sides.
-        drain_slots = threading.Semaphore(2)
-        fut_caps: List[Tuple[concurrent.futures.Future, int]] = []
-        ex = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="ooc_drain"
-        )
-
-        def drain_task():
-            try:
-                self._drain_one()
-            finally:
-                drain_slots.release()
-
-        try:
-            staged = self._stage_pair(0) if k else None
-            for b in range(k):
-                cur = staged
-                staged = self._stage_pair(b + 1) if b + 1 < k else None
-                # spilled buckets are consumed; free the host arena as we go
-                self.left_spill.spill[b] = []
-                self.right_spill.spill[b] = []
-                # observability: CONCURRENT device rows — staged pairs plus
-                # results emitted but not yet confirmed drained (future not
-                # done; conservative overestimate) — this is the number the
-                # out-of-core guarantee is stated against
-                undrained = sum(c for f, c in fut_caps if not f.done())
-                resident = sum(
-                    t.shard_cap for pair in (cur, staged) if pair for t in pair
-                )
-                if cur is None:
-                    self.max_device_cap = max(
-                        self.max_device_cap, resident + undrained
-                    )
+        names = table.column_names
+        si = names.index(_SUBPART)
+        keep = [ci for ci in range(len(names)) if ci != si]
+        if self.names is None:
+            self.names = [names[ci] for ci in keep]
+        meta = [table._columns[n] for n in names]
+        for s in range(self.world):
+            n = int(counts[s])
+            if not n or shard_cols[s] is None:
+                continue
+            cols = shard_cols[s]
+            sub = np.asarray(cols[si][0][:n])
+            order = np.argsort(sub, kind="stable")
+            bc = np.bincount(sub, minlength=self.k)[: self.k]
+            offs = np.concatenate([[0], np.cumsum(bc)]).astype(np.int64)
+            decoded = [
+                meta[ci].decode_host(
+                    np.asarray(cols[ci][0][:n]),
+                    None if cols[ci][1] is None else cols[ci][1][:n],
+                )[order]
+                for ci in keep
+            ]
+            for b in range(self.k):
+                lo, hi = int(offs[b]), int(offs[b + 1])
+                if hi <= lo:
                     continue
-                lt, rt = cur
-                del cur
-                t0 = time.perf_counter()
-                out = lt.distributed_join(rt, **self.join_kwargs)
-                self.join_s += time.perf_counter() - t0
-                del lt, rt
-                cap_out = out.shard_cap
-                self.max_device_cap = max(
-                    self.max_device_cap, resident + undrained + cap_out
-                )
-                drain_slots.acquire()  # bound undrained device results
-                self._emit(out)
-                del out
-                fut_caps.append((ex.submit(drain_task), cap_out))
-        finally:
-            # collect EVERY future before shutdown: raising on the first
-            # failure would skip the rest and leak the drainer thread
-            drain_errs = []
-            for f, _cap in fut_caps:
-                try:
-                    f.result()
-                except Exception as e:  # noqa: BLE001 - re-raised below
-                    drain_errs.append(e)
-            ex.shutdown(wait=True)
-            if drain_errs:
-                raise drain_errs[0]
-        self._drain_one()  # final sweep (anything emitted but unqueued)
-        return None
+                arena = self.arenas.get((b, s))
+                if arena is None:
+                    arena = self.arenas[(b, s)] = _spill.HostArena(
+                        [
+                            (nm, d.dtype, False)
+                            for nm, d in zip(self.names, decoded)
+                        ],
+                        backing=self.backing,
+                    )
+                batch = []
+                for ci, d in enumerate(decoded):
+                    want = _promote(arena.schema[ci][1], d.dtype)
+                    arena.promote(ci, want)
+                    batch.append((d[lo:hi].astype(want, copy=False), None))
+                arena.append_batch(batch)
+        self.fetch_s += time.perf_counter() - t0
+
+    def bucket_shards(self, b: int):
+        """Per-shard logical column dicts of bucket ``b`` (dtypes unified
+        across shards), or None when the bucket is empty."""
+        if self.names is None:
+            return None
+        got = [self.arenas.get((b, s)) for s in range(self.world)]
+        total = sum(a.rows for a in got if a is not None)
+        if total == 0:
+            return None
+        dtypes = []
+        for ci in range(len(self.names)):
+            dt = np.dtype(np.int8)
+            first = True
+            for a in got:
+                if a is None:
+                    continue
+                dt = a.schema[ci][1] if first else _promote(dt, a.schema[ci][1])
+                first = False
+            dtypes.append(dt)
+        shards = []
+        for s in range(self.world):
+            a = got[s]
+            cols = a.columns() if a is not None else None
+            od = {}
+            for ci, nm in enumerate(self.names):
+                if cols is None:
+                    od[nm] = np.empty((0,), dtypes[ci])
+                else:
+                    od[nm] = cols[ci][0].astype(dtypes[ci], copy=False)
+            shards.append(od)
+        return shards
+
+    def release(self, b: int) -> None:
+        """Free bucket ``b``'s arenas as the join consumes them."""
+        for s in range(self.world):
+            a = self.arenas.pop((b, s), None)
+            if a is not None:
+                a.close()
+
+    def close(self) -> None:
+        for a in self.arenas.values():
+            a.close()
+        self.arenas.clear()
 
 
-class HostSink(RootOp):
-    """Chunked sink: every result chunk leaves the device immediately; the
-    combined result lives on the HOST (reference: per-rank CSV writes are the
-    same pattern). ``result_pydict()`` is the host concat; ``RootOp.result()``
-    (device concat) is deliberately unavailable."""
+class HostSink:
+    """Arena-backed result sink: every result chunk leaves the device
+    through the spill-aware lane fetch into ONE preallocated host arena
+    (``reserve`` sized from the result's already-known counts — the
+    per-bucket host concat the old sink paid at ``result_pydict()`` is
+    gone; reads are zero-copy views). ``RootOp.result()``-style device
+    concat is deliberately unavailable."""
 
-    def __init__(self, op_id: str = "host_sink"):
-        super().__init__(op_id, 1)
-        self.host_chunks: List[Dict[str, np.ndarray]] = []
+    def __init__(self, op_id: str = "host_sink", backing: int = _spill.TIER_HOST):
         self.rows = 0
         self.fetch_s = 0.0  # cost split: result device->host download wall
+        self._backing = backing
+        self._arena: Optional[_spill.HostArena] = None
+        self._names: Optional[List[str]] = None
 
-    def process(self, table: Table, edge: int) -> None:
+    def process(self, table: Table, edge: int = 0) -> None:
         t0 = time.perf_counter()
-        host = table.to_pydict()
+        counts = np.asarray(table.row_counts, np.int64)
+        n = int(counts.sum())
+        if n:
+            if self._arena is not None:
+                self._arena.reserve(n)
+            _spill.stage_table(self, table, counts)
+        self.rows += n
         self.fetch_s += time.perf_counter() - t0
-        self.rows += table.row_count
-        self.host_chunks.append(host)
-        return None
+
+    def accept(self, table, shard_cols, counts) -> None:
+        """Spill-sink contract: decode each shard's physical rows and
+        append shard-major (the same global order ``to_pydict`` yields)."""
+        meta = [table._columns[n] for n in table.column_names]
+        batches = []
+        for s in range(len(counts)):
+            n = int(counts[s])
+            if not n or shard_cols[s] is None:
+                continue
+            cols = shard_cols[s]
+            batches.append(
+                [
+                    meta[ci].decode_host(
+                        np.asarray(d[:n]), None if v is None else v[:n]
+                    )
+                    for ci, (d, v) in enumerate(cols)
+                ]
+            )
+        if not batches:
+            return
+        merged = [
+            np.concatenate([b[ci] for b in batches])
+            if len(batches) > 1
+            else batches[0][ci]
+            for ci in range(len(meta))
+        ]
+        if self._arena is None:
+            self._names = table.column_names
+            self._arena = _spill.HostArena(
+                [(nm, m.dtype, False) for nm, m in zip(self._names, merged)],
+                backing=self._backing,
+            )
+        out = []
+        for ci, m in enumerate(merged):
+            want = _promote(self._arena.schema[ci][1], m.dtype)
+            self._arena.promote(ci, want)
+            out.append((m.astype(want, copy=False), None))
+        self._arena.append_batch(out)
 
     def result(self) -> Table:  # pragma: no cover - guard
         raise RuntimeError(
@@ -266,9 +246,13 @@ class HostSink(RootOp):
         )
 
     def result_pydict(self) -> Dict[str, np.ndarray]:
-        if not self.host_chunks:
+        if self._arena is None:
             return {}
-        return _host_concat(self.host_chunks)
+        return {
+            nm: col for nm, (col, _v) in zip(
+                self._names, self._arena.columns()
+            )
+        }
 
 
 class OutOfCoreJoin:
@@ -276,93 +260,242 @@ class OutOfCoreJoin:
 
     ``execute(left_chunks, right_chunks)`` accepts iterables of host
     column-dicts (the host-staged chunk source); returns the HostSink. K
-    buckets bound the device-resident bucket size to ~total/K rows.
+    buckets bound the device-resident bucket size to ~total/K rows. The
+    partitioning, byte budgeting and (under skew) relay splitting all run
+    through the unified ``_shuffle_many`` planner — this class owns only
+    chunk ingestion and the result sink.
     """
 
     def __init__(self, ctx, on, how: str = "inner", num_buckets: int = 8,
-                 **join_kwargs):
+                 byte_budget: Optional[int] = None, **join_kwargs):
         if how != "inner":
             # outer joins need null-extension for one-sided buckets, which
-            # BucketJoinOp's skip-empty-bucket logic would silently drop
+            # the skip-empty-bucket logic would silently drop
             raise NotImplementedError(
                 "OutOfCoreJoin supports how='inner' only"
             )
         keys = on if isinstance(on, (list, tuple)) else [on]
         self.ctx = ctx
-        self.lp = SpillPartitionOp("spill_l", keys, num_buckets)
-        self.rp = SpillPartitionOp("spill_r", keys, num_buckets)
-        # bucket joins stay EAGER by default: the fused path's speculative
-        # join_cap is a worst-case-receive capacity (~2*(1+respill)*input
-        # rows), which would inflate device residency ~8x past the
-        # out-of-core ~total/K guarantee. mode='fused' remains a caller
-        # override (ONE host sync per bucket pair instead of ~5) for
-        # deployments where sync latency outweighs the residency bound —
-        # the published cost_split (join_s vs *_fetch_s) is the evidence
-        # to decide with.
-        self.join = BucketJoinOp(
-            "bucket_join", ctx, self.lp, self.rp,
-            on=on, how=how, **join_kwargs,
+        self.on = on
+        self.keys = list(keys)
+        self.k = int(num_buckets)
+        self.byte_budget = byte_budget
+        self.join_kwargs = join_kwargs
+        backing = (
+            _spill.TIER_DISK
+            if _spill.forced_tier() == _spill.TIER_DISK
+            else _spill.TIER_HOST
         )
-        self.sink = HostSink()
-        self.lp.add_child(self.join, edge=0)
-        self.rp.add_child(self.join, edge=1)
-        self.join.add_child(self.sink)
+        world = ctx.world_size
+        self.lp = _BucketSink(self.k, world, backing)
+        self.rp = _BucketSink(self.k, world, backing)
+        self.sink = HostSink(backing=backing)
+        self._ingest_cap = 0   # chunk-upload residency (per shard rows)
+        self._join_cap = 0     # bucket-join residency (per shard rows)
+        self.stage_s = 0.0     # cost split: bucket staging (host->device)
+        self.join_s = 0.0      # cost split: bucket join dispatch+sync wall
+        self.drain_s = 0.0     # cost split: result download wall (drain thread)
+
+    # -- ingestion -----------------------------------------------------
+    def _with_subpart(self, t: Table) -> Table:
+        """Stamp the grace sub-bucket lane: HIGH murmur bits (hash_shift)
+        so the ingest shuffle's low-bit routing stays independent — the
+        same split the old bucket_pack spill used, now riding the unified
+        exchange as a plain int32 column."""
+        kflat = tuple(t._key_hash_cols(self.keys))
+        key = (
+            "ooc_subpart",
+            tuple(str(d.dtype) for d, _v in kflat),
+            self.k,
+        )
+        k = self.k
+
+        def build():
+            def kern(dp, rep):
+                (kc, counts) = dp
+                n = counts[0]
+                pid = _p.hash_partition_ids(
+                    list(kc), n, k, hash_shift=16
+                )
+                # padding rows map to bucket k; clamp into range so the
+                # host bincount stays dense (live counts gate the slices)
+                return jnp.minimum(pid, k - 1).astype(jnp.int32)
+
+            return kern
+
+        pid = get_kernel(self.ctx, key, build)((kflat, t.counts_dev), ())
+        return t.add_column(
+            _SUBPART, Column(pid, DataType(Type.INT32), None, None)
+        )
+
+    def _ingest(self, sink: _BucketSink, chunk: Dict[str, np.ndarray]) -> None:
+        t = Table.from_pydict(self.ctx, dict(chunk))
+        if t.row_count == 0:
+            return
+        t2 = self._with_subpart(t)
+        self._ingest_cap = max(self._ingest_cap, 2 * t2.shard_cap)
+        if self.ctx.world_size == 1:
+            # no mesh to route over: the chunk IS its own shard — stage it
+            # straight into the sink through the same lane fetch
+            _spill.stage_table(sink, t2, np.asarray(t2.row_counts))
+            return
+        spec = _ShuffleSpec(
+            t2, "hash", tuple(self.keys),
+            byte_budget=self.byte_budget, sink=sink,
+        )
+        _shuffle_many([spec])
+
+    # -- bucket joins --------------------------------------------------
+    def _bucket_table(self, bsink: _BucketSink, b: int) -> Optional[Table]:
+        shards = bsink.bucket_shards(b)
+        if shards is None:
+            return None
+        t0 = time.perf_counter()
+        t = Table.from_shards(self.ctx, shards)
+        self.stage_s += time.perf_counter() - t0
+        return t
+
+    def _stage_pair(self, b: int):
+        """Upload bucket pair ``b``, or None if either side is empty
+        (inner join of an empty side is empty)."""
+        if b >= self.k:
+            return None
+        lt = self._bucket_table(self.lp, b)
+        rt = self._bucket_table(self.rp, b)
+        self.lp.release(b)
+        self.rp.release(b)
+        if lt is None or rt is None:
+            return None
+        return lt, rt
+
+    def _join_buckets(self) -> None:
+        # one-ahead staging + threaded result drain: pair b+1's device
+        # uploads are dispatched BEFORE pair b's join blocks on its count
+        # fetch, and result downloads run on a single drainer thread (jax
+        # device_get is thread-safe) bounded by a 2-slot semaphore — both
+        # transfers ride under the NEXT join's device work instead of
+        # serializing with it (the overlap the old hand-built BucketJoinOp
+        # measured as a ~100x ooc throughput cliff on remote-attached
+        # devices). Device residency: TWO bucket pairs + at most TWO
+        # undrained results — still ~total/K, just double-buffered.
+        drain_slots = threading.Semaphore(2)
+        fut_caps: List[tuple] = []
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ooc_drain"
+        )
+
+        def drain(out):
+            t0 = time.perf_counter()
+            try:
+                self.sink.process(out)
+            finally:
+                self.drain_s += time.perf_counter() - t0
+                drain_slots.release()
+
+        try:
+            staged = self._stage_pair(0)
+            for b in range(self.k):
+                cur, staged = staged, self._stage_pair(b + 1)
+                undrained = sum(c for f, c in fut_caps if not f.done())
+                resident = sum(
+                    t.shard_cap
+                    for pair in (cur, staged) if pair for t in pair
+                )
+                if cur is None:
+                    self._join_cap = max(
+                        self._join_cap, resident + undrained
+                    )
+                    continue
+                lt, rt = cur
+                del cur
+                t0 = time.perf_counter()
+                out = lt.distributed_join(rt, on=self.on, **self.join_kwargs)
+                self.join_s += time.perf_counter() - t0
+                cap_out = out.shard_cap
+                self._join_cap = max(
+                    self._join_cap, resident + undrained + cap_out
+                )
+                del lt, rt
+                drain_slots.acquire()  # bound undrained device results
+                fut_caps.append((ex.submit(drain, out), cap_out))
+                del out
+        finally:
+            # collect EVERY future before shutdown: raising on the first
+            # failure would skip the rest and leak the drainer thread
+            errs = []
+            for f, _cap in fut_caps:
+                try:
+                    f.result()
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    errs.append(e)
+            ex.shutdown(wait=True)
+            if errs:
+                raise errs[0]
 
     def execute(
         self,
         left_chunks: Iterable[Dict[str, np.ndarray]],
         right_chunks: Iterable[Dict[str, np.ndarray]],
     ) -> HostSink:
-        execution = RoundRobinExecution(self.lp, self.rp)
         li, ri = iter(left_chunks), iter(right_chunks)
-        # stream: at most ONE pending chunk per source per quantum — the
+        # stream: at most ONE chunk per source resident per quantum — the
         # host-staged source is pull-based, so the whole input is never
         # resident anywhere at once
         exhausted = [False, False]
-        while not all(exhausted):
-            for i, (it, src) in enumerate(((li, self.lp), (ri, self.rp))):
-                if exhausted[i]:
-                    continue
-                try:
-                    chunk = next(it)
-                except StopIteration:
-                    exhausted[i] = True
-                    src.finish()
-                    continue
-                src.insert(Table.from_pydict(self.ctx, dict(chunk)))
-            execution.step()
-        execution.run()
+        try:
+            with span("shuffle.spill.ooc_ingest"):
+                while not all(exhausted):
+                    for i, (it, sink) in enumerate(
+                        ((li, self.lp), (ri, self.rp))
+                    ):
+                        if exhausted[i]:
+                            continue
+                        try:
+                            chunk = next(it)
+                        except StopIteration:
+                            exhausted[i] = True
+                            continue
+                        self._ingest(sink, chunk)
+            bump("shuffle.spill.ooc_joins")
+            with span("shuffle.spill.ooc_join"):
+                self._join_buckets()
+        finally:
+            # close on failure too: leaked arenas would pin tier-2 memmap
+            # files and keep _ARENA_LIVE_BYTES inflated for later shuffles
+            self.lp.close()
+            self.rp.close()
         return self.sink
 
+    # -- observability -------------------------------------------------
     @property
     def max_device_cap(self) -> int:
-        """Largest per-shard device capacity any stage ever allocated —
-        the out-of-core guarantee is max_device_cap << total rows."""
-        return max(
-            self.lp.max_device_cap, self.rp.max_device_cap,
-            self.join.max_device_cap,
+        """Largest per-shard device row residency any stage reached —
+        the out-of-core guarantee is max_device_cap << total rows. The
+        ingest term comes from the unified engine's own accounting
+        (chunk + bounded round buffers + the <=2-round staging window)."""
+        engine_peak = max(
+            self.lp.device_rows_peak, self.rp.device_rows_peak
         )
+        return max(self._ingest_cap + engine_peak, self._join_cap)
 
     @property
     def join_phase_device_cap(self) -> int:
         """Peak residency of the bucket-join phase alone — the ~total/K
-        quantity num_buckets controls (the spill phase's chunk-sized
-        residency is bucket-count-independent and can dominate the global
-        max for small inputs)."""
-        return self.join.max_device_cap
+        quantity num_buckets controls (ingest residency is chunk-sized
+        and bucket-count-independent)."""
+        return self._join_cap
 
     @property
     def cost_split(self) -> Dict[str, float]:
-        """Per-phase wall seconds — the tunnel-free projection evidence
-        (VERDICT r3 item 4). spill_fetch/drain_fetch are pure host<->device
-        transfer walls (the part a remote tunnel inflates and a
-        locally-attached chip would collapse); join is dispatch+count-sync;
-        stage is upload dispatch. Overlapped phases can sum past the
-        end-to-end wall — each number is that phase's own clock."""
+        """Per-phase wall seconds (the tunnel-free projection evidence):
+        spill_fetch covers the ingest-side device->host staging, stage
+        the bucket re-uploads, join the bucket-join dispatch+sync, and
+        drain_fetch the result downloads. Overlapped phases can sum past
+        the end-to-end wall — each number is that phase's own clock."""
         return {
             "spill_fetch_s": round(self.lp.fetch_s + self.rp.fetch_s, 3),
-            "stage_upload_s": round(self.join.stage_s, 3),
-            "join_s": round(self.join.join_s, 3),
+            "stage_upload_s": round(self.stage_s, 3),
+            "join_s": round(self.join_s, 3),
             "drain_fetch_s": round(self.sink.fetch_s, 3),
-            "drain_thread_s": round(self.join.drain_s, 3),
+            "drain_thread_s": round(self.drain_s, 3),
         }

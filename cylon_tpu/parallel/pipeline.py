@@ -16,6 +16,15 @@ impossible under XLA static shapes); ``join_cap`` bounds per-shard join
 output. Each step also returns an ``overflow`` flag so callers can detect
 undersized capacities and re-run with bigger ones (two-round respill,
 SURVEY.md §7 hard-parts plan).
+
+Skew: the in-graph respill rounds absorb MODERATE skew (a bucket up to
+(1+respill) x cap) with zero host syncs; extreme skew — where padding
+every respill round to the hot bucket would dominate the wire — is the
+eager engine's job, whose measured-count planner splits heavy-bucket
+tails onto the host relay instead (parallel/spill.plan_schedule). The
+fused path reports its padded exchange volume through the same
+``shuffle.exchanged_bytes`` counter via :func:`fused_exchange_bytes` so
+the two regimes stay comparable in BENCH/EXPLAIN output.
 """
 from __future__ import annotations
 
@@ -39,6 +48,24 @@ class ShardTable(NamedTuple):
 
     cols: Tuple[KeyCol, ...]
     n: jax.Array  # scalar int32
+
+
+def fused_exchange_bytes(
+    world: int,
+    bucket_cap: int,
+    respill: int,
+    row_bytes_l: int,
+    row_bytes_r: int,
+    num_slices: int = 1,
+) -> int:
+    """Global padded exchange bytes of one fused join/q3 step: each side
+    ships ``num_slices x (1 + respill)`` header-augmented all_to_all
+    buffers of ``world x (cap + 1)`` rows per shard. The fused-path twin
+    of the eager planner's ``shuffle.exchanged_bytes`` accounting (one
+    formula, so the eager and fused regimes compare like-for-like)."""
+    rows = world * world * (bucket_cap + _sh.HEADER_ROWS)
+    per_side = num_slices * (1 + respill) * rows
+    return per_side * (row_bytes_l + row_bytes_r)
 
 
 def _shuffle_rounds(
@@ -122,7 +149,7 @@ def shuffle_shard(
 
 
 # slice bits live at hash_shift=24 (bits 24..31): shard pid uses the low
-# bits, the out-of-core bucket split uses bits 16..23 (bucket_pack
+# bits, the out-of-core bucket split uses bits 16..23 (ooc subpart
 # hash_shift=16, up to 256 buckets) — reusing shift 16 here would make
 # every ooc bucket land in ONE slice (bucket b fixes those bits), turning
 # K-1 slice rounds into empty work and the live one into guaranteed

@@ -46,8 +46,13 @@ Cols = Sequence[Tuple[jax.Array, Optional[jax.Array]]]
 # distributed join instead of 4)
 HEADER_ROWS = 1
 
-# dispatch-count bound for extreme skew: past this many rounds the planner
-# raises bucket_cap (over the byte budget) rather than exploding round count
+# dispatch-count bound for extreme skew: past this many rounds plan_rounds
+# raises bucket_cap (over the byte budget) rather than exploding round
+# count. NOTE this raise is GLOBAL — a single over-budget bucket inflates
+# every bucket's cap — which is exactly the case the skew-adaptive
+# schedule (parallel/spill.plan_schedule) removes: heavy-bucket tails
+# leave the collective through the host relay and the cap stays sized for
+# the cold histogram.
 DEFAULT_MAX_ROUNDS = 16
 
 
@@ -104,6 +109,14 @@ def build_send_slots_round(
     ``round_idx`` may be a traced scalar, so ONE compiled program serves
     every round. Returns (dest [cap] int32 with P*bucket_cap meaning
     not-this-round, leftover scalar = rows still unsent AFTER this round).
+
+    The round windows double as the skew-adaptive schedule's bucket-slice
+    clamp (parallel/spill.RoundSchedule): a K-round plan ships exactly the
+    first ``K * bucket_cap`` rows of every bucket — rows past that quota
+    fall outside every round's window here (and outside every round's
+    header count in :func:`round_counts`), and the adaptive planner routes
+    them through the host relay (:func:`relay_send_slots`) instead of
+    padding the cap up to the hottest bucket.
     """
     cap = pid.shape[0]
     order = shuffle_gather_order(pid, num_partitions)
@@ -204,6 +217,42 @@ def round_counts(counts: jax.Array, bucket_cap: int, round_idx) -> jax.Array:
     return jnp.clip(counts - r * bucket_cap, 0, bucket_cap)
 
 
+def relay_send_slots(
+    pid: jax.Array,
+    counts: jax.Array,
+    num_partitions: int,
+    quota,
+    relay_cap: int,
+) -> jax.Array:
+    """Destination slot in the [relay_cap] RELAY buffer for every row whose
+    within-bucket position is past the collective quota — the skew-split
+    tail of the adaptive schedule (parallel/spill.plan_schedule): heavy
+    buckets ship their first ``quota = K * bucket_cap`` rows through the
+    K padded all_to_all rounds and the remainder through ONE host-mediated
+    relay extraction, so a one-hot distribution costs O(rows) bytes
+    instead of world x the padded rounds.
+
+    ``quota`` may be a traced scalar (one compiled program serves every
+    schedule at a given relay_cap). Relay rows keep the stable
+    destination-major order of :func:`shuffle_gather_order`, so the host
+    splits each source's buffer into per-destination runs with the
+    planner's own [src, dst] relay counts — no count lane needed. Rows
+    under quota (and padding) get the dropped slot ``relay_cap``.
+    """
+    cap = pid.shape[0]
+    order = shuffle_gather_order(pid, num_partitions)
+    spid = pid[order]
+    starts = jnp.cumsum(counts) - counts
+    safe_pid = jnp.clip(spid, 0, num_partitions - 1)
+    pos = jnp.arange(cap, dtype=jnp.int32) - starts[safe_pid]
+    q = jnp.asarray(quota, jnp.int32)
+    ok = (spid < num_partitions) & (pos >= q)
+    slot_sorted = jnp.where(
+        ok, jnp.cumsum(ok.astype(jnp.int32)) - 1, relay_cap
+    ).astype(jnp.int32)
+    return jnp.full((cap,), relay_cap, jnp.int32).at[order].set(slot_sorted)
+
+
 # ----------------------------------------------------------------------
 # chunked-round planning (the byte-budget knob, config.py)
 # ----------------------------------------------------------------------
@@ -266,7 +315,11 @@ def plan_rounds(
     shuffles in K bounded rounds without the full padded buffer ever
     materializing). n_rounds = ceil(hottest bucket / cap), bounded by
     ``max_rounds`` (beyond it the cap grows past the budget — dispatch
-    count is the scarcer resource under extreme skew).
+    count is the scarcer resource under extreme skew). That raise is
+    GLOBAL: one over-budget bucket inflates every bucket's cap — the
+    skew-adaptive planner (parallel/spill.plan_schedule) wraps this
+    function to keep non-skewed plans byte-identical while routing
+    heavy-bucket tails through the host relay instead of raising the cap.
     """
     from ..engine import round_cap
 

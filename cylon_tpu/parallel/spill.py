@@ -1,0 +1,639 @@
+"""Spill tiers + skew-adaptive round scheduling: ONE budget-driven planner
+for every table that does not fit a single padded exchange.
+
+The reference streams arbitrarily large tables through fixed-size buffers
+(arrow_all_to_all.cpp:83-141). Our TPU engine used to have two disjoint
+answers to "table doesn't fit": the chunked ``_shuffle_many`` rounds
+(tier 0) and ``parallel/ooc.py``'s private Grace-style spill rounds that
+saw none of the engine's header fusion / lane packing / semi filtering.
+Per Exoshuffle (PAPERS.md), shuffle should be ONE application-level
+composition whose spill tiers are policy — this module is that policy:
+
+tier 0 (HBM)
+    Today's K bounded rounds; every round's compacted output stays
+    device-resident until the final concat. Chosen when the measured
+    received rows fit the device spill budget.
+tier 1 (host RAM)
+    The same K rounds, but each round's compacted output is fetched into
+    a host :class:`HostArena` as soon as the NEXT round is dispatched
+    (one-deep overlap), so peak device memory is the round buffers plus
+    at most two staged outputs — never the whole table.
+tier 2 (disk)
+    Tier 1 with ``np.memmap``-backed arenas under ``CYLON_TPU_SPILL_DIR``
+    (or a tempdir); engaged when the host budget is exceeded, or forced.
+
+The tier is chosen PER SHUFFLE from the per-bucket counts the fused count
+pass already returns for free (:func:`choose_tier`), so every
+``Distributed*`` op transparently scales past HBM through the same
+``_shuffle_many`` loop.
+
+Skew-adaptive round splitting (:func:`plan_schedule`) rides the same
+measured counts: an equal-chunk ``all_to_all`` must ship
+``K x world^2 x cap`` rows no matter how empty the cold buckets are, so a
+one-hot key distribution pays a ``world``-fold padding tax that no cap
+choice can remove. The adaptive schedule therefore keeps the collective
+rounds sized for the COLD buckets (cap, K and the per-bucket quota
+``K*cap`` derived from the histogram — the ``(cap, bucket-slice)``
+schedule threaded through ``build_send_slots_round`` / ``round_counts``,
+whose round windows already implement the quota clamp) and moves each
+heavy bucket's tail through the spill machinery instead: a relay
+extraction kernel packs the over-quota rows once, they cross through host
+RAM, and land directly on their owner shard. A one-hot distribution then
+ships O(rows) bytes instead of O(world x max-bucket) — ``_shuffle_many``
+emits the traced ``shuffle.skew_split`` counter and non-skewed plans stay
+byte-identical to :func:`~cylon_tpu.parallel.shuffle.plan_rounds`.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import gather as _g
+from ..utils import envgate as _envgate
+from ..utils.tracing import bump, gauge, span
+from . import shuffle as _sh
+
+TIER_HBM = 0
+TIER_HOST = 1
+TIER_DISK = 2
+TIER_NAMES = {TIER_HBM: "hbm", TIER_HOST: "host", TIER_DISK: "disk"}
+
+# ----------------------------------------------------------------------
+# knobs (registered in utils/envgate.py; resolvers mirror config.py's
+# shuffle_byte_budget pattern)
+# ----------------------------------------------------------------------
+
+# kill switch for the skew-adaptive schedule: the padded-plan oracle for
+# differentials. Host-only by construction — the gate changes which
+# (cap, K) the HOST picks and whether the separately-keyed ('relay',)
+# extraction program dispatches; no kernel body ever reads it.
+skew_enabled, skew_disabled = _envgate.env_gate(
+    "CYLON_TPU_NO_SKEW_SPLIT",
+    keyed_via="host round planning only: cap/K reach kernels as operand "
+    "shapes + traced round scalars, and the relay extraction dispatches "
+    "under its own ('relay',) cache-key suffix; no kernel body reads the "
+    "gate",
+    note="=1 disables skew-adaptive round splitting (padded-plan oracle)",
+)
+
+#: a heavy bucket exceeds this multiple of the mean bucket count
+SKEW_MIN_RATIO = 4
+#: apply the adaptive schedule only when it cuts decision cost >= 25%
+SKEW_MIN_SAVINGS = 0.25
+#: host-relayed bytes cross PCIe twice (fetch + restage), so they count
+#: double against the collective bytes they replace
+RELAY_COST_FACTOR = 2.0
+
+
+def forced_tier() -> Optional[int]:
+    """The CYLON_TPU_SPILL_TIER override (None = measured decision)."""
+    v = _envgate.SPILL_TIER.get()
+    if v == "":
+        return None
+    t = int(v)
+    if t not in (TIER_HBM, TIER_HOST, TIER_DISK):
+        raise ValueError(f"CYLON_TPU_SPILL_TIER must be 0/1/2, got {v!r}")
+    return t
+
+
+def device_spill_budget() -> Optional[int]:
+    """Per-shard staged-output bytes above which a shuffle spills its
+    rounds off-device (None = never: tier 0 unless forced)."""
+    v = _envgate.SPILL_DEVICE_BUDGET.get()
+    return int(v) if v else None
+
+
+def host_spill_budget() -> Optional[int]:
+    """Total live host-arena bytes above which NEW arena growth goes to
+    disk-backed buffers (None = unlimited host RAM)."""
+    v = _envgate.SPILL_HOST_BUDGET.get()
+    return int(v) if v else None
+
+
+def spill_dir() -> Optional[str]:
+    return _envgate.SPILL_DIR.get() or None
+
+
+def gate_state() -> tuple:
+    """The spill-policy component of the plan fingerprint
+    (plan/lazy.gated_fingerprint): forced tier + skew-split gate. Both
+    are host-side dispatch policy, but a cached executor built under one
+    state must not serve the other (the tier changes the staging path a
+    lowered shuffle takes; the skew gate changes its round plan)."""
+    return (_envgate.SPILL_TIER.get(), skew_enabled())
+
+
+def choose_tier(staged_bytes: int) -> int:
+    """Tier for a shuffle whose measured received rows stage
+    ``staged_bytes`` per shard: forced knob wins; else tier 0 while the
+    device spill budget (unset = unlimited) holds, tier 1 beyond it.
+    (Tier 1 arenas self-promote to disk when the HOST budget is exceeded
+    — see :meth:`HostArena._alloc` — so the 1 vs 2 split is a property
+    of the arena backing, not of this decision.)"""
+    f = forced_tier()
+    if f is not None:
+        return f
+    budget = device_spill_budget()
+    if budget is None or staged_bytes <= budget:
+        return TIER_HBM
+    return TIER_HOST
+
+
+# ----------------------------------------------------------------------
+# skew-adaptive round schedule
+# ----------------------------------------------------------------------
+
+class RoundSchedule(NamedTuple):
+    """One shuffle's planned rounds. ``relay=None`` means the plan is the
+    uniform padded plan, bit-for-bit what :func:`plan_rounds` returns.
+    With ``relay`` (a [src, dst] row matrix), each bucket ships only its
+    first ``quota = n_rounds * bucket_cap`` rows through the collective
+    rounds (the existing round windows enforce exactly that) and the
+    tails cross through the host relay."""
+
+    bucket_cap: int
+    n_rounds: int
+    relay: Optional[np.ndarray]  # [world, world] over-quota rows, or None
+
+    @property
+    def adaptive(self) -> bool:
+        return self.relay is not None
+
+    @property
+    def quota(self) -> int:
+        return self.bucket_cap * self.n_rounds
+
+    def coll_row_slots(self, world: int) -> int:
+        """Global collective row slots shipped: K x world^2 x cap."""
+        return self.n_rounds * world * world * self.bucket_cap
+
+    def relay_rows(self) -> int:
+        return 0 if self.relay is None else int(self.relay.sum())
+
+    def relay_cap(self) -> int:
+        """Static per-source relay buffer rows (pow2, engine minimum 8)."""
+        if self.relay is None:
+            return 0
+        from ..engine import round_cap
+
+        return round_cap(int(self.relay.sum(axis=1).max()))
+
+
+def plan_schedule(
+    send_counts: np.ndarray,
+    row_bytes: int,
+    world: int,
+    byte_budget: int,
+    max_rounds: int = _sh.DEFAULT_MAX_ROUNDS,
+) -> RoundSchedule:
+    """The budget-driven round schedule for a measured [src, dst] count
+    matrix. Non-skewed distributions return exactly ``plan_rounds``'
+    (cap, K) with no relay — byte-identical plans, same compiled kernels.
+
+    Heavy buckets (above ``SKEW_MIN_RATIO`` x the mean bucket) re-plan
+    the collective rounds against the COLD histogram and relay their
+    tails through the host, but only when that cuts the cost model
+    (collective slots + ``RELAY_COST_FACTOR`` x relayed rows) by at
+    least ``SKEW_MIN_SAVINGS`` — marginal skew keeps the padded plan.
+    """
+    cap0, k0 = _sh.plan_rounds(
+        send_counts, row_bytes, world, byte_budget, max_rounds
+    )
+    base = RoundSchedule(cap0, k0, None)
+    # lint: key=CYLON_TPU_NO_SKEW_SPLIT -- the gate decides HOST planning
+    # only: cap/K reach every round kernel through operand shapes (jit
+    # shape specialization) and the relay extraction dispatches under its
+    # own ('relay',) cache-key suffix, so no compiled program can alias
+    # across a gate flip; the plan fingerprint carries the gate via
+    # spill.gate_state (plan/lazy.gated_fingerprint)
+    if not skew_enabled():
+        return base
+    m = np.asarray(send_counts, np.int64).reshape(-1, world)
+    if m.size == 0 or m.max() == 0:
+        return base
+    mean_bucket = -(-int(m.sum()) // m.size)
+    heavy_thresh = max(SKEW_MIN_RATIO * mean_bucket, 8)
+    heavy_cols = m.max(axis=0) > heavy_thresh
+    if not heavy_cols.any() or heavy_cols.all():
+        # all-heavy == uniformly large: nothing to rebalance against
+        return base
+    cold_max = int(m[:, ~heavy_cols].max()) if (~heavy_cols).any() else 0
+    clipped = np.minimum(m, max(cold_max, 1))
+    cap_c, k_c = _sh.plan_rounds(
+        clipped, row_bytes, world, byte_budget, max_rounds
+    )
+    quota = cap_c * k_c
+    relay = np.maximum(m - quota, 0)
+    if int(relay.sum()) == 0:
+        return base
+    adaptive = RoundSchedule(cap_c, k_c, relay)
+    cost_base = base.coll_row_slots(world)
+    cost_adapt = (
+        adaptive.coll_row_slots(world)
+        + RELAY_COST_FACTOR * adaptive.relay_rows()
+    )
+    if cost_adapt > (1.0 - SKEW_MIN_SAVINGS) * cost_base:
+        return base
+    return adaptive
+
+
+# ----------------------------------------------------------------------
+# host / disk arenas
+# ----------------------------------------------------------------------
+
+_arena_lock = threading.Lock()
+_ARENA_LIVE_BYTES = 0
+
+
+def _arena_adjust(delta: int) -> None:
+    """Track total live arena bytes; the gauge's max is the process peak
+    (the satellite's 'report peak host bytes' evidence)."""
+    global _ARENA_LIVE_BYTES
+    with _arena_lock:
+        _ARENA_LIVE_BYTES += delta
+        live = _ARENA_LIVE_BYTES
+    gauge("shuffle.spill.host_bytes", live)
+
+
+class HostArena:
+    """Preallocated columnar arena for spilled rows.
+
+    ``schema``: ``[(name, np_dtype, has_valid)]``. Growth is by explicit
+    :meth:`reserve` (callers size it from the fused count pass, so the
+    steady state never copies) with geometric doubling as the fallback.
+    RAM-backed by default; buffers allocate as ``np.memmap`` under the
+    spill dir when ``backing=TIER_DISK`` or when total live arena bytes
+    exceed the host spill budget (automatic tier-1 -> tier-2 promotion).
+    Object-dtype columns (decoded dictionary values) always stay in RAM
+    — only fixed-width columns can spill to disk."""
+
+    def __init__(
+        self,
+        schema: Sequence[Tuple[str, np.dtype, bool]],
+        backing: int = TIER_HOST,
+        directory: Optional[str] = None,
+    ) -> None:
+        self.schema = [(n, np.dtype(d), bool(v)) for n, d, v in schema]
+        self.backing = backing
+        self.rows = 0
+        self._cap = 0
+        self._dir = directory
+        self._owns_dir = False
+        self._nfiles = 0
+        self._bytes = 0
+        # per column: [data buffer, valid buffer | None]
+        self._bufs: List[List[Optional[np.ndarray]]] = [
+            [None, None] for _ in self.schema
+        ]
+
+    # -- allocation ----------------------------------------------------
+    def _ensure_dir(self) -> str:
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(
+                prefix="cylon_spill_", dir=spill_dir()
+            )
+            self._owns_dir = True
+        return self._dir
+
+    def _alloc(self, dtype: np.dtype, n: int) -> np.ndarray:
+        want_disk = self.backing == TIER_DISK
+        if not want_disk:
+            hb = host_spill_budget()
+            if hb is not None and _ARENA_LIVE_BYTES >= hb:
+                want_disk = True
+                bump("shuffle.spill.tier2_promotions")
+        if want_disk and dtype != np.dtype(object):
+            self._nfiles += 1
+            path = os.path.join(
+                self._ensure_dir(), f"col{self._nfiles}.bin"
+            )
+            return np.memmap(path, dtype=dtype, mode="w+", shape=(n,))
+        return np.empty((n,), dtype)
+
+    @staticmethod
+    def _release_buf(buf) -> None:
+        """Drop a superseded buffer's disk backing: growth/promotion
+        replaces memmaps with fresh files, and the dead generation must
+        not accumulate on the spill volume (POSIX unlink-while-mapped is
+        safe; the mapping dies with the last array reference)."""
+        if isinstance(buf, np.memmap):
+            try:
+                os.unlink(buf.filename)
+            except OSError:
+                pass
+
+    def _recount_bytes(self) -> None:
+        """Re-derive live bytes from the actual buffers (growth AND
+        dtype promotion both land here, so the host-budget check and the
+        ``shuffle.spill.host_bytes`` gauge never understate memory)."""
+        total = 0
+        for (name, dtype, _hv), (d, v) in zip(self.schema, self._bufs):
+            if d is not None:
+                total += self._cap * 8 if dtype == np.dtype(object) else d.nbytes
+            if v is not None:
+                total += v.nbytes
+        _arena_adjust(total - self._bytes)
+        self._bytes = total
+
+    def reserve(self, extra: int) -> None:
+        """Ensure capacity for ``extra`` more rows (count-pass sizing:
+        call with the exact incoming total and no growth copy happens)."""
+        target = self.rows + int(extra)
+        if target <= self._cap:
+            return
+        new_cap = max(target, 2 * self._cap)
+        for ci, (name, dtype, has_valid) in enumerate(self.schema):
+            old_d, old_v = self._bufs[ci]
+            d = self._alloc(dtype, new_cap)
+            if old_d is not None:
+                d[: self.rows] = old_d[: self.rows]
+                self._release_buf(old_d)
+            self._bufs[ci][0] = d
+            if has_valid:
+                v = self._alloc(np.dtype(bool), new_cap)
+                if old_v is not None:
+                    v[: self.rows] = old_v[: self.rows]
+                    self._release_buf(old_v)
+                self._bufs[ci][1] = v
+        self._cap = new_cap
+        self._recount_bytes()
+
+    def promote(self, ci: int, new_dtype) -> None:
+        """Widen one column's buffer dtype in place. Decoded-value sinks
+        (parallel/ooc.py) need this: a later batch may carry nulls that
+        decode wider (int32 -> float64-with-NaN) or strings that decode
+        to object — the arena follows the widest batch seen."""
+        name, old, has_valid = self.schema[ci]
+        new_dtype = np.dtype(new_dtype)
+        if new_dtype == old:
+            return
+        self.schema[ci] = (name, new_dtype, has_valid)
+        buf = self._bufs[ci][0]
+        if buf is not None:
+            nb = self._alloc(new_dtype, self._cap)
+            nb[: self.rows] = buf[: self.rows]
+            self._release_buf(buf)
+            self._bufs[ci][0] = nb
+            self._recount_bytes()
+
+    # -- data path -----------------------------------------------------
+    def append_batch(self, cols: Sequence[Tuple[np.ndarray, Optional[np.ndarray]]]) -> None:
+        """Append one batch of physical columns (order = schema order)."""
+        n = len(cols[0][0]) if cols else 0
+        if n == 0:
+            return
+        self.reserve(n)
+        lo, hi = self.rows, self.rows + n
+        for ci, (data, valid) in enumerate(cols):
+            self._bufs[ci][0][lo:hi] = data
+            vb = self._bufs[ci][1]
+            if vb is not None:
+                vb[lo:hi] = True if valid is None else valid
+        self.rows = hi
+
+    def columns(self) -> List[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Zero-copy live views, schema order."""
+        out = []
+        for ci, (_n, _d, has_valid) in enumerate(self.schema):
+            d, v = self._bufs[ci]
+            if d is None:
+                d = self._alloc(self.schema[ci][1], 0)
+            out.append(
+                (d[: self.rows], v[: self.rows] if v is not None else None)
+            )
+        return out
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        _arena_adjust(-self._bytes)
+        self._bytes = 0
+        for pair in self._bufs:
+            self._release_buf(pair[0])
+            self._release_buf(pair[1])
+        self._bufs = [[None, None] for _ in self.schema]
+        self._cap = 0
+        self.rows = 0
+        if self._owns_dir and self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+            self._owns_dir = False
+
+    def __del__(self):  # pragma: no cover - best-effort cleanup
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShardArenaSink:
+    """The engine-internal tier-1/2 sink: one PHYSICAL-encoding arena per
+    destination shard; :func:`arena_result` rebuilds the device table at
+    the end with the source table's dtype/dictionary metadata, so a
+    spilled shuffle's result is bit-identical to the in-HBM path."""
+
+    def __init__(self, world: int, schema, backing: int) -> None:
+        self.arenas = [HostArena(schema, backing) for _ in range(world)]
+        self.device_rows_peak = 0  # engine-reported, per shard
+
+    def accept(self, table, shard_cols, counts) -> None:
+        """``shard_cols[s]`` = physical (data, valid) pairs of shard s's
+        rows (host arrays); ``table`` carries metadata only."""
+        for s, cols in enumerate(shard_cols):
+            if int(counts[s]):
+                self.arenas[s].append_batch(cols)
+
+    def counts(self) -> np.ndarray:
+        return np.asarray([a.rows for a in self.arenas], np.int64)
+
+    def close(self) -> None:
+        for a in self.arenas:
+            a.close()
+
+
+# ----------------------------------------------------------------------
+# the spill-aware lane fetch (ops/gather host codec consumers)
+# ----------------------------------------------------------------------
+
+def _table_lane_parts(table):
+    """(plan, pt_order, flat) of a table's columns under the lane codec."""
+    flat = table._flat_cols()
+    plan = _g.lane_plan(flat)
+    pt_order = tuple(ci for ci, (tag, _nl, _hv) in enumerate(plan) if tag is None)
+    return plan, pt_order, flat
+
+
+def _unpack_host_shard(plan, pt_order, mat_s, pts_s, n):
+    """One shard's physical columns from its fetched lane rows."""
+    lanes = [
+        np.ascontiguousarray(mat_s[:n, j]) for j in range(mat_s.shape[1])
+    ]
+    pt_map = {ci: pts_s[k][:n] for k, ci in enumerate(pt_order)}
+    return _g.host_unpack_cols(plan, lanes, lambda ci: pt_map[ci])
+
+
+def stage_table(sink, table, counts: np.ndarray) -> None:
+    """Fetch one staged round's table into ``sink`` through the
+    spill-aware lane codec: every int32-lane column rides ONE packed
+    [rows, L] transfer (plus one per f64 passthrough column) and is
+    decoded on the host (ops/gather.host_unpack_cols) — instead of one
+    device round-trip per column. ``counts`` are the host-known received
+    rows per shard (the engine's planned expectation; no extra count
+    fetch). This function owns the spill staging sync sites
+    (analysis/contracts.py 'spill.stage_table')."""
+    from ..table import _fetch, get_kernel
+    import jax.numpy as jnp
+
+    ctx = table.ctx
+    world = ctx.world_size
+    plan, pt_order, flat = _table_lane_parts(table)
+    key = ("spill_pack", tuple(plan))
+
+    def build():
+        def kern(dp, rep):
+            (cols,) = dp
+            _plan, lanes, passthrough = _g.pack_cols(list(cols))
+            cap = cols[0][0].shape[0]
+            mat = (
+                jnp.stack(lanes, axis=1)
+                if lanes
+                else jnp.zeros((cap, 0), jnp.int32)
+            )
+            # lint: keyed=pt_order -- pure function of the lane plan,
+            # which is the ("spill_pack", plan) cache key itself
+            return mat, tuple(passthrough[ci] for ci in pt_order)
+
+        return kern
+
+    with span("shuffle.spill.stage", rows=int(np.sum(counts))):
+        mat, pts = get_kernel(ctx, key, build)((flat,), ())
+        bump("host_sync")
+        mat_np = np.asarray(_fetch(mat))
+        pts_np = [np.asarray(_fetch(p)) for p in pts]
+    cap = mat_np.shape[0] // world
+    mat_np = mat_np.reshape(world, cap, mat_np.shape[1])
+    pts_np = [p.reshape(world, cap) for p in pts_np]
+    shard_cols = []
+    staged = 0
+    for s in range(world):
+        n = int(counts[s])
+        shard_cols.append(
+            _unpack_host_shard(
+                plan, pt_order, mat_np[s], [p[s] for p in pts_np], n
+            )
+        )
+        staged += n
+    bump("shuffle.spill.staged_rounds")
+    bump(
+        "shuffle.spill.staged_bytes",
+        rows=staged * _sh.exchange_row_bytes(flat),
+    )
+    sink.accept(table, shard_cols, counts)
+
+
+def fetch_relay(
+    ctx, plan, pt_order, mat, pts, relay: np.ndarray
+):
+    """Fetch the relay extraction kernel's output and regroup rows by
+    DESTINATION shard on the host. ``relay`` is the planner's [src, dst]
+    over-quota row matrix — the per-source buffers are destination-major
+    (shuffle.relay_send_slots), so regrouping is pure slicing. Returns
+    ``(per_dst_cols, per_dst_counts)`` where ``per_dst_cols[d]`` holds
+    physical (data, valid) pairs of every row relayed to shard d. Owns
+    the relay fetch sync sites ('spill.fetch_relay')."""
+    from ..table import _fetch
+
+    world = ctx.world_size
+    bump("host_sync")
+    mat_np = np.asarray(_fetch(mat))
+    pts_np = [np.asarray(_fetch(p)) for p in pts]
+    cap = mat_np.shape[0] // world
+    mat_np = mat_np.reshape(world, cap, mat_np.shape[1])
+    pts_np = [p.reshape(world, cap) for p in pts_np]
+    pieces: List[List[list]] = [[] for _ in range(world)]
+    for s in range(world):
+        n_s = int(relay[s].sum())
+        if n_s == 0:
+            continue
+        cols_s = _unpack_host_shard(
+            plan, pt_order, mat_np[s], [p[s] for p in pts_np], n_s
+        )
+        offs = np.concatenate([[0], np.cumsum(relay[s])]).astype(np.int64)
+        for d in range(world):
+            lo, hi = int(offs[d]), int(offs[d + 1])
+            if hi > lo:
+                pieces[d].append(
+                    [
+                        (dd[lo:hi], None if vv is None else vv[lo:hi])
+                        for dd, vv in cols_s
+                    ]
+                )
+    per_dst: List[Optional[list]] = []
+    for d in range(world):
+        if not pieces[d]:
+            per_dst.append(None)
+            continue
+        ncols = len(pieces[d][0])
+        merged = []
+        for ci in range(ncols):
+            data = np.concatenate([p[ci][0] for p in pieces[d]])
+            vs = [p[ci][1] for p in pieces[d]]
+            if any(v is not None for v in vs):
+                valid = np.concatenate(
+                    [
+                        v if v is not None else np.ones(len(p[ci][0]), bool)
+                        for v, p in zip(vs, pieces[d])
+                    ]
+                )
+            else:
+                valid = None
+            merged.append((data, valid))
+        per_dst.append(merged)
+    counts = relay.sum(axis=0).astype(np.int64)
+    bump("shuffle.skew_split", rows=int(counts.sum()))
+    return per_dst, counts
+
+
+def shards_to_table(template, per_shard_cols, counts: np.ndarray):
+    """Rebuild a device table from per-destination-shard PHYSICAL host
+    columns, reusing ``template``'s dtype/dictionary metadata (the relay
+    and arena paths both land here; 'spill.shards_to_table' owns the
+    staging syncs inside ``Table.from_encoded_shards``)."""
+    from ..table import Table
+
+    names = template.column_names
+    cols_meta = [template._columns[n] for n in names]
+    world = template.ctx.world_size
+    shards = []
+    for s in range(world):
+        od = OrderedDict()
+        got = per_shard_cols[s]
+        for ci, name in enumerate(names):
+            meta = cols_meta[ci]
+            if got is None:
+                data = np.empty((0,), np.dtype(meta.data.dtype))
+                valid = None
+            else:
+                data, valid = got[ci]
+            od[name] = (data, valid, meta.dtype, meta.dictionary)
+        shards.append(od)
+    return Table.from_encoded_shards(
+        template.ctx, shards, counts=np.asarray(counts, np.int64)
+    )
+
+
+def arena_result(sink: ShardArenaSink, template):
+    """A spilled shuffle's final device table, rebuilt from the sink's
+    per-shard arenas (tier-1/2 counterpart of the in-HBM round concat)."""
+    per_shard = [a.columns() if a.rows else None for a in sink.arenas]
+    res = shards_to_table(template, per_shard, sink.counts())
+    sink.close()
+    return res

@@ -53,9 +53,16 @@ def gated_fingerprint(plan: Node) -> tuple:
     from ..ops.sketch import enabled as _semi_enabled
     from ..ops.stats import enabled as _pack_enabled
     from ..ordering import enabled as _ord_enabled
+    from ..parallel.spill import gate_state as _spill_gate
 
+    # the spill component carries the forced-tier knob and the skew-split
+    # gate: both are host dispatch policy, but a cached executor's lowered
+    # shuffles re-read them per run THROUGH this identity — a flip must
+    # re-enter the cache, never serve a result staged under the other
+    # tier/schedule regime
     return (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
+        _spill_gate(),
     )
 
 
@@ -348,6 +355,10 @@ class LazyFrame:
 #: decisions, attributable to the node whose execution made them
 _GATE_PREFIXES = (
     "ordering.", "shuffle.semi_filter.", "lane_pack.", "plan.cache.",
+    # the spill planner's per-node decisions: tier engagement and
+    # skew-split relays render beside coll MB on the owning node's line
+    "shuffle.skew_split", "shuffle.spill.shuffles",
+    "shuffle.spill.staged_rounds",
 )
 
 

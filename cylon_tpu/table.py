@@ -51,6 +51,7 @@ from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
 from .ops import stats as _st
 from .parallel import shuffle as _sh
+from .parallel import spill as _spill
 from .obs import trace as _obstrace
 from .utils.tracing import annotate_add, bump, gauge, span
 
@@ -1386,71 +1387,6 @@ class Table:
             out[p] = self.filter(pid == p)
         return out
 
-    def bucket_pack(
-        self,
-        hash_columns: Sequence[Union[str, int]],
-        num_partitions: int,
-        hash_shift: int = 0,
-    ) -> Tuple["Table", np.ndarray]:
-        """Pack rows into contiguous hash-bucket order in ONE program.
-
-        Returns (packed table, bucket counts [shards, k]): rows of bucket p
-        occupy the half-open slice [offsets[p], offsets[p+1]) of each
-        shard's live prefix, offsets = cumsum of that shard's counts row.
-        The spill path of the out-of-core join (parallel/ooc.py) uses this
-        instead of :meth:`hash_partition`: one stable key sort by bucket id
-        (payload columns riding the sort) + one fetch per column lane (+
-        one for the counts) replaces K filter kernels, K count syncs, and
-        K x C per-bucket column fetches — through a remote-attached device
-        the round-trips WERE the spill cost (measured 7.9x on the 16-chunk
-        ooc bench). Same bucket assignment as every shuffle (vectorized
-        murmur3), so packs are consistent across chunks and across the two
-        inputs."""
-        names = self._resolve_cols(hash_columns)
-        kflat = tuple(self._key_hash_cols(names))
-        flat = self._flat_cols()
-        k = int(num_partitions)
-        key = ("bucket_pack", tuple(names), k, len(flat), hash_shift)
-
-        def build():
-            def kern(dp, rep):
-                (kc, cols, counts) = dp
-                n = counts[0]
-                cap = cols[0][0].shape[0]
-                # padding rows already map to bucket k (partition.py); the
-                # shift keeps bucket bits independent of shuffle bits
-                pid = _p.hash_partition_ids(
-                    kc, n, k, hash_shift=hash_shift
-                ).astype(jnp.int32)
-                bcounts = (
-                    jnp.zeros((k + 1,), jnp.int32).at[pid].add(1, mode="drop")
-                )[:k]
-                ride, payloads, heavy = _sort_mod.split_ride_cols(cols)
-                order, spays = _sort_mod.lexsort_rows_payload(
-                    [(pid, None)], n, cap, payloads
-                )
-                heavy_out = (
-                    _g_pack.pack_gather(heavy, order)[0] if heavy else []
-                )
-                out = _sort_mod.merge_ride_cols(cols, ride, spays, heavy_out)
-                return out, bcounts
-
-            return kern
-
-        with span("bucket_pack", rows=self._rows_hint()):
-            out, bcounts = get_kernel(self.ctx, key, build)(
-                (kflat, flat, self.counts_dev), ()
-            )
-            bump("host_sync")
-            bc = _fetch(bcounts).reshape(self.world_size, k).astype(np.int64)
-        tbl = self._rebuild_cols(
-            list(zip(self.column_names, self._columns.values())),
-            out,
-            self._row_counts,
-            self._shard_cap,
-        )
-        return tbl, bc
-
     # ------------------------------------------------------------------
     # join
     # ------------------------------------------------------------------
@@ -1959,6 +1895,21 @@ class Table:
         else:
             join_cap = round_cap(cap_l + cap_r)
         for attempt in range(max_retries):
+            if world > 1:
+                # fused-path exchange accounting: same counter family the
+                # eager planner feeds, so fused and eager regimes compare
+                # like-for-like in BENCH / EXPLAIN (pipeline.py helper)
+                from .parallel.pipeline import fused_exchange_bytes
+
+                bump(
+                    "shuffle.exchanged_bytes",
+                    rows=fused_exchange_bytes(
+                        world, bucket_cap, respill,
+                        _sh.exchange_row_bytes(lflat),
+                        _sh.exchange_row_bytes(rflat),
+                        num_slices,
+                    ),
+                )
             key = (
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
                 bucket_cap, join_cap, respill, num_slices,
@@ -3234,6 +3185,13 @@ class _ShuffleSpec(NamedTuple):
     sketch: Optional[jax.Array] = None
     probe_row: int = 0
     use_range: bool = False
+    # spill tiering (parallel/spill.py): ``sink`` streams the received
+    # rows into a caller-owned host sink (``accept(table, shard_cols,
+    # counts)``) instead of materializing a device result — the unified
+    # out-of-core ingestion path; ``spill_tier`` forces the tier for this
+    # shuffle (None = choose_tier's measured decision)
+    sink: Optional[object] = None
+    spill_tier: Optional[int] = None
 
 
 def _shuffle_state(spec: "_ShuffleSpec") -> dict:
@@ -3402,6 +3360,46 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
 
         return kern
 
+    def build_relay():
+        # skew-split tail extraction (parallel/spill.plan_schedule): rows
+        # past the collective quota of the adaptive schedule leave through
+        # the host relay — packed once into PLAIN int32 lanes (the host
+        # codec ops/gather.host_unpack_cols decodes them; wire narrowing
+        # never applies, the rows do not ride a collective), destination-
+        # major so the host splits per-source buffers with the planner's
+        # own relay counts. Dispatched under the separately-keyed
+        # ("relay",) suffix only when the schedule is adaptive.
+        def kern(dp, rep):
+            if semi:
+                (cols, kcols, counts, sk) = dp
+                (dummy, quota, usef) = rep
+            else:
+                (cols, kcols, counts) = dp
+                (dummy, quota) = rep
+            n = counts[0]
+            pid = compute_pid(cols, kcols, n)
+            if semi:
+                pid = jnp.where(
+                    (usef != 0) & ~probe_ok(cols, sk), world, pid
+                )
+            rc = dummy.shape[0]
+            cnt = _sh.bucket_counts(pid, world)
+            dest = _sh.relay_send_slots(pid, cnt, world, quota, rc)
+            _plan2, lanes, passthrough = _g_pack.pack_cols(list(cols))
+            if lanes:
+                mat = _sh.scatter_send(
+                    jnp.stack(lanes, axis=1), dest, 1, rc
+                )
+            else:
+                mat = jnp.zeros((rc, 0), jnp.int32)
+            pts = tuple(
+                _sh.scatter_send(passthrough[ci], dest, 1, rc)
+                for ci in pt_order
+            )
+            return mat, pts
+
+        return kern
+
     def build_compact():
         def kern(dp, rep):
             wire = st["wire"]
@@ -3430,9 +3428,10 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     st = dict(
         spec=spec, t=t, ctx=ctx, world=world, flat=flat, khash=khash,
         key=key, plan_sig=plan_sig, has_lanes=has_lanes, n_pt=len(pt_order),
-        stat_cols=stat_cols, wire=None, bases=None,
+        pt_order=pt_order, stat_cols=stat_cols, wire=None, bases=None,
         build_count=build_count, build_pack=build_pack,
         build_coll=build_coll, build_compact=build_compact,
+        build_relay=build_relay, pending_spill=None,
     )
     return st
 
@@ -3554,10 +3553,20 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             st["bucket_cap"], st["n_rounds"] = _sh.plan_rounds(
                 st["send_counts"], row_bytes, st["world"], budget
             )
+        # skew-adaptive schedule (parallel/spill.py): re-plan the chosen
+        # counts — non-skewed histograms return plan_rounds' own (cap, K)
+        # with no relay, keeping those plans byte-identical; heavy buckets
+        # shrink the collective rounds to the cold histogram and ship
+        # their over-quota tails through the host relay instead
+        w = st["world"]
+        sched = _spill.plan_schedule(st["send_counts"], row_bytes, w, budget)
+        st["bucket_cap"], st["n_rounds"] = sched.bucket_cap, sched.n_rounds
+        st["sched"] = sched
         # bit-width-adaptive wire narrowing, gated plan-aware like the
-        # semi filter: capacities quantize to powers of two, so the
-        # narrowed codec is used only when it yields a strictly cheaper
-        # round plan (total exchanged bytes) than the plain int32 lanes
+        # semi filter and now schedule-aware: decision cost = global
+        # collective row slots x row bytes + the relay tail's double host
+        # crossing (relay rows always ride the PLAIN codec — they never
+        # touch a collective — so only the collective part narrows)
         if st["col_stats"]:
             stats_list = [None] * len(st["plan_sig"])
             for ci, stat in st["col_stats"].items():
@@ -3565,21 +3574,32 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             wplan = _g_pack.wire_plan(list(st["plan_sig"]), stats_list)
             if wplan is not None:
                 rb_w = _g_pack.wire_row_bytes(wplan)
-                cap_w, k_w = _sh.plan_rounds(
-                    st["send_counts"], rb_w, st["world"], budget
+                sched_w = _spill.plan_schedule(
+                    st["send_counts"], rb_w, w, budget
                 )
-                total_wire = k_w * cap_w * rb_w
-                total_plain = st["n_rounds"] * st["bucket_cap"] * row_bytes
+                relay_rb = _spill.RELAY_COST_FACTOR * row_bytes
+                total_wire = (
+                    sched_w.coll_row_slots(w) * rb_w
+                    + sched_w.relay_rows() * relay_rb
+                )
+                total_plain = (
+                    sched.coll_row_slots(w) * row_bytes
+                    + sched.relay_rows() * relay_rb
+                )
                 if total_wire < total_plain:
                     st["wire"] = wplan
                     st["bases"] = jnp.asarray(
                         _g_pack.wire_bases(wplan, st["col_stats"])
                     )
-                    st["bucket_cap"], st["n_rounds"] = cap_w, k_w
+                    sched = sched_w
+                    st["sched"] = sched
+                    st["bucket_cap"], st["n_rounds"] = (
+                        sched.bucket_cap, sched.n_rounds,
+                    )
                     bump("lane_pack.wire.applied")
                     bump(
                         "lane_pack.wire.bytes_saved",
-                        rows=(total_plain - total_wire) * st["world"],
+                        rows=int(total_plain - total_wire),
                     )
                     gauge(
                         "lane_pack.wire.row_bytes_ratio",
@@ -3589,7 +3609,8 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                     bump("lane_pack.wire.gate_skipped")
         # per-exchange wire accounting for the active query trace: total
         # shipped bytes = K rounds x world^2 bucket blocks x effective
-        # (possibly wire-narrowed) row bytes. Attaches to the innermost
+        # (possibly wire-narrowed) row bytes, plus the plain-codec relay
+        # tail under a skew-split schedule. Attaches to the innermost
         # open span — the owning plan.node.* during lowered execution —
         # so explain(analyze=True) prints per-node coll MB. Host
         # arithmetic only; adds no sync and no dispatch.
@@ -3597,20 +3618,106 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             row_bytes if st["wire"] is None
             else _g_pack.wire_row_bytes(st["wire"])
         )
+        coll_bytes = sched.coll_row_slots(w) * int(rb_eff)
         annotate_add(
-            coll_bytes=int(st["n_rounds"]) * st["world"] * st["world"]
-            * int(st["bucket_cap"]) * int(rb_eff),
+            coll_bytes=coll_bytes,
             shuffle_rounds=int(st["n_rounds"]),
         )
+        bump("shuffle.exchanged_bytes", rows=coll_bytes)
+        if sched.adaptive:
+            relay_bytes = sched.relay_rows() * int(row_bytes)
+            bump("shuffle.spill.relay_bytes", rows=relay_bytes)
+            annotate_add(relay_bytes=relay_bytes)
         st["new_counts"] = st["send_counts"].sum(axis=0).astype(np.int64)
         bump("shuffle.rounds", rows=st["n_rounds"])
         st["rounds_out"] = []
+        # spill-tier decision from the same measured counts: per-shard
+        # staged-output bytes vs the device spill budget (the forced knob
+        # wins; a caller-owned sink implies at least tier 1 — the rows'
+        # destination IS the host)
+        tier = st["spec"].spill_tier
+        if tier is None:
+            staged = int(st["send_counts"].sum(axis=0).max()) * row_bytes
+            tier = _spill.choose_tier(staged)
+        if st["spec"].sink is not None and tier == _spill.TIER_HBM:
+            tier = _spill.TIER_HOST
+        st["tier"] = tier
+        st["src_pairs"] = list(
+            zip(st["t"].column_names, st["t"]._columns.values())
+        )
+        if tier != _spill.TIER_HBM:
+            bump("shuffle.spill.shuffles")
+            gauge("shuffle.spill.tier", tier)
+            if st["spec"].sink is not None:
+                st["sink_obj"] = st["spec"].sink
+            else:
+                names = st["t"].column_names
+                schema = [
+                    (
+                        names[ci],
+                        np.dtype(st["flat"][ci][0].dtype),
+                        bool(st["plan_sig"][ci][2]),
+                    )
+                    for ci in range(len(names))
+                ]
+                st["sink_obj"] = _spill.ShardArenaSink(
+                    w, schema,
+                    _spill.TIER_DISK
+                    if tier == _spill.TIER_DISK
+                    else _spill.TIER_HOST,
+                )
+        # analytic peak-device accounting (per shard, bytes): input +
+        # double-buffered round exchange buffers + staged round outputs
+        # (every round device-resident at tier 0; at most the two-deep
+        # staging window when spilled) + the relay buffer — the number
+        # the spill-smoke CI gate pins against the budget
+        bc = st["bucket_cap"]
+        staged_rounds = (
+            st["n_rounds"]
+            if tier == _spill.TIER_HBM
+            else min(st["n_rounds"], 2)
+        )
+        peak_rows = (
+            st["t"].shard_cap
+            + 2 * w * (bc + _sh.HEADER_ROWS)
+            + staged_rounds * w * bc
+            + sched.relay_cap()
+        )
+        st["dev_peak_bytes"] = peak_rows * row_bytes
+        if tier != _spill.TIER_HBM:
+            st["sink_obj"].device_rows_peak = max(
+                getattr(st["sink_obj"], "device_rows_peak", 0), peak_rows
+            )
+    gauge(
+        "shuffle.spill.peak_device_bytes",
+        sum(st["dev_peak_bytes"] for st in states),
+    )
 
     # phase 2: the double-buffered round loop — all dispatches async, the
-    # single blocking fetch deferred past the last round
+    # single blocking fetch deferred past the last round. Skew-split
+    # relay extractions dispatch FIRST so the one-per-shuffle relay
+    # program overlaps every collective round behind it.
     results: List["Table"] = []
     with span("shuffle.exchange", rows=rows_total):
         t0 = _time.perf_counter()
+        for st in states:
+            if not st["sched"].adaptive:
+                continue
+            rc = st["sched"].relay_cap()
+            rep = (
+                jnp.zeros((rc,), jnp.int8),
+                jnp.asarray(st["sched"].quota, jnp.int32),
+            )
+            dp = (st["flat"], st["khash"], st["t"].counts_dev)
+            if st["spec"].sketch is not None:
+                dp = dp + (st["spec"].sketch,)
+                rep = rep + (
+                    jnp.asarray(1 if st["use_filter"] else 0, jnp.int32),
+                )
+            with span("shuffle.round.relay", rows=st["sched"].relay_rows()):
+                st["relay_out"] = get_kernel(
+                    st["ctx"], st["key"] + ("relay",), st["build_relay"]
+                )(dp, rep)
         for r in range(max(st["n_rounds"] for st in states)):
             for st in states:
                 if r >= st["n_rounds"]:
@@ -3649,7 +3756,38 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                         (head, pts),
                         (st["bases"],) if st["wire"] is not None else (),
                     )
-                st["rounds_out"].append((out, nout))
+                if st["tier"] != _spill.TIER_HBM:
+                    # tier 1/2: this round's compacted output streams into
+                    # the host arena ONE ROUND DEEP — round r is fetched
+                    # only after round r+1's kernels are queued (below,
+                    # AFTER every state's round-r dispatches, so one
+                    # table's staging fetch never stalls its pair
+                    # sibling's dispatches), and at most two round
+                    # outputs are ever resident. The received counts are
+                    # host-known from the plan (same expectation the
+                    # deferred validation uses): staging adds no count
+                    # fetch.
+                    bc = st["bucket_cap"]
+                    expect_r = (
+                        np.clip(st["send_counts"] - r * bc, 0, bc)
+                        .sum(axis=0)
+                        .astype(np.int64)
+                    )
+                    rt = st["t"]._rebuild_cols(
+                        st["src_pairs"], out, expect_r, st["world"] * bc
+                    )
+                    st["spill_fresh"] = (rt, expect_r)
+                    st["rounds_out"].append((None, nout))
+                else:
+                    st["rounds_out"].append((out, nout))
+            for st in states:
+                fresh = st.pop("spill_fresh", None)
+                if fresh is None:
+                    continue
+                prev = st["pending_spill"]
+                st["pending_spill"] = fresh
+                if prev is not None:
+                    _spill.stage_table(st["sink_obj"], *prev)
         t_disp = _time.perf_counter()
 
         # the ONE deferred sync per table: every round's received counts
@@ -3660,8 +3798,9 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         for st in states:
             bump("host_sync")
             t = st["t"]
-            src_pairs = list(zip(t.column_names, t._columns.values()))
+            src_pairs = st["src_pairs"]
             bc = st["bucket_cap"]
+            spilled = st["tier"] != _spill.TIER_HBM
             nouts = [nout for _out, nout in st["rounds_out"]]
             got_all = _fetch(
                 nouts[0] if len(nouts) == 1 else jnp.stack(nouts)
@@ -3679,18 +3818,45 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                         f"shuffle round {r}: received row counts {got} != "
                         f"expected {expect} — internal routing bug"
                     )
-                round_tables.append(
-                    t._rebuild_cols(src_pairs, out, got, st["world"] * bc)
+                if not spilled:
+                    round_tables.append(
+                        t._rebuild_cols(src_pairs, out, got, st["world"] * bc)
+                    )
+            if spilled and st["pending_spill"] is not None:
+                # flush the one-deep staging window
+                pend, st["pending_spill"] = st["pending_spill"], None
+                _spill.stage_table(st["sink_obj"], *pend)
+            # skew-split relay tails: fetched once, regrouped by owner
+            # shard on the host. Spilled shuffles merge them straight into
+            # the arenas; in-HBM shuffles restage them as one extra table
+            # in the round concat.
+            relay_tbl = None
+            if st["sched"].adaptive:
+                per_dst, rcounts = _spill.fetch_relay(
+                    st["ctx"], list(st["plan_sig"]), st["pt_order"],
+                    *st["relay_out"], st["sched"].relay,
                 )
-            res = (
-                round_tables[0]
-                if len(round_tables) == 1
-                else _concat_tables(round_tables)
-            )
-            # compact when the uniform bucket sizing overshot; any input
-            # sortedness is gone — rows arrive source-major per round and
-            # K-round chunks interleave (shuffle.ordering_after_shuffle)
-            res = res._maybe_compact(st["new_counts"], factor=2)
+                if spilled:
+                    st["sink_obj"].accept(t, per_dst, rcounts)
+                else:
+                    relay_tbl = _spill.shards_to_table(t, per_dst, rcounts)
+            if spilled:
+                if st["spec"].sink is not None:
+                    # the rows live in the caller's sink (the unified
+                    # out-of-core ingestion path) — no device result
+                    results.append(None)
+                    continue
+                res = _spill.arena_result(st["sink_obj"], t)
+            else:
+                parts = round_tables + (
+                    [relay_tbl] if relay_tbl is not None else []
+                )
+                res = parts[0] if len(parts) == 1 else _concat_tables(parts)
+                # compact when the uniform bucket sizing overshot; any
+                # input sortedness is gone — rows arrive source-major per
+                # round and K-round chunks interleave
+                # (shuffle.ordering_after_shuffle)
+                res = res._maybe_compact(st["new_counts"], factor=2)
             res._ordering = _sh.ordering_after_shuffle(st["spec"].kind)
             if st["col_stats"]:
                 names = t.column_names

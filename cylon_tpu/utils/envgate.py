@@ -202,6 +202,40 @@ SKETCH_BITS = EnvKnob(
     note="semi-join sketch bit cap (config.py)",
 )
 
+# -- spill tiers (parallel/spill.py; the CYLON_TPU_NO_SKEW_SPLIT kill
+# switch is declared at its consumer module via env_gate) ---------------
+SPILL_TIER = EnvKnob(
+    "CYLON_TPU_SPILL_TIER", "", kind="dispatch",
+    keyed_via="host-side tier selection between the in-HBM round path "
+    "and the arena staging path; staged and in-HBM rounds dispatch the "
+    "same compiled kernels plus the separately-keyed ('spill_pack',) "
+    "fetch program — no program aliasing. The forced tier also rides "
+    "the plan fingerprint (spill.gate_state in plan/lazy.py)",
+    note="force the spill tier: 0=HBM rounds, 1=host-RAM arenas, "
+    "2=disk-backed arenas; empty = decide from the measured counts",
+)
+SPILL_DEVICE_BUDGET = EnvKnob(
+    "CYLON_TPU_SPILL_DEVICE_BUDGET", "", kind="tuning",
+    keyed_via="per-shard staged-output byte threshold for the host-side "
+    "tier decision; reaches no compiled program (staging fetches the "
+    "same round outputs the in-HBM path keeps resident)",
+    note="per-shard staged-output bytes above which shuffle rounds "
+    "spill off-device (unset = never, tier 0 unless forced)",
+)
+SPILL_HOST_BUDGET = EnvKnob(
+    "CYLON_TPU_SPILL_HOST_BUDGET", "", kind="tuning",
+    keyed_via="host arena allocation policy only (RAM vs memmap "
+    "backing); never reaches a compiled program",
+    note="total live host-arena bytes above which arena growth promotes "
+    "to disk-backed buffers (tier 1 -> tier 2)",
+)
+SPILL_DIR = EnvKnob(
+    "CYLON_TPU_SPILL_DIR", "", kind="tuning",
+    keyed_via="filesystem location of tier-2 memmap files only; never "
+    "reaches a compiled program",
+    note="directory for tier-2 disk-spill arenas (default: a tempdir)",
+)
+
 # -- query serving (cylon_tpu/serve) -----------------------------------
 # All three are host-resolved admission/batching knobs read per call in
 # the scheduler (flips take effect on the next submit/drain cycle); none

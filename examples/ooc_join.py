@@ -1,12 +1,15 @@
 """Out-of-core join: inputs bigger than the device budget, streamed in
-chunks (parallel/ooc.py — Grace-style partitioned dag join).
+chunks (parallel/ooc.py — a thin wrapper over the unified spill-tiered
+shuffle planner, parallel/spill.py).
 
 Reference analog: the byte-chunked streaming shuffle
 (arrow/arrow_all_to_all.cpp) + DisJoinOP, whose purpose is joining tables
 that exceed memory. XLA programs are static-shaped, so the TPU-native
-equivalent hash-partitions each chunk into K buckets on device, spills the
-buckets to the host arena, and joins bucket pairs one at a time — device
-memory stays bounded by chunk + bucket size no matter how large the inputs.
+equivalent pushes each chunk through the chunked shuffle engine (rows
+hash-route to their owner shard, received rounds spill to host arenas
+binned by a sub-bucket lane) and joins bucket pairs one at a time —
+device memory stays bounded by chunk + bucket size no matter how large
+the inputs.
 
 Run locally on a virtual CPU mesh:
 

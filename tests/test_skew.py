@@ -179,14 +179,16 @@ def test_chunked_all_rows_to_one_shard(devices, k):
     _cap, expect_rounds = _sh.plan_rounds(counts, row_bytes, world, budget)
 
     # the subject is the chunking engine's round arithmetic over PLAIN
-    # int32 lanes: run under the lane-packing oracle so the wire-narrowed
-    # codec (whose smaller row bytes legitimately need fewer rounds)
-    # doesn't shift the pinned round count — test_lane_pack.py covers the
-    # narrowed plans
+    # int32 lanes under the PADDED plan: run under the lane-packing
+    # oracle (the wire-narrowed codec's smaller row bytes legitimately
+    # need fewer rounds — test_lane_pack.py covers those plans) AND the
+    # skew-split oracle (the adaptive schedule legitimately collapses the
+    # one-hot round count — test_skew_split_* pins that behavior)
     from cylon_tpu.ops import stats as _lp
+    from cylon_tpu.parallel import spill as _sp
 
     reset_trace()
-    with _lp.disabled():
+    with _lp.disabled(), _sp.skew_disabled():
         s = t.shuffle(["k"], byte_budget=budget)
     got_rounds = int(report("shuffle.")["shuffle.rounds"]["rows"])
     assert got_rounds == expect_rounds
@@ -238,6 +240,111 @@ def test_chunked_empty_shard_skew(devices, k):
     bp = base.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
     assert np.array_equal(sp["k"].to_numpy(), bp["k"].to_numpy())
     assert np.allclose(sp["v"].to_numpy(), bp["v"].to_numpy())
+
+
+def test_skew_split_one_hot_adaptive(devices):
+    """Satellite pin (ISSUE 10): the skew-adaptive schedule splits the
+    one-hot hot bucket onto the host relay — the traced
+    ``shuffle.skew_split`` counter fires, total shipped bytes (collective
+    + relay) land >= 40% below the padded plan's, and the output matches
+    the padded-plan oracle exactly. Runs with lane packing ENABLED: the
+    old skew pins ran only under the lane-pack oracle."""
+    from cylon_tpu.parallel import spill as _sp
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    n = 2048
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": np.zeros(n, np.int32),
+         "v": np.arange(n, dtype=np.float32)},
+    )
+    reset_trace()
+    s = t.shuffle(["k"])
+    r = report("shuffle.")
+    assert r["shuffle.skew_split"]["count"] >= 1
+    assert int(r["shuffle.skew_split"]["rows"]) > 0
+    adaptive_bytes = int(r["shuffle.exchanged_bytes"]["rows"]) + int(
+        r["shuffle.spill.relay_bytes"]["rows"]
+    )
+    reset_trace()
+    with _sp.skew_disabled():
+        base = t.shuffle(["k"])
+    rb = report("shuffle.")
+    assert "shuffle.skew_split" not in rb
+    padded_bytes = int(rb["shuffle.exchanged_bytes"]["rows"])
+    # the acceptance bar: >= 40% fewer shipped bytes at 8-way one-hot
+    assert adaptive_bytes <= 0.6 * padded_bytes, (
+        adaptive_bytes, padded_bytes,
+    )
+    assert s.row_count == n
+    assert (s.row_counts == base.row_counts).all()
+    assert np.array_equal(
+        np.sort(s.to_pandas()["v"].to_numpy()),
+        np.sort(base.to_pandas()["v"].to_numpy()),
+    )
+
+
+def test_skew_split_non_skewed_plans_byte_identical(devices):
+    """Satellite pin: a NON-skewed histogram must plan byte-identically
+    with the skew gate on or off — same (cap, K), same exchanged bytes,
+    no relay counter — so the adaptive planner provably costs nothing on
+    the plans the padded engine already handled well."""
+    from cylon_tpu.parallel import spill as _sp
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = _ctx8(devices)
+    rng = np.random.default_rng(9)
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 997, 4096).astype(np.int32),
+         "v": rng.normal(size=4096).astype(np.float32)},
+    )
+    reset_trace()
+    s_on = t.shuffle(["k"])
+    r_on = report("shuffle.")
+    reset_trace()
+    with _sp.skew_disabled():
+        s_off = t.shuffle(["k"])
+    r_off = report("shuffle.")
+    assert "shuffle.skew_split" not in r_on
+    assert "shuffle.spill.relay_bytes" not in r_on
+    assert (
+        r_on["shuffle.exchanged_bytes"]["rows"]
+        == r_off["shuffle.exchanged_bytes"]["rows"]
+    )
+    assert r_on["shuffle.rounds"]["rows"] == r_off["shuffle.rounds"]["rows"]
+    assert (s_on.row_counts == s_off.row_counts).all()
+    assert s_on.shard_cap == s_off.shard_cap
+
+
+def test_skew_split_schedule_planner_units():
+    """plan_schedule host arithmetic: one-hot splits (quota + relay cover
+    every bucket exactly), uniform stays the plan_rounds identity, and
+    the marginal-skew guard keeps the padded plan."""
+    from cylon_tpu.parallel import shuffle as _sh
+    from cylon_tpu.parallel import spill as _sp
+
+    world, rb = 8, 8
+    budget = 1 << 40
+    # one-hot: every source sends 256 rows to destination 0
+    m = np.zeros((world, world), np.int64)
+    m[:, 0] = 256
+    sched = _sp.plan_schedule(m, rb, world, budget)
+    assert sched.adaptive
+    shipped = np.minimum(m, sched.quota) + sched.relay
+    assert (shipped == m).all()  # relay + quota cover every bucket
+    base_cap, base_k = _sh.plan_rounds(m, rb, world, budget)
+    assert sched.coll_row_slots(world) < base_k * base_cap * world * world
+    # uniform: byte-identical passthrough of plan_rounds
+    u = np.full((world, world), 64, np.int64)
+    su = _sp.plan_schedule(u, rb, world, budget)
+    cap_u, k_u = _sh.plan_rounds(u, rb, world, budget)
+    assert (su.bucket_cap, su.n_rounds, su.relay) == (cap_u, k_u, None)
+    # mild skew below the savings bar: stays padded
+    mild = np.full((world, world), 64, np.int64)
+    mild[0, 0] = 96
+    assert not _sp.plan_schedule(mild, rb, world, budget).adaptive
 
 
 def test_shuffle_jit_cache_stable(devices):

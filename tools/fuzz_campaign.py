@@ -881,6 +881,81 @@ def serve_round_once(seed) -> bool:
     return ok
 
 
+def spill_round_once(seed) -> bool:
+    """Spill-tier rounds (ISSUE 10): random (world, forced tier 1/2 or
+    measured auto-tier, chunking K, skew profile, dtype) push join + sort
+    + shuffle through the spill-tiered planner and assert exact equality
+    with the in-core tier-0 run (and transitively pandas — the tier-0
+    path is the default profile's subject). The skew-split schedule runs
+    LIVE here; ~half the rounds also flip the CYLON_TPU_NO_SKEW_SPLIT
+    oracle to pin padded-vs-adaptive equality under random histograms."""
+    from cylon_tpu.parallel import shuffle as _sh
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(100, max(MAX_N, 101)))
+    keyspace = int(rng.integers(2, 200))
+    world = int(rng.choice([1, 4, 8]))
+    tier = int(rng.choice([0, 1, 2]))  # 0 = auto via tiny device budget
+    dtype = str(rng.choice(["int32", "int64", "str"]))
+    skew = str(rng.choice(["none", "one_hot", "hot_key"]))
+    k_target = int(rng.choice([1, 4, 16]))
+    oracle_skew = bool(rng.random() < 0.5)
+    params = dict(seed=seed, profile="spill", n=n, keyspace=keyspace,
+                  world=world, tier=tier, dtype=dtype, skew=skew,
+                  k_target=k_target, oracle_skew=oracle_skew)
+    ctx = ctx_for(world)
+
+    ldf = rand_frame(rng, n, keyspace, dtype, 0.0)
+    rdf = rand_frame(rng, max(n // 2, 30), keyspace, dtype, 0.0, vname="w")
+    karr = ldf["k"].to_numpy(copy=True)
+    hot = karr[0]
+    if skew == "one_hot":
+        karr[:] = hot
+        ldf["k"] = karr
+    elif skew == "hot_key":
+        karr[rng.random(n) < 0.6] = hot
+        ldf["k"] = karr
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    max_bucket = max(int(lt.row_counts.max()), 1)
+    budget = _sh.budget_for_rounds(
+        max_bucket, k_target, world, _sh.exchange_row_bytes(lt._flat_cols())
+    )
+
+    base_join = lt.distributed_join(rt, on="k", how="inner").to_pandas()
+    base_sort = lt.distributed_sort("k").to_pandas()["k"]
+    base_shuf = lt.shuffle(["k"], byte_budget=budget).to_pandas()
+
+    env = {"CYLON_TPU_SHUFFLE_BUDGET": str(budget)}
+    if tier == 0:
+        env["CYLON_TPU_SPILL_DEVICE_BUDGET"] = "64"
+    else:
+        env["CYLON_TPU_SPILL_TIER"] = str(tier)
+    if oracle_skew:
+        env["CYLON_TPU_NO_SKEW_SPLIT"] = "1"
+    prev = {k: os.environ.get(k) for k in env}
+    for k, v in env.items():
+        os.environ[k] = v
+    try:
+        got_join = lt.distributed_join(rt, on="k", how="inner").to_pandas()
+        got_sort = lt.distributed_sort("k").to_pandas()["k"]
+        got_shuf = lt.shuffle(["k"], byte_budget=budget).to_pandas()
+    finally:
+        for k, p in prev.items():
+            if p is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = p
+    ok = check(got_join, base_join, "spill/join", params)
+    ok &= check(got_shuf, base_shuf, "spill/shuffle", params)
+    if not np.array_equal(
+        np.asarray(got_sort.map(canon)), np.asarray(base_sort.map(canon))
+    ):
+        print(f"MISMATCH spill/sort params={params}", flush=True)
+        ok = False
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -890,7 +965,8 @@ def main():
                          "respill/overflow/capacity-retry paths)")
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
-                             "ordering", "semi", "packing", "serve"],
+                             "ordering", "semi", "packing", "serve",
+                             "spill"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -906,7 +982,9 @@ def main():
                          "CYLON_TPU_NO_SEMI_FILTER=1 oracle; 'serve': "
                          "random binding sets / batch sizes through the "
                          "stacked serving batch path vs the serial "
-                         "collect() oracle")
+                         "collect() oracle; 'spill': forced/auto spill "
+                         "tiers 1-2 + skew-split schedules (random world/"
+                         "K/skew/dtype) vs the in-core tier-0 oracle")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -915,7 +993,8 @@ def main():
           "ordering": ordering_round_once,
           "semi": semi_round_once,
           "packing": packing_round_once,
-          "serve": serve_round_once}.get(args.profile, round_once)
+          "serve": serve_round_once,
+          "spill": spill_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
