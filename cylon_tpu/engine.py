@@ -178,6 +178,11 @@ class PlanEntry(NamedTuple):
     fired: Tuple[str, ...]    # optimizer rule firings
     fn: Callable              # executor: fn(tables) -> Table
     hist_key: str             # fingerprint_key(fingerprint), precomputed
+    #: observation-store profile key (plan/feedback.base_key over the
+    #: BASE fingerprint — the identity WITHOUT the tuned-decision
+    #: component, so a decision flip keeps feeding the same profile);
+    #: "" when the plan layer predates/skips the store
+    obs_key: str = ""
 
 
 def plan_executable(ctx: CylonContext, fingerprint, compile_fn):

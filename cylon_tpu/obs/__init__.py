@@ -1,7 +1,7 @@
 """Query-scoped telemetry (ISSUE 8): span trees, sync-free device timing,
 a metrics registry with plan-fingerprint latency histograms, and exporters.
 
-Three modules, layered bottom-up:
+Four modules, layered bottom-up:
 
 - :mod:`.metrics` — the process-global ROLLUP (the old ``utils/tracing``
   aggregate: {name: count/total/max/rows}, always on, lock-serialized)
@@ -16,13 +16,17 @@ Three modules, layered bottom-up:
 - :mod:`.export` — the bounded flight-recorder ring of the last N query
   traces and the Chrome trace-event (Perfetto-loadable) exporter, one
   track per query.
+- :mod:`.store` — the PERSISTENT observation journal (ISSUE 11):
+  per-fingerprint profiles surviving across runs under
+  ``CYLON_TPU_OBS_DIR``, the evidence the feedback re-coster
+  (``plan/feedback.py``) tunes the engine's adaptive gates from.
 
 ``utils/tracing.py`` is the thin compat shim over this package: every
 pre-existing call site (``span``/``bump``/``gauge``/``report``/...)
 keeps working, and the process-global rollup keeps feeding the
 graft-lint plan registry (``analysis/plans.py``) unchanged.
 """
-from . import export, metrics, trace  # noqa: F401
+from . import export, metrics, store, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     fingerprint_key,
     latency_quantiles,
@@ -49,6 +53,7 @@ __all__ = [
     "metrics",
     "observe_latency",
     "query_trace",
+    "store",
     "trace",
     "traces",
     "tracing_active",

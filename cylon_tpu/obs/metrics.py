@@ -36,8 +36,10 @@ from __future__ import annotations
 import hashlib
 import math
 import threading
-from collections import defaultdict
+from collections import OrderedDict, defaultdict
 from typing import Dict, Optional, Tuple
+
+from ..utils import envgate as _eg
 
 _lock = threading.Lock()
 
@@ -144,8 +146,27 @@ class Histogram:
         return self.max_s
 
 
-_HISTS: Dict[str, Histogram] = {}
+#: the in-process histogram registry is BOUNDED: a serving process
+#: answering a million distinct fingerprints must not grow host memory
+#: without limit. LRU order = last observation; capacity scales with the
+#: flight-ring knob (the one "how much observability state" dial) at
+#: HIST_CAP_PER_RING entries per ring slot, floored at HIST_CAP_MIN.
+#: Evicted histograms flush to the persistent observation store when one
+#: is configured (obs/store.py) — bounding memory never loses a sample.
+_HISTS: "OrderedDict[str, Histogram]" = OrderedDict()
 _HIST_LABELS: Dict[str, str] = {}
+HIST_CAP_PER_RING = 16
+HIST_CAP_MIN = 256
+
+
+def hist_capacity() -> int:
+    """Max in-process latency-histogram keys, derived from
+    CYLON_TPU_TRACE_RING (read per miss — resizable without restart)."""
+    try:
+        ring = int(_eg.TRACE_RING.get())
+    except ValueError:
+        ring = 64
+    return max(HIST_CAP_PER_RING * max(ring, 1), HIST_CAP_MIN)
 
 
 def fingerprint_key(fingerprint) -> str:
@@ -165,14 +186,31 @@ def fingerprint_key(fingerprint) -> str:
 
 def observe_latency(key: str, seconds: float, label: str = "") -> None:
     """Record one query latency under ``key`` (a fingerprint_key, or any
-    caller-chosen stable name, e.g. a benchmark row)."""
+    caller-chosen stable name, e.g. a benchmark row). A NEW key past
+    :func:`hist_capacity` LRU-evicts the coldest entries; evicted
+    histograms flush to the observation store (outside the lock) so no
+    observation is lost when one is configured."""
+    evicted = []
     with _lock:
         h = _HISTS.get(key)
         if h is None:
+            cap = hist_capacity()
+            while len(_HISTS) >= cap:
+                k2, h2 = _HISTS.popitem(last=False)
+                evicted.append((k2, h2, _HIST_LABELS.pop(k2, "")))
             h = _HISTS[key] = Histogram()
+        else:
+            _HISTS.move_to_end(key)
         if label and key not in _HIST_LABELS:
             _HIST_LABELS[key] = label
         h.record(seconds)
+    if evicted:
+        rollup_count("obs.hist.evicted", rows=len(evicted))
+        from . import store as _obstore
+
+        if _obstore.store() is not None:
+            for k2, h2, lb in evicted:
+                _obstore.absorb_histogram(k2, h2, lb)
 
 
 def latency_quantiles(key: str) -> Optional[Dict[str, float]]:
@@ -270,6 +308,12 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "shed / backpressure.wait / budget_overflow / batches / singles "
         "counters; batch_cache.hit/miss; serve.stack span"),
     "query.": ("mixed", "query-level rollup: query.traces recorded"),
+    "autotune.": (
+        "counter", "feedback re-coster applications (plan/feedback.py): "
+        "semi_forced / semi_skipped / tier_promoted"),
+    "obs.": (
+        "counter", "obs-layer internals: hist.evicted (bounded histogram "
+        "registry LRU evictions, rows=entries flushed)"),
     "overhead.": ("span", "trace_smoke calibration probes (tools only)"),
 }
 

@@ -1,0 +1,616 @@
+"""The persistent observation store: a per-context journal of what the
+obs layer measures, keyed by plan fingerprint, surviving across runs.
+
+PR 8 made the engine observable — per-node wall/rows/coll-MB, every gate
+decision (semi-filter selectivity, wire-plan engage, spill tier, skew
+split, serve batch B), plan-fingerprint latency histograms — but only
+in-process: every restart forgets what the last million queries taught.
+This module persists those observations so the feedback re-coster
+(``plan/feedback.py``) can override the engine's static heuristics from
+measured data (ROADMAP open item 4; Exoshuffle's thesis that runtime
+statistics should re-plan what a fixed pipeline cannot).
+
+LAYOUT (under ``CYLON_TPU_OBS_DIR``; unset = the store is disabled and
+every hook here is a cheap no-op):
+
+``journal.jsonl``
+    Append-only, one JSON record per line. Crash-tolerant by design: a
+    torn or truncated tail line (the process died mid-write) is skipped
+    on load — a journal is evidence, never a source of truth that can
+    brick a deployment. Records: ``exec`` (one per plan execution: the
+    shuffle planner's measured counts, gate decisions, selectivity),
+    ``lat`` (one per resolved query latency — the device-resolved wall
+    the histogram substrate observes), ``trace`` (per-node wall/rows/
+    coll bytes from a finished query trace), ``hist`` (an in-process
+    latency histogram evicted by the bounded registry in
+    :mod:`.metrics` — flushed here so no observation is lost).
+
+``snapshot.json``
+    The compacted store: bounded per-fingerprint PROFILES (count,
+    geometric latency buckets -> p50/p99, mean selectivity, observed
+    bytes/row, hottest bucket, staged bytes, per-node aggregates) plus
+    the current tuned decisions and their hysteresis state. Every
+    ``COMPACT_EVERY`` journal records the journal folds into the
+    snapshot (atomic tmp+rename) and truncates, so neither file grows
+    unboundedly; profiles themselves are O(buckets), never O(samples),
+    and the profile set is LRU-bounded (``PROFILE_CAP``).
+
+KEYING: profiles are keyed by the plan's BASE gated fingerprint — the
+structural fingerprint plus the ordering/semi/lane-pack/spill gate
+states, WITHOUT the feedback component (``plan/feedback.base_key``).
+The tuned decisions must not fragment their own evidence: a decision
+flip changes the full executable fingerprint (recompile) but keeps
+feeding the same profile.
+
+THREADING + SYNC DISCIPLINE: all mutation is lock-serialized; the store
+is host-only file I/O and dict math — it never touches the device, never
+fetches, and adds zero host syncs to any budgeted path (the hooks ride
+data the engine already holds on the host).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+from contextvars import ContextVar
+from typing import Any, Dict, List, Optional
+
+from ..utils import envgate as _eg
+
+#: journal records folded into the snapshot per compaction cycle; the
+#: journal never holds more than this many lines plus the torn tail
+COMPACT_EVERY = 256
+#: bounded per-fingerprint profile set (LRU by last observation)
+PROFILE_CAP = 512
+#: bounded evicted-histogram set carried in the snapshot
+HIST_CAP = 1024
+#: latency buckets per decade — matches obs.metrics so merged histograms
+#: stay exact
+BUCKETS_PER_DECADE = 24
+
+_lock = threading.RLock()
+_STORES: Dict[str, "ObsStore"] = {}
+
+
+def store() -> Optional["ObsStore"]:
+    """The process's store for the current ``CYLON_TPU_OBS_DIR`` (read
+    per call — flips take effect on the next observation), or None when
+    the knob is unset (everything downstream no-ops)."""
+    d = _eg.OBS_DIR.get()
+    if not d:
+        return None
+    s = _STORES.get(d)
+    if s is None:
+        with _lock:
+            s = _STORES.get(d)
+            if s is None:
+                s = ObsStore(d)
+                _STORES[d] = s
+    return s
+
+
+def reset_stores() -> None:
+    """Drop every open store handle (tests; the files stay on disk)."""
+    with _lock:
+        for s in _STORES.values():
+            s.close()
+        _STORES.clear()
+
+
+# ----------------------------------------------------------------------
+# profile schema + latency-bucket math (mirrors obs.metrics.Histogram)
+# ----------------------------------------------------------------------
+def new_profile() -> Dict[str, Any]:
+    return {
+        "n": 0,              # exec observations
+        "world": 0,
+        "row_bytes": 0,      # last observed exchange row bytes
+        "hot": 0,            # max observed hottest-bucket rows
+        "mean_bucket": 0,    # last observed mean bucket rows
+        "staged_max": 0,     # max observed per-shard staged bytes
+        "tier_max": 0,       # highest spill tier observed
+        "budget": 0,         # last effective shuffle byte budget
+        "coll_sum": 0,       # total collective bytes shipped
+        "rounds_sum": 0,
+        "wire_n": 0,         # wire-narrowing engagements
+        "relay_n": 0,        # skew-split relays
+        "sel_sum": 0.0,      # semi-filter selectivity accumulator
+        "sel_n": 0,
+        "sketch_built": 0,
+        "payoff_skip": 0,    # static size gate declined the sketch
+        "static_budget": 0,  # the ctx's untuned budget (proposal baseline)
+        "lat": _new_lat(),
+        # serving-only latency window (samples carrying a batch size B):
+        # the serve-bucket proposer judges THIS, never the pooled `lat`,
+        # which also holds serial collect latencies no bucket can change
+        "serve_lat": _new_lat(),
+        "serve_b": {},       # str(B) -> count of batched resolutions
+        "nodes": {},         # node name -> [count, wall_ms, rows, coll]
+        "dec": {},           # tuned decisions (plan/feedback.py)
+        "pend": {},          # hysteresis: field -> [candidate, streak]
+        "flips": 0,
+        "seq": 0,            # LRU clock
+    }
+
+
+def _new_lat() -> Dict[str, Any]:
+    return {"b": {}, "n": 0, "total": 0.0, "min": None, "max": 0.0}
+
+
+def lat_record(lat: Dict[str, Any], seconds: float) -> None:
+    s = max(float(seconds), 1e-9)
+    b = str(int(math.floor(math.log10(s) * BUCKETS_PER_DECADE)))
+    lat["b"][b] = lat["b"].get(b, 0) + 1
+    lat["n"] += 1
+    lat["total"] += s
+    lat["min"] = s if lat["min"] is None else min(lat["min"], s)
+    lat["max"] = max(lat["max"], s)
+
+
+def lat_quantile(lat: Dict[str, Any], q: float) -> float:
+    """Upper bucket edge holding the q-quantile, clamped to [min, max] —
+    the same read-off rule as obs.metrics.Histogram.quantile."""
+    n = lat.get("n", 0)
+    if not n:
+        return 0.0
+    target = q * n
+    acc = 0
+    for b in sorted(lat["b"], key=int):
+        acc += lat["b"][b]
+        if acc >= target:
+            edge = 10.0 ** ((int(b) + 1) / BUCKETS_PER_DECADE)
+            lo = lat["min"] if lat["min"] is not None else edge
+            return min(max(edge, lo), lat["max"])
+    return lat["max"]
+
+
+def lat_merge(into: Dict[str, Any], other: Dict[str, Any]) -> None:
+    for b, c in other.get("b", {}).items():
+        into["b"][b] = into["b"].get(b, 0) + c
+    into["n"] += other.get("n", 0)
+    into["total"] += other.get("total", 0.0)
+    om = other.get("min")
+    if om is not None:
+        into["min"] = om if into["min"] is None else min(into["min"], om)
+    into["max"] = max(into["max"], other.get("max", 0.0))
+
+
+# ----------------------------------------------------------------------
+# the store
+# ----------------------------------------------------------------------
+class ObsStore:
+    """One observation directory: profiles + journal + compaction."""
+
+    def __init__(self, directory: str, compact_every: int = COMPACT_EVERY):
+        self.dir = directory
+        self.compact_every = int(compact_every)
+        self.journal_path = os.path.join(directory, "journal.jsonl")
+        self.snapshot_path = os.path.join(directory, "snapshot.json")
+        self._lock = threading.RLock()
+        self._jf = None
+        self._jlines = 0
+        self._since_flush = 0
+        self._rec_seq = 0   # monotone journal record id (replay dedup)
+        self._seq = 0
+        self.profiles: Dict[str, Dict[str, Any]] = {}
+        self.hists: Dict[str, Dict[str, Any]] = {}
+        self.skipped_lines = 0  # torn/garbled journal lines on load
+        self._load()
+
+    # -- load / persistence --------------------------------------------
+    def _load(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        jseq = 0
+        try:
+            with open(self.snapshot_path) as f:
+                snap = json.load(f)
+            self.profiles = dict(snap.get("profiles", {}))
+            self.hists = dict(snap.get("hists", {}))
+            jseq = int(snap.get("jseq", 0))
+        except (OSError, ValueError):
+            pass  # no/garbled snapshot: profiles rebuild from the journal
+        self._seq = max(
+            [p.get("seq", 0) for p in self.profiles.values()] + [0]
+        )
+        self._rec_seq = jseq
+        # replay the journal, skipping torn/truncated lines: a crash
+        # mid-append must cost at most the records after the last
+        # complete line, never the store. Records whose id is already
+        # covered by the snapshot's jseq are skipped too — a crash in the
+        # window between compact()'s snapshot rename and its journal
+        # truncate must not double-absorb the folded records.
+        try:
+            with open(self.journal_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        self.skipped_lines += 1
+                        continue
+                    if not isinstance(rec, dict):
+                        self.skipped_lines += 1
+                        continue
+                    i = rec.get("i")
+                    if isinstance(i, int):
+                        if i <= jseq:
+                            continue  # already folded into the snapshot
+                        self._rec_seq = max(self._rec_seq, i)
+                    self._absorb(rec)
+                    self._jlines += 1
+        except OSError:
+            pass
+        # prime the decision caches for the feedback layer
+        from ..plan import feedback as _fb
+
+        for p in self.profiles.values():
+            p["_dec"] = _fb.effective_decisions(p)
+
+    def _journal_file(self):
+        if self._jf is None:
+            self._jf = open(self.journal_path, "a")
+        return self._jf
+
+    #: journal appends ride OS buffering; an explicit flush happens every
+    #: FLUSH_EVERY records (+ close/compact), bounding both the syscall
+    #: load on the query-resolution hot path and the crash-loss window —
+    #: an unflushed tail is exactly the torn-line case the loader skips
+    FLUSH_EVERY = 32
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        """Absorb one observation record into its profile AND append it
+        to the journal; compacts past ``compact_every`` records."""
+        with self._lock:
+            self._rec_seq += 1
+            rec.setdefault("i", self._rec_seq)
+            self._absorb(rec)
+            try:
+                jf = self._journal_file()
+                jf.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                self._since_flush += 1
+                if self._since_flush >= self.FLUSH_EVERY:
+                    jf.flush()
+                    self._since_flush = 0
+            except OSError:
+                return  # a full/readonly volume must never fail a query
+            self._jlines += 1
+            if self._jlines >= self.compact_every:
+                self.compact()
+
+    def compact(self) -> None:
+        """Fold the journal into the snapshot (atomic tmp+rename) and
+        truncate it; bounds both files."""
+        with self._lock:
+            self._evict()
+            tmp = self.snapshot_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {"v": 1, "jseq": self._rec_seq,
+                         "profiles": self._persistable(),
+                         "hists": self.hists},
+                        f, separators=(",", ":"),
+                    )
+                os.replace(tmp, self.snapshot_path)
+                if self._jf is not None:
+                    self._jf.close()
+                    self._jf = None
+                open(self.journal_path, "w").close()
+                self._jlines = 0
+                self._since_flush = 0
+            except OSError:
+                with contextlib.suppress(OSError):
+                    os.unlink(tmp)
+
+    def _persistable(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            fp: {k: v for k, v in p.items() if not k.startswith("_")}
+            for fp, p in self.profiles.items()
+        }
+
+    def _evict(self) -> None:
+        while len(self.profiles) > PROFILE_CAP:
+            oldest = min(
+                self.profiles, key=lambda fp: self.profiles[fp].get("seq", 0)
+            )
+            del self.profiles[oldest]
+        while len(self.hists) > HIST_CAP:
+            self.hists.pop(next(iter(self.hists)))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._jf is not None:
+                with contextlib.suppress(OSError):
+                    self._jf.close()
+                self._jf = None
+
+    # -- absorption ----------------------------------------------------
+    def _profile(self, fp: str) -> Dict[str, Any]:
+        p = self.profiles.get(fp)
+        if p is None:
+            p = self.profiles[fp] = new_profile()
+            # stamp the LRU clock at creation: a freshly-admitted profile
+            # must never be the eviction victim of its own admission
+            self._seq += 1
+            p["seq"] = self._seq
+            if len(self.profiles) > PROFILE_CAP:
+                self._evict()
+        return p
+
+    def _absorb(self, rec: Dict[str, Any]) -> None:
+        kind = rec.get("k")
+        if kind == "hist":
+            h = self.hists.get(rec.get("key", ""))
+            lat = {
+                "b": rec.get("b", {}), "n": rec.get("n", 0),
+                "total": rec.get("total", 0.0), "min": rec.get("min"),
+                "max": rec.get("max", 0.0),
+            }
+            if h is None:
+                self.hists[rec.get("key", "")] = {
+                    "label": rec.get("label", ""), **lat,
+                }
+            else:
+                lat_merge(h, lat)
+            return
+        fp = rec.get("fp")
+        if not fp:
+            return
+        p = self._profile(fp)
+        if kind == "exec":
+            p["n"] += 1
+            if rec.get("world"):
+                p["world"] = int(rec["world"])
+            if rec.get("row_bytes"):
+                p["row_bytes"] = int(rec["row_bytes"])
+            p["hot"] = max(p["hot"], int(rec.get("hot", 0)))
+            if rec.get("mean_bucket"):
+                p["mean_bucket"] = int(rec["mean_bucket"])
+            p["staged_max"] = max(p["staged_max"], int(rec.get("staged", 0)))
+            p["tier_max"] = max(p["tier_max"], int(rec.get("tier", 0)))
+            if rec.get("budget"):
+                p["budget"] = int(rec["budget"])
+            p["coll_sum"] += int(rec.get("coll", 0))
+            p["rounds_sum"] += int(rec.get("rounds", 0))
+            p["wire_n"] += 1 if rec.get("wire") else 0
+            p["relay_n"] += 1 if rec.get("relay") else 0
+            if rec.get("static_budget"):
+                p["static_budget"] = int(rec["static_budget"])
+            sels = rec.get("sel")
+            if sels:
+                for s in sels:
+                    p["sel_sum"] += float(s)
+                    p["sel_n"] += 1
+            p["sketch_built"] += int(rec.get("sketch_built", 0))
+            p["payoff_skip"] += int(rec.get("payoff_skip", 0))
+        elif kind == "lat":
+            lat_record(p["lat"], float(rec.get("s", 0.0)))
+            b = rec.get("b")
+            if b:
+                key = str(int(b))
+                p["serve_b"][key] = p["serve_b"].get(key, 0) + 1
+                lat_record(
+                    p.setdefault("serve_lat", _new_lat()),
+                    float(rec.get("s", 0.0)),
+                )
+        elif kind == "trace":
+            for name, wall_ms, rows, coll in rec.get("nodes", []):
+                agg = p["nodes"].setdefault(name, [0, 0.0, 0, 0])
+                agg[0] += 1
+                agg[1] += float(wall_ms)
+                agg[2] += int(rows)
+                agg[3] += int(coll)
+        else:
+            return
+        self._seq += 1
+        p["seq"] = self._seq
+        # re-cost the tuned decisions from the updated evidence (the
+        # hysteresis machinery lives with the proposers in plan/feedback).
+        # The record KIND scopes which gates re-propose, so a hysteresis
+        # streak counts gate-RELEVANT observations: one exec record per
+        # query for the shuffle-side gates, one latency sample for the
+        # serve bucket — never both for one query, and trace records
+        # advance nothing.
+        if kind in ("exec", "lat"):
+            from ..plan import feedback as _fb
+
+            _fb.update_profile_decisions(p, kind)
+
+    # -- read side ------------------------------------------------------
+    def dec_tuple(self, fp: str) -> Optional[tuple]:
+        """The profile's cached effective-decision tuple (Decisions field
+        order) — a lock-free GIL-atomic read for the fingerprint hot
+        path; None when the fingerprint has no profile yet."""
+        p = self.profiles.get(fp)
+        if p is None:
+            return None
+        return p.get("_dec")
+
+    def profile_snapshot(self, fp: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            p = self.profiles.get(fp)
+            if p is None:
+                return None
+            return json.loads(json.dumps(
+                {k: v for k, v in p.items() if not k.startswith("_")}
+            ))
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        """{fingerprint: flat profile summary} — the traceview
+        --profiles / --diff substrate."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for fp, p in self.profiles.items():
+                lat = p["lat"]
+                out[fp] = {
+                    "n": p["n"],
+                    "lat_n": lat["n"],
+                    "p50_ms": lat_quantile(lat, 0.50) * 1e3,
+                    "p99_ms": lat_quantile(lat, 0.99) * 1e3,
+                    "mean_sel": (
+                        p["sel_sum"] / p["sel_n"] if p["sel_n"] else None
+                    ),
+                    "bytes_per_row": p["row_bytes"] or None,
+                    "coll_mb_mean": (
+                        p["coll_sum"] / p["n"] / 1e6 if p["n"] else 0.0
+                    ),
+                    "hot": p["hot"],
+                    "staged_max": p["staged_max"],
+                    "tier_max": p["tier_max"],
+                    "serve_b": dict(p["serve_b"]),
+                    "dec": {
+                        k: v for k, v in p["dec"].items() if v is not None
+                    },
+                    "flips": p["flips"],
+                    "nodes": {
+                        name: {
+                            "count": a[0],
+                            "wall_ms": round(a[1], 3),
+                            "rows": a[2],
+                            "coll_mb": round(a[3] / 1e6, 3),
+                        }
+                        for name, a in sorted(
+                            p["nodes"].items(), key=lambda kv: -kv[1][1]
+                        )
+                    },
+                }
+        return out
+
+
+# ----------------------------------------------------------------------
+# the execution-observation context (one per plan execution)
+# ----------------------------------------------------------------------
+_EXEC: "ContextVar[Optional[Dict[str, Any]]]" = ContextVar(
+    "cylon_tpu_obs_exec", default=None
+)
+
+
+@contextlib.contextmanager
+def exec_obs(obs_key: Optional[str]):
+    """Collect one plan execution's gate observations under ``obs_key``
+    (the base-fingerprint key) and journal them on exit. No-op (and
+    allocation-free on the note side) when the store is disabled."""
+    s = store()
+    if s is None or not obs_key:
+        yield None
+        return
+    rec: Dict[str, Any] = {"k": "exec", "fp": obs_key}
+    token = _EXEC.set(rec)
+    try:
+        yield rec
+    finally:
+        _EXEC.reset(token)
+        s.record(rec)
+
+
+def note_shuffle(
+    world: int,
+    row_bytes: int,
+    hot: int,
+    mean_bucket: int,
+    staged: int,
+    tier: int,
+    rounds: int,
+    coll: int,
+    budget: int,
+    static_budget: int = 0,
+    wire: bool = False,
+    relay: bool = False,
+) -> None:
+    """Fold one shuffle's planner measurements into the active exec
+    record (table._shuffle_many phase 1 — data the host already holds)."""
+    rec = _EXEC.get()
+    if rec is None:
+        return
+    rec["world"] = int(world)
+    rec["row_bytes"] = int(row_bytes)
+    rec["hot"] = max(rec.get("hot", 0), int(hot))
+    rec["mean_bucket"] = int(mean_bucket)
+    rec["staged"] = max(rec.get("staged", 0), int(staged))
+    rec["tier"] = max(rec.get("tier", 0), int(tier))
+    rec["rounds"] = rec.get("rounds", 0) + int(rounds)
+    rec["coll"] = rec.get("coll", 0) + int(coll)
+    rec["budget"] = int(budget)
+    if static_budget:
+        rec["static_budget"] = int(static_budget)
+    if wire:
+        rec["wire"] = True
+    if relay:
+        rec["relay"] = True
+
+
+def note_semi(
+    sel: Optional[float] = None,
+    built: bool = False,
+    payoff_skip: bool = False,
+) -> None:
+    """Record a semi-filter observation on the active exec record:
+    measured selectivity (from the count pass), a sketch build, or the
+    static size gate declining."""
+    rec = _EXEC.get()
+    if rec is None:
+        return
+    if sel is not None:
+        rec.setdefault("sel", []).append(round(float(sel), 4))
+    if built:
+        rec["sketch_built"] = rec.get("sketch_built", 0) + 1
+    if payoff_skip:
+        rec["payoff_skip"] = rec.get("payoff_skip", 0) + 1
+
+
+def observe_latency(
+    obs_key: Optional[str], seconds: float, batch_b: Optional[int] = None
+) -> None:
+    """Journal one resolved query latency (called from the deferred
+    resolution hook in obs.trace — the fetch already happened; this adds
+    file I/O only, never a sync)."""
+    if not obs_key:
+        return
+    s = store()
+    if s is None:
+        return
+    rec: Dict[str, Any] = {"k": "lat", "fp": obs_key, "s": round(seconds, 6)}
+    if batch_b:
+        rec["b"] = int(batch_b)
+    s.record(rec)
+
+
+def record_trace(q) -> None:
+    """Journal a finished query trace's per-node wall/rows/coll bytes
+    (called from obs.trace._maybe_finish when tracing is active)."""
+    obs_key = getattr(q, "obs_key", None)
+    if not obs_key:
+        return
+    s = store()
+    if s is None:
+        return
+    nodes: List[list] = []
+    for sp in q.all_spans():
+        if sp.name.startswith("plan.node."):
+            nodes.append([
+                sp.name[len("plan.node."):],
+                round(sp.dur_s() * 1e3, 3),
+                int(sp.attrs.get("rows_out") or 0),
+                int(sp.attrs.get("coll_bytes") or 0),
+            ])
+    if nodes:
+        s.record({"k": "trace", "fp": obs_key, "nodes": nodes})
+
+
+def absorb_histogram(key: str, hist, label: str = "") -> None:
+    """Flush an in-process latency histogram evicted by the bounded
+    registry (obs.metrics) into the store, so eviction never loses an
+    observation."""
+    s = store()
+    if s is None:
+        return
+    s.record({
+        "k": "hist", "key": key, "label": label,
+        "b": {str(b): c for b, c in hist.buckets.items()},
+        "n": hist.n, "total": round(hist.total_s, 6),
+        "min": None if hist.n == 0 else hist.min_s, "max": hist.max_s,
+    })

@@ -51,6 +51,7 @@ from typing import Any, Dict, Iterator, List, Optional
 from ..utils import envgate as _eg
 from . import export as _export
 from . import metrics as _metrics
+from . import store as _obsstore
 
 _ACTIVE: "ContextVar[Optional[QueryTrace]]" = ContextVar(
     "cylon_tpu_query_trace", default=None
@@ -114,7 +115,7 @@ class QueryTrace:
     engine refuses to make."""
 
     __slots__ = (
-        "qid", "name", "kind", "hist_key", "label", "thread",
+        "qid", "name", "kind", "hist_key", "obs_key", "label", "thread",
         "t0", "t1", "resolved", "closed", "finished", "pending",
         "spans", "_stack", "counters", "values", "attrs",
     )
@@ -124,6 +125,7 @@ class QueryTrace:
         self.name = name
         self.kind = kind
         self.hist_key: Optional[str] = None
+        self.obs_key: Optional[str] = None
         self.label = name
         self.thread = threading.get_ident()
         self.t0 = time.perf_counter()
@@ -205,6 +207,9 @@ def _maybe_finish(q: QueryTrace) -> None:
         q.finished = True
     _metrics.rollup_count("query.traces")
     _export.record(q)
+    # persist the trace's per-node wall/rows/coll bytes when the
+    # observation store is on (host dict+file work only — never a sync)
+    _obsstore.record_trace(q)
 
 
 # ----------------------------------------------------------------------
@@ -323,6 +328,8 @@ def attach_result(
     label: str = "",
     t0: Optional[float] = None,
     hist_key: Optional[str] = None,
+    obs_key: Optional[str] = None,
+    batch_b: Optional[int] = None,
 ) -> None:
     """Bind a dispatched result Table to the active trace / the latency
     histogram. The table's deferred count fetch (``_materialize_counts``)
@@ -334,7 +341,9 @@ def attach_result(
     Hot callers (``LazyFrame.dispatch``, the serving scheduler) pass the
     PRECOMPUTED ``hist_key`` hoisted onto the cached executor entry
     (``engine.PlanEntry``); ``fingerprint=`` hashes per call and remains
-    for one-shot diagnostic callers only."""
+    for one-shot diagnostic callers only. ``obs_key`` (+ optional
+    ``batch_b``, the serving batch size) additionally lands the resolved
+    latency in the persistent observation store (obs/store.py)."""
     q = _ACTIVE.get()
     key = hist_key
     if key is None and fingerprint is not None:
@@ -343,13 +352,16 @@ def attach_result(
         q.pending = True
         if key is not None:
             q.hist_key = key
+        if obs_key is not None:
+            q.obs_key = obs_key
         if label:
             q.label = label
         if t0 is None:
             t0 = q.t0
-    if q is None and key is None:
+    if q is None and key is None and obs_key is None:
         return
-    rec = (q, key, label, t0 if t0 is not None else time.perf_counter())
+    rec = (q, key, label, t0 if t0 is not None else time.perf_counter(),
+           obs_key, batch_b)
     if table._counts_host is not None:
         _resolve_record(rec, time.perf_counter())
         return
@@ -386,9 +398,13 @@ def resolve_table(table) -> None:
 
 
 def _resolve_record(rec, now: float) -> None:
-    q, key, label, t0 = rec
+    q, key, label, t0, obs_key, batch_b = rec
     if key is not None:
         _metrics.observe_latency(key, max(now - t0, 0.0), label=label)
+    if obs_key is not None:
+        # the persistent store's latency journal — the fetch already
+        # happened, this is host file I/O only
+        _obsstore.observe_latency(obs_key, max(now - t0, 0.0), batch_b)
     if q is not None:
         q.resolved = now
         _maybe_finish(q)

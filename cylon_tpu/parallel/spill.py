@@ -129,20 +129,31 @@ def gate_state() -> tuple:
     return (_envgate.SPILL_TIER.get(), skew_enabled())
 
 
-def choose_tier(staged_bytes: int) -> int:
+def choose_tier(staged_bytes: int, tuned: Optional[int] = None) -> int:
     """Tier for a shuffle whose measured received rows stage
     ``staged_bytes`` per shard: forced knob wins; else tier 0 while the
     device spill budget (unset = unlimited) holds, tier 1 beyond it.
     (Tier 1 arenas self-promote to disk when the HOST budget is exceeded
     — see :meth:`HostArena._alloc` — so the 1 vs 2 split is a property
-    of the arena backing, not of this decision.)"""
+    of the arena backing, not of this decision.)
+
+    ``tuned`` is the feedback re-coster's decision (plan/feedback.py,
+    observed peak staged bytes near the budget line): it can only
+    PROMOTE past the measured decision — spilling early is a memory
+    policy; demoting below the measured need would OOM."""
     f = forced_tier()
     if f is not None:
         return f
     budget = device_spill_budget()
-    if budget is None or staged_bytes <= budget:
-        return TIER_HBM
-    return TIER_HOST
+    tier = (
+        TIER_HBM
+        if budget is None or staged_bytes <= budget
+        else TIER_HOST
+    )
+    if tuned is not None and tuned > tier:
+        bump("autotune.tier_promoted")
+        tier = tuned
+    return tier
 
 
 # ----------------------------------------------------------------------

@@ -16,8 +16,10 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
 
 from ..engine import PlanEntry, plan_executable
 from ..obs import metrics as _obsmetrics
+from ..obs import store as _obsstore
 from ..obs import trace as _obstrace
 from ..utils.tracing import bump, span
+from . import feedback as _feedback
 from . import lower as _lower
 from . import rules as _rules
 from .expr import Col, Expr, col
@@ -60,10 +62,17 @@ def gated_fingerprint(plan: Node) -> tuple:
     # shuffles re-read them per run THROUGH this identity — a flip must
     # re-enter the cache, never serve a result staged under the other
     # tier/schedule regime
-    return (
+    base = (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
         _spill_gate(),
     )
+    # the feedback component: (autotune active, tuned Decisions) — every
+    # telemetry-driven override (shuffle budget, semi mode, serve bucket,
+    # spill tier) is part of the executable identity, so a decision flip
+    # recompiles exactly once and never aliases; the observation store is
+    # keyed by `base` (WITHOUT this component) so flips keep feeding one
+    # profile (plan/feedback.py)
+    return base + (_feedback.fingerprint_component(base),)
 
 
 def _normalize_aggs(agg: Dict[str, TUnion[str, Sequence[str]]]) -> List[Tuple[str, str]]:
@@ -267,6 +276,7 @@ class LazyFrame:
             return PlanEntry(
                 opt, tuple(fired), fn,
                 _obsmetrics.fingerprint_key(fingerprint),
+                _feedback.base_key(fingerprint[:-1]),
             )
 
         entry, hit = plan_executable(ctx, fingerprint, compile_plan)
@@ -308,10 +318,16 @@ class LazyFrame:
                     pass
             for f in fired:
                 bump(f"plan.rule.{f}")
-            with span("plan.execute"):
-                out = fn(tables)
+            # apply the tuned decisions the executor was keyed under and
+            # collect this execution's gate observations for the store
+            # (both no-ops when autotune/the store are off)
+            with _feedback.applying(fingerprint[-1]), \
+                    _obsstore.exec_obs(entry.obs_key):
+                with span("plan.execute"):
+                    out = fn(tables)
             _obstrace.attach_result(
-                out, hist_key=entry.hist_key, label=opt.label(), t0=t_q
+                out, hist_key=entry.hist_key, obs_key=entry.obs_key,
+                label=opt.label(), t0=t_q,
             )
             return out
 
@@ -326,8 +342,12 @@ class LazyFrame:
             with _obstrace.query_trace(
                 type(self._plan).__name__, kind="explain", force=True,
             ) as q:
-                with span("plan.execute"):
-                    out = fn(tables)
+                # same tuned decisions the executor was keyed under —
+                # an analyzed run must execute the regime it annotates
+                with _feedback.applying(fingerprint[-1]), \
+                        _obsstore.exec_obs(entry.obs_key):
+                    with span("plan.execute"):
+                        out = fn(tables)
                 # fingerprint deliberately NOT passed: an analyzed run's
                 # per-node diagnostic syncs (+ compile on a cache miss)
                 # must never land a sample in the fingerprint histogram
@@ -340,11 +360,18 @@ class LazyFrame:
             "== Analyzed plan (executed) ==",
             _render_analyzed(opt, q), "",
             _fired_line(fired),
+        ]
+        tuned = _feedback.describe(fingerprint[:-1])
+        lines.append(
+            "Tuned gates:" + ("" if tuned else " (none)")
+        )
+        lines.extend(f"  {t}" for t in tuned)
+        lines.append(
             f"Plan fingerprint: {entry.hist_key}"
             f"  plan-cache {'hit' if hit else 'miss'}"
             f"  total {q.wall_s() * 1e3:.1f} ms"
-            f"  rows out {out.row_count}",
-        ]
+            f"  rows out {out.row_count}"
+        )
         return "\n".join(lines)
 
 

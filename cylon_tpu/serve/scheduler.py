@@ -53,7 +53,9 @@ from typing import Callable, List, Optional
 
 from .. import engine as _engine
 from ..obs import metrics as _obsmetrics
+from ..obs import store as _obsstore
 from ..obs import trace as _obstrace
+from ..plan import feedback as _feedback
 from ..plan import lazy as _lazy
 from ..plan import lower as _plan_lower
 from ..plan import rules as _plan_rules
@@ -122,12 +124,13 @@ class _Record:
 class _BatchEntry:
     """One compiled batched executor (cached in engine's batch tier)."""
 
-    __slots__ = ("template", "fn", "hist_key", "label")
+    __slots__ = ("template", "fn", "hist_key", "obs_key", "label")
 
-    def __init__(self, template, fn, hist_key, label):
+    def __init__(self, template, fn, hist_key, obs_key, label):
         self.template = template
         self.fn = fn
         self.hist_key = hist_key
+        self.obs_key = obs_key
         self.label = label
 
 
@@ -372,6 +375,13 @@ class ServeScheduler:
         rest. Caller holds the lock."""
         head = self._queue[0]
         limit = max(_knob_int(_eg.SERVE_BATCH_MAX, 16), 1)
+        # the feedback re-coster's p99-target batch bucket rides the
+        # fingerprint the group is keyed by: a tuned shape caps its own
+        # group size (smaller stacked programs -> lower tail latency)
+        # without touching other shapes' batching
+        tuned_b = _feedback.decisions_of(head.fingerprint).serve_bucket
+        if tuned_b:
+            limit = min(limit, max(int(tuned_b), 1))
         group: List[_Record] = []
         rest: List[_Record] = []
         for rec in self._queue:
@@ -418,11 +428,17 @@ class ServeScheduler:
         deferred handle."""
         with _obstrace.query_trace(rec.label, kind="serve"):
             tables, fingerprint, entry, hit = rec.lf._executable()
-            with span("plan.execute"):
-                out = entry.fn(rec.tables)
+            with _feedback.applying(fingerprint[-1]), \
+                    _obsstore.exec_obs(entry.obs_key):
+                with span("plan.execute"):
+                    out = entry.fn(rec.tables)
+            # batch_b=1: an honest B=1 serving sample — it keeps the
+            # serve-bucket proposer's latency window fed even when a
+            # tuned bucket of 1 routes every query through this path,
+            # so a halved bucket can walk back up when latency recovers
             _obstrace.attach_result(
-                out, hist_key=entry.hist_key, label=rec.label,
-                t0=rec.fut.t_submit,
+                out, hist_key=entry.hist_key, obs_key=entry.obs_key,
+                batch_b=1, label=rec.label, t0=rec.fut.t_submit,
             )
             rec.fut.hist_key = entry.hist_key
             bump("serve.singles")
@@ -464,22 +480,27 @@ class ServeScheduler:
                 fn = _plan_lower.build_executor(opt)
             # per-query latency samples land in the ORIGINAL plan shape's
             # histogram: batched and serial collects of one fingerprint
-            # share a distribution (hashed once, at compile time)
+            # share a distribution (hashed once, at compile time) — and
+            # its observation-store profile is likewise the single-plan
+            # base identity, so batched and serial evidence pool
             return _BatchEntry(
                 template, fn, _obsmetrics.fingerprint_key(orig_fp),
+                _feedback.base_key(orig_fp[:-1]),
                 opt.label(),
             )
 
         entry, hit = _engine.serve_batch_executable(ctx, key, compile_batch)
         with _obstrace.query_trace(entry.label, kind="serve") as q:
-            stacked = [
-                _batch.stack_tables(
-                    ctx, [rec.tables[s] for rec in group], bucket
-                )
-                for s in range(len(head.tables))
-            ]
-            with span("plan.execute"):
-                out = entry.fn(stacked)
+            with _feedback.applying(orig_fp[-1]), \
+                    _obsstore.exec_obs(entry.obs_key):
+                stacked = [
+                    _batch.stack_tables(
+                        ctx, [rec.tables[s] for rec in group], bucket
+                    )
+                    for s in range(len(head.tables))
+                ]
+                with span("plan.execute"):
+                    out = entry.fn(stacked)
             if q is not None:
                 q.hist_key = entry.hist_key
                 q.attrs["serve.batch_b"] = b
@@ -498,8 +519,8 @@ class ServeScheduler:
             slices = _batch.split_batch(out, entry.template, b, bucket)
             for rec, sliced in zip(group, slices):
                 _obstrace.attach_result(
-                    sliced, hist_key=entry.hist_key, label=rec.label,
-                    t0=rec.fut.t_submit,
+                    sliced, hist_key=entry.hist_key, obs_key=entry.obs_key,
+                    batch_b=b, label=rec.label, t0=rec.fut.t_submit,
                 )
                 rec.fut.hist_key = entry.hist_key
                 rec.fut._fulfill(sliced)

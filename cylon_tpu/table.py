@@ -52,7 +52,9 @@ from .ops import sort as _sort_mod
 from .ops import stats as _st
 from .parallel import shuffle as _sh
 from .parallel import spill as _spill
+from .obs import store as _obsstore
 from .obs import trace as _obstrace
+from .plan import feedback as _feedback
 from .utils.tracing import annotate_add, bump, gauge, span
 
 KeyCol = Tuple[jax.Array, Optional[jax.Array]]
@@ -1888,7 +1890,13 @@ class Table:
             bucket_cap = min(
                 bucket_cap,
                 _sh.budget_bucket_cap(
-                    row_bytes, world, ctx.shuffle_byte_budget, bucket_cap
+                    row_bytes, world,
+                    # the feedback re-coster's per-shape budget (threaded
+                    # into the plan fingerprint) overrides the static
+                    # default here exactly as in _shuffle_many
+                    _feedback.tuned_shuffle_budget()
+                    or ctx.shuffle_byte_budget,
+                    bucket_cap,
                 ),
             )
             join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
@@ -3525,7 +3533,14 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     # counts yield a strictly cheaper round plan — a prune that does not
     # cross a capacity boundary would cost probe work for zero byte win.
     for st in states:
-        budget = int(st["spec"].byte_budget or st["ctx"].shuffle_byte_budget)
+        # explicit per-call budget wins; then the feedback re-coster's
+        # per-shape tuned budget (present only inside a plan execution
+        # whose fingerprint carries it); then the static default
+        budget = int(
+            st["spec"].byte_budget
+            or _feedback.tuned_shuffle_budget()
+            or st["ctx"].shuffle_byte_budget
+        )
         row_bytes = _sh.exchange_row_bytes(st["flat"])
         if st["spec"].sketch is not None:
             unfiltered, filtered = st["counts_pair"]
@@ -3533,6 +3548,9 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             gauge(
                 "shuffle.semi_filter.selectivity", tot_f / max(tot_u, 1)
             )
+            # measured selectivity feeds the persistent per-fingerprint
+            # profile: the feedback re-coster's semi decision substrate
+            _obsstore.note_semi(sel=tot_f / max(tot_u, 1), built=True)
             cap_u, k_u = _sh.plan_rounds(
                 unfiltered, row_bytes, st["world"], budget
             )
@@ -3636,9 +3654,14 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         # wins; a caller-owned sink implies at least tier 1 — the rows'
         # destination IS the host)
         tier = st["spec"].spill_tier
+        staged = int(st["send_counts"].sum(axis=0).max()) * row_bytes
         if tier is None:
-            staged = int(st["send_counts"].sum(axis=0).max()) * row_bytes
-            tier = _spill.choose_tier(staged)
+            # the feedback re-coster can PROMOTE the tier before the
+            # budget line from historically observed staged bytes (it
+            # never demotes below the measured decision)
+            tier = _spill.choose_tier(
+                staged, tuned=_feedback.tuned_spill_tier()
+            )
         if st["spec"].sink is not None and tier == _spill.TIER_HBM:
             tier = _spill.TIER_HOST
         st["tier"] = tier
@@ -3688,6 +3711,24 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             st["sink_obj"].device_rows_peak = max(
                 getattr(st["sink_obj"], "device_rows_peak", 0), peak_rows
             )
+        # persist this shuffle's measured planning inputs + decisions for
+        # the feedback re-coster (host dict work; no-op without an active
+        # exec-observation context / store)
+        m = np.asarray(st["send_counts"], np.int64)
+        _obsstore.note_shuffle(
+            world=w,
+            row_bytes=int(row_bytes),
+            hot=int(m.max()) if m.size else 0,
+            mean_bucket=-(-int(m.sum()) // max(m.size, 1)),
+            staged=staged,
+            tier=int(tier),
+            rounds=int(st["n_rounds"]),
+            coll=int(coll_bytes),
+            budget=budget,
+            static_budget=int(st["ctx"].shuffle_byte_budget),
+            wire=st["wire"] is not None,
+            relay=sched.adaptive,
+        )
     gauge(
         "shuffle.spill.peak_device_bytes",
         sum(st["dev_peak_bytes"] for st in states),
@@ -3875,6 +3916,7 @@ def _pair_sketches(
     b: "Table",
     b_keys: Sequence[str],
     sides: str,
+    size_gate: bool = True,
 ) -> Optional[dict]:
     """Build the combined semi-join key sketches for a shuffle pair
     (ops/sketch.py): each side named in ``sides`` ('both'/'a'/'b' = which
@@ -3927,7 +3969,11 @@ def _pair_sketches(
     prunable //= max(world, 1)
     from .config import SEMI_FILTER_MIN_PAYOFF
 
-    if prunable < SEMI_FILTER_MIN_PAYOFF * wire:
+    # ``size_gate=False`` (the feedback re-coster's "on"/"explore" semi
+    # modes) overrides ONLY this static payoff heuristic — the soundness
+    # gates above (hash-class pairing, range-class match) always stand
+    if size_gate and prunable < SEMI_FILTER_MIN_PAYOFF * wire:
+        _obsstore.note_semi(payoff_skip=True)
         return None
     kflats = [tuple(t._flat_cols(list(keys))) for _, t, keys in build]
     sig = tuple(
@@ -3982,8 +4028,25 @@ def _shuffle_pair(
     shuffle's (CYLON_TPU_NO_SEMI_FILTER=1 disables for differentials)."""
     sa = _ShuffleSpec(a, "hash", tuple(a_keys), byte_budget=byte_budget)
     sb = _ShuffleSpec(b, "hash", tuple(b_keys), byte_budget=byte_budget)
-    if semi is not None and a.world_size > 1 and _sketch.enabled():
-        got = _pair_sketches(a, a_keys, b, b_keys, semi)
+    # the feedback re-coster's semi decision (threaded through the plan
+    # fingerprint; None outside plan execution / with autotune off):
+    # "off" skips even building the sketch — observed selectivity too
+    # high to ever repay the sketch collective; "on"/"explore" build it
+    # past the static size gate ("on": observed selectivity low;
+    # "explore": measure-then-decide on a shape with no evidence yet)
+    mode = _feedback.tuned_semi_mode()
+    if semi is not None and a.world_size > 1 and mode == "off":
+        bump("autotune.semi_skipped")
+    if (
+        semi is not None and a.world_size > 1 and _sketch.enabled()
+        and mode != "off"
+    ):
+        if mode in ("on", "explore"):
+            bump("autotune.semi_forced")
+        got = _pair_sketches(
+            a, a_keys, b, b_keys, semi,
+            size_gate=mode not in ("on", "explore"),
+        )
         if got is not None:
             if "a" in got["probe"]:
                 sa = sa._replace(

@@ -286,6 +286,49 @@ COMPILE_CACHE = EnvKnob(
     note="persistent XLA compile cache location (context init)",
 )
 
+# -- self-tuning execution (obs/store.py + plan/feedback.py; the
+# CYLON_TPU_NO_AUTOTUNE kill switch is declared at its consumer module
+# plan/feedback.py via env_gate) ----------------------------------------
+OBS_DIR = EnvKnob(
+    "CYLON_TPU_OBS_DIR", "", kind="tuning",
+    keyed_via="presence/location of the persistent observation store; "
+    "the autotune state it enables rides the plan fingerprint as the "
+    "(active, Decisions) component plan/feedback.fingerprint_component "
+    "appends in plan/lazy.gated_fingerprint — every tuned decision is "
+    "part of the executable identity, so a store flip re-enters the "
+    "plan cache instead of aliasing",
+    note="directory of the persistent per-fingerprint observation "
+    "journal (obs/store.py); unset disables the store AND every "
+    "telemetry-driven gate re-costing decision",
+)
+AUTOTUNE_MIN_OBS = EnvKnob(
+    "CYLON_TPU_AUTOTUNE_MIN_OBS", "8", kind="tuning",
+    keyed_via="hysteresis depth of the feedback re-coster only: a tuned "
+    "decision flips after this many CONSISTENT observations; the flipped "
+    "decision (not this knob) rides the plan fingerprint",
+    note="observations a candidate decision must win consecutively "
+    "before the feedback optimizer flips a gate (plan/feedback.py)",
+)
+AUTOTUNE_MARGIN = EnvKnob(
+    "CYLON_TPU_AUTOTUNE_MARGIN", "0.2", kind="tuning",
+    keyed_via="hysteresis margin of the feedback re-coster only: the "
+    "incumbent decision's modeled cost must exceed the candidate's by "
+    "this fraction before a flip; the flipped decision rides the plan "
+    "fingerprint",
+    note="relative cost margin a candidate decision must beat the "
+    "incumbent by before the feedback optimizer flips (plan/feedback.py)",
+)
+SERVE_P99_TARGET_MS = EnvKnob(
+    "CYLON_TPU_SERVE_P99_TARGET_MS", "", kind="tuning",
+    keyed_via="feeds the serve-batch-bucket proposal only; the chosen "
+    "bucket rides the plan fingerprint (Decisions.serve_bucket) and the "
+    "(fingerprint, B-bucket) serve_batch_executable key",
+    note="per-fingerprint serving p99 target in milliseconds: observed "
+    "p99 above it halves the tuned serve batch bucket, p99 under half "
+    "of it doubles the bucket back toward CYLON_TPU_SERVE_BATCH_MAX "
+    "(unset = no batch-size tuning)",
+)
+
 # -- observability ------------------------------------------------------
 # All three trace knobs are host-only by declared contract (the L1
 # trace-time-read rule): they gate span logging/recording/export and can

@@ -1,0 +1,402 @@
+"""Feedback autopilot (ISSUE 11): store durability + tuning correctness.
+
+Covers the acceptance checklist:
+- journal round-trip, torn-tail-line recovery, compaction bound;
+- the CYLON_TPU_NO_AUTOTUNE differential oracle (identical results on
+  every shape, warm or cold store);
+- the hysteresis no-flap pin (alternating observations must not
+  oscillate recompiles — asserted via the plan-cache miss counter);
+- tuned-decision-in-fingerprint pin (a flip re-keys the plan exactly
+  once; the kill switch re-keys like the other gates);
+- explain(analyze=True) ``tuned:`` annotation golden;
+- bounded in-process histogram registry with store flush on eviction.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.obs import metrics as obs_metrics
+from cylon_tpu.obs import store as obs_store
+from cylon_tpu.plan import feedback as fb
+from cylon_tpu.plan.lazy import gated_fingerprint
+from cylon_tpu.utils import tracing
+
+
+@pytest.fixture
+def obs_env(tmp_path, monkeypatch):
+    """A fresh observation store + fast hysteresis for the test."""
+    d = str(tmp_path / "obs")
+    monkeypatch.setenv("CYLON_TPU_OBS_DIR", d)
+    monkeypatch.setenv("CYLON_TPU_AUTOTUNE_MIN_OBS", "2")
+    obs_store.reset_stores()
+    yield d
+    obs_store.reset_stores()
+
+
+@pytest.fixture(scope="module")
+def ctx4(devices):
+    # module-scoped: the tests share one mesh's jit caches (each test's
+    # plans use distinct value-column names, so plan fingerprints — and
+    # their per-tmpdir store profiles — never collide across tests)
+    return ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:4])
+    )
+
+
+def _pair(ctx, rng, n, sel, vname="v"):
+    keyspace = max(n // 6, 8)
+    lk = rng.integers(0, keyspace, n).astype(np.int32)
+    rk = rng.integers(0, keyspace, max(n // 2, 8)).astype(np.int32)
+    rk = np.where(
+        rng.random(len(rk)) >= sel, rk + 10 * keyspace, rk
+    ).astype(np.int32)
+    lt = ct.Table.from_pydict(
+        ctx, {"k": lk, vname: rng.random(n).astype(np.float32)}
+    )
+    rt = ct.Table.from_pydict(
+        ctx, {"rk": rk, "w": rng.random(len(rk)).astype(np.float32)}
+    )
+    return lt, rt
+
+
+def _plan(lt, rt, vname="v"):
+    return lt.lazy().join(
+        rt.lazy(), left_on="k", right_on="rk", how="inner"
+    ).groupby("k", {vname: "sum"})
+
+
+# ----------------------------------------------------------------------
+# store durability
+# ----------------------------------------------------------------------
+def test_journal_round_trip(tmp_path):
+    d = str(tmp_path / "s")
+    s = obs_store.ObsStore(d)
+    for i in range(10):
+        s.record({"k": "exec", "fp": "aaaa", "world": 4, "row_bytes": 8,
+                  "hot": 100 + i, "coll": 1000})
+        s.record({"k": "lat", "fp": "aaaa", "s": 0.01 * (i + 1)})
+    s.close()
+    s2 = obs_store.ObsStore(d)
+    p = s2.profiles["aaaa"]
+    assert p["n"] == 10
+    assert p["hot"] == 109
+    assert p["lat"]["n"] == 10
+    assert p["coll_sum"] == 10_000
+    assert s2.skipped_lines == 0
+    # quantiles read back off the merged buckets
+    q = obs_store.lat_quantile(p["lat"], 0.5)
+    assert 0.01 <= q <= 0.11
+
+
+def test_torn_tail_line_recovery(tmp_path):
+    d = str(tmp_path / "s")
+    s = obs_store.ObsStore(d)
+    for i in range(5):
+        s.record({"k": "exec", "fp": "bbbb", "world": 2, "row_bytes": 4,
+                  "hot": 50})
+    s.close()
+    # simulate a crash mid-append: a torn half-record at the tail AND a
+    # garbage line in the middle must both be skipped, everything else
+    # kept
+    with open(os.path.join(d, "journal.jsonl"), "a") as f:
+        f.write('{"k": "exec", "fp": "bbbb", "wor')
+    s2 = obs_store.ObsStore(d)
+    assert s2.profiles["bbbb"]["n"] == 5
+    assert s2.skipped_lines == 1
+    # and the reloaded store keeps accepting records
+    s2.record({"k": "exec", "fp": "bbbb", "world": 2, "row_bytes": 4,
+               "hot": 50})
+    assert s2.profiles["bbbb"]["n"] == 6
+    s2.close()
+
+
+def test_compaction_bounds_journal(tmp_path):
+    d = str(tmp_path / "s")
+    s = obs_store.ObsStore(d, compact_every=16)
+    for i in range(100):
+        s.record({"k": "lat", "fp": f"fp{i % 3}", "s": 0.001})
+    # the journal folded into snapshot.json on every 16th record: the
+    # live journal holds fewer than compact_every lines and the
+    # snapshot carries the rest
+    with open(s.journal_path) as f:
+        assert sum(1 for _ in f) < 16
+    with open(s.snapshot_path) as f:
+        snap = json.load(f)
+    assert set(snap["profiles"]) == {"fp0", "fp1", "fp2"}
+    total = sum(p["lat"]["n"] for p in s.profiles.values())
+    assert total == 100
+    s.close()
+    # nothing lost across the reload either
+    s2 = obs_store.ObsStore(d)
+    assert sum(p["lat"]["n"] for p in s2.profiles.values()) == 100
+    s2.close()
+
+
+def test_compaction_crash_window_never_double_absorbs(tmp_path):
+    """A crash between compact()'s snapshot rename and its journal
+    truncate leaves the folded records in BOTH files; the snapshot's
+    jseq high-water mark must dedup them on load."""
+    d = str(tmp_path / "s")
+    s = obs_store.ObsStore(d, compact_every=10 ** 9)
+    recs = []
+    for i in range(6):
+        r = {"k": "exec", "fp": "cc", "world": 4, "row_bytes": 8,
+             "hot": 10}
+        s.record(r)  # record() stamps the journal id onto the dict
+        recs.append(r)
+    s.compact()  # journal truncated, snapshot carries jseq=6
+    s.close()
+    # simulate the crash window: the folded records are still in the
+    # journal when the process dies
+    with open(os.path.join(d, "journal.jsonl"), "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    s2 = obs_store.ObsStore(d)
+    assert s2.profiles["cc"]["n"] == 6, "folded records double-absorbed"
+    # and genuinely-new records after the stale tail still absorb
+    s2.record({"k": "exec", "fp": "cc", "world": 4, "row_bytes": 8,
+               "hot": 10})
+    assert s2.profiles["cc"]["n"] == 7
+    s2.close()
+
+
+def test_profile_cap_evicts_lru(tmp_path, monkeypatch):
+    d = str(tmp_path / "s")
+    monkeypatch.setattr(obs_store, "PROFILE_CAP", 8)
+    s = obs_store.ObsStore(d, compact_every=10 ** 9)
+    for i in range(20):
+        s.record({"k": "lat", "fp": f"fp{i}", "s": 0.001})
+    s.compact()
+    assert len(s.profiles) <= 8
+    # the most recent fingerprints survive
+    assert "fp19" in s.profiles and "fp0" not in s.profiles
+    s.close()
+
+
+# ----------------------------------------------------------------------
+# differential oracle + fingerprint discipline
+# ----------------------------------------------------------------------
+def test_no_autotune_oracle_exact(ctx4, rng, obs_env):
+    """Warm-store tuned execution returns bit-identical results to the
+    CYLON_TPU_NO_AUTOTUNE=1 static-heuristic run on join/groupby/sort
+    shapes at several selectivities."""
+    for sel, vname in ((0.1, "a"), (1.0, "b")):
+        lt, rt = _pair(ctx4, rng, 3000, sel, vname)
+        lf = _plan(lt, rt, vname)
+        with fb.autotune_disabled():
+            want = lf.collect().to_pandas()
+        for _ in range(4):  # explore -> decide -> tuned
+            got = lf.collect().to_pandas()
+            assert got.equals(want)
+        srt = lt.lazy().sort("k")
+        with fb.autotune_disabled():
+            want_s = srt.collect().to_pandas()
+        assert srt.collect().to_pandas().equals(want_s)
+
+
+def test_kill_switch_rekeys_fingerprint(ctx4, rng, obs_env):
+    lt, rt = _pair(ctx4, rng, 500, 1.0, "c")
+    plan = _plan(lt, rt, "c").plan
+    fp_on = gated_fingerprint(plan)
+    with fb.autotune_disabled():
+        fp_off = gated_fingerprint(plan)
+    assert fp_on != fp_off
+    # the component is (active, Decisions) — the L1-policed carrier
+    assert fp_on[-1][0] is True and fp_off[-1][0] is False
+    assert isinstance(fp_on[-1][1], fb.Decisions)
+    # without a store the component is the constant OFF state
+    os.environ.pop("CYLON_TPU_OBS_DIR", None)
+    assert gated_fingerprint(plan)[-1] == (False, fb.DECISIONS_OFF)
+
+
+def test_decision_flip_recompiles_exactly_once(ctx4, rng, obs_env):
+    """A tuned-decision flip re-enters the plan cache exactly once (the
+    tuned-decision-in-fingerprint pin): misses == 1 cold compile + 1 per
+    recorded flip, and a settled store stops recompiling."""
+    lt, rt = _pair(ctx4, rng, 3000, 1.0, "d")
+    lf = _plan(lt, rt, "d")
+    m0 = tracing.get_count("plan.cache.miss")
+    for _ in range(8):
+        lf.collect()
+    s = obs_store.store()
+    flips = sum(p.get("flips", 0) for p in s.profiles.values())
+    assert flips >= 1, "expected at least one decision flip on warm-up"
+    assert tracing.get_count("plan.cache.miss") - m0 == 1 + flips
+    # settled: no further misses
+    m1 = tracing.get_count("plan.cache.miss")
+    for _ in range(3):
+        lf.collect()
+    assert tracing.get_count("plan.cache.miss") == m1
+    # the flipped decision is visible in the fingerprint component
+    dec = gated_fingerprint(lf.plan)[-1][1]
+    assert dec.semi_mode in ("on", "off", None) and dec != fb.Decisions(
+        semi_mode="explore"
+    )
+
+
+def test_hysteresis_no_flap_on_alternating_observations(tmp_path):
+    """Alternating evidence must never flip a decision: the candidate
+    streak resets on every alternation, so the decision dict stays empty
+    no matter how long the sequence runs (the no-flap pin at the
+    decision layer; the plan-cache twin is the test above)."""
+    d = str(tmp_path / "s")
+    os.environ["CYLON_TPU_AUTOTUNE_MIN_OBS"] = "3"
+    try:
+        s = obs_store.ObsStore(d, compact_every=10 ** 9)
+        for i in range(60):
+            sel = 0.3 if i % 2 == 0 else 0.95  # mean ~0.625: mid-band
+            s.record({"k": "exec", "fp": "flap", "world": 4,
+                      "row_bytes": 8, "hot": 64, "sel": [sel, sel],
+                      "sketch_built": 2})
+        p = s.profiles["flap"]
+        # each gate settles AT MOST once under alternating evidence
+        # (semi to the mid-band static fallback, budget to its one
+        # shrink) — never oscillates: total flips <= number of gates
+        # that decided, and the semi decision is static/undecided
+        assert p["flips"] <= 2, "alternating evidence must not oscillate"
+        assert p["dec"].get("semi_mode") in (None, fb.STATIC)
+        flips0 = p["flips"]
+        # and CONSISTENT low-selectivity evidence from here flips the
+        # semi gate exactly once more (to "on"), then stays
+        for _ in range(30):
+            s.record({"k": "exec", "fp": "flap", "world": 4,
+                      "row_bytes": 8, "hot": 64, "sel": [0.05, 0.05],
+                      "sketch_built": 2})
+        assert p["dec"].get("semi_mode") == "on"
+        assert p["flips"] == flips0 + 1
+        s.close()
+    finally:
+        os.environ.pop("CYLON_TPU_AUTOTUNE_MIN_OBS", None)
+
+
+def test_explain_analyze_tuned_golden(ctx4, rng, obs_env):
+    """explain(analyze=True) annotates each tuned gate with
+    ``tuned: <value> (was <static>, n=<obs>)``."""
+    lt, rt = _pair(ctx4, rng, 3000, 1.0, "e")
+    lf = _plan(lt, rt, "e")
+    for _ in range(5):
+        lf.collect()
+    text = lf.explain(analyze=True)
+    assert "Tuned gates:" in text
+    assert "tuned: " in text and "(was " in text and ", n=" in text
+    # the semi decision line names its static heuristic
+    assert "semi_filter tuned: off (was payoff>=" in text
+    # with autotune off the section is explicitly empty
+    with fb.autotune_disabled():
+        text_off = lf.explain(analyze=True)
+    assert "Tuned gates: (none)" in text_off
+    assert "tuned: " not in text_off
+
+
+# ----------------------------------------------------------------------
+# serve-bucket + spill proposers (decision layer)
+# ----------------------------------------------------------------------
+def test_serve_bucket_halves_toward_p99_target(tmp_path, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_AUTOTUNE_MIN_OBS", "2")
+    monkeypatch.setenv("CYLON_TPU_SERVE_P99_TARGET_MS", "1.0")
+    monkeypatch.setenv("CYLON_TPU_SERVE_BATCH_MAX", "16")
+    s = obs_store.ObsStore(str(tmp_path / "s"), compact_every=10 ** 9)
+    for _ in range(4):  # 10 ms >> 1 ms target: halve the bucket
+        s.record({"k": "lat", "fp": "serve", "s": 0.010, "b": 16})
+    p = s.profiles["serve"]
+    assert p["dec"].get("serve_bucket") == 8
+    # the SERVING latency window (not the pooled lat histogram) resets
+    # on flip so the NEW bucket is judged on its own evidence
+    assert p["serve_lat"]["n"] < 4
+    assert p["lat"]["n"] == 4  # the pooled history is untouched
+    # fast observations under the new bucket walk it back up toward the
+    # env max (a decision AT the max is recorded as None = untuned)
+    for _ in range(8):
+        s.record({"k": "lat", "fp": "serve", "s": 0.0001, "b": 8})
+    assert p["dec"].get("serve_bucket") in (16, None)
+    s.close()
+
+
+def test_spill_tier_promotes_before_budget_line(tmp_path, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_AUTOTUNE_MIN_OBS", "2")
+    monkeypatch.setenv("CYLON_TPU_SPILL_DEVICE_BUDGET", str(1 << 20))
+    s = obs_store.ObsStore(str(tmp_path / "s"), compact_every=10 ** 9)
+    # staged at 90% of the budget: under the line (no spill yet) but
+    # past the high-water mark -> promote to tier 1 preemptively
+    for _ in range(4):
+        s.record({"k": "exec", "fp": "sp", "world": 4, "row_bytes": 8,
+                  "hot": 64, "staged": int(0.9 * (1 << 20)), "tier": 0})
+    p = s.profiles["sp"]
+    assert p["dec"].get("spill_tier") == 1
+    # and choose_tier honors the promotion (forced env knob still wins)
+    from cylon_tpu.parallel import spill
+
+    assert spill.choose_tier(1024, tuned=1) == 1
+    assert spill.choose_tier(1024, tuned=None) == 0
+    s.close()
+
+
+# ----------------------------------------------------------------------
+# bounded histogram registry (obs/metrics.py satellite)
+# ----------------------------------------------------------------------
+def test_hist_registry_bounded_lru_evicts_to_store(monkeypatch, tmp_path):
+    d = str(tmp_path / "h")
+    monkeypatch.setenv("CYLON_TPU_OBS_DIR", d)
+    monkeypatch.setenv("CYLON_TPU_TRACE_RING", "1")  # tiny capacity
+    obs_store.reset_stores()
+    obs_metrics.reset_latency()
+    try:
+        cap = obs_metrics.hist_capacity()
+        assert cap == obs_metrics.HIST_CAP_MIN
+        n_keys = cap + 50
+        for i in range(n_keys):
+            obs_metrics.observe_latency(f"hk{i}", 0.001 * (i + 1),
+                                        label=f"lbl{i}")
+        rep = obs_metrics.latency_report()
+        assert len(rep) <= cap, "registry must stay bounded"
+        # the oldest keys were evicted from memory...
+        assert "hk0" not in rep and f"hk{n_keys - 1}" in rep
+        # ...but their samples flushed to the store (no observation lost)
+        s = obs_store.store()
+        assert "hk0" in s.hists
+        assert s.hists["hk0"]["n"] == 1
+        assert s.hists["hk0"]["label"] == "lbl0"
+        assert tracing.get_count("obs.hist.evicted") > 0
+        # an LRU touch protects a hot key from eviction
+        obs_metrics.observe_latency("hk_hot", 0.5)
+        for i in range(cap - 1):
+            obs_metrics.observe_latency(f"hk2_{i}", 0.001)
+            obs_metrics.observe_latency("hk_hot", 0.5)
+        assert "hk_hot" in obs_metrics.latency_report()
+    finally:
+        obs_metrics.reset_latency()
+        obs_store.reset_stores()
+
+
+# ----------------------------------------------------------------------
+# traceview store modes
+# ----------------------------------------------------------------------
+def test_traceview_profiles_and_diff(tmp_path, capsys):
+    import tools.traceview as tv
+
+    d = str(tmp_path / "s")
+    s = obs_store.ObsStore(d, compact_every=10 ** 9)
+    for i in range(4):
+        s.record({"k": "exec", "fp": "tv1", "world": 4, "row_bytes": 8,
+                  "hot": 128, "coll": 10_000, "sel": [0.25]})
+        s.record({"k": "lat", "fp": "tv1", "s": 0.01})
+    s.compact()
+    s.close()
+    assert tv.main(["--profiles", "--obs-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "tv1" in out and "p99" in out and "semi sel 0.25" in out
+    # bless a baseline, then diff clean
+    assert tv.main(["--diff", "--obs-dir", d, "--save-baseline"]) == 0
+    assert tv.main(["--diff", "--obs-dir", d]) == 0
+    # regress coll-MB by 10x: the sentinel must flag and exit 1
+    s2 = obs_store.ObsStore(d)
+    for i in range(40):
+        s2.record({"k": "exec", "fp": "tv1", "world": 4, "row_bytes": 8,
+                   "hot": 128, "coll": 100_000})
+    s2.close()
+    capsys.readouterr()
+    assert tv.main(["--diff", "--obs-dir", d]) == 1
+    assert "REGRESSION" in capsys.readouterr().out

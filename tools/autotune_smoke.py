@@ -1,0 +1,264 @@
+"""autotune-smoke: the CI feedback-autopilot gate (ISSUE 11).
+
+Runs on the 8-virtual-device CPU mesh, in one process:
+
+1. SEMI OFF  — a full-overlap (selectivity ~1.0) distributed join where
+   the static config builds the semi sketch (the size gate passes) and
+   then never applies it: pure wasted sketch collective. With a warm
+   store the feedback re-coster decides ``semi_mode=off`` and the tuned
+   run must ship STRICTLY fewer wire bytes (exchanged + sketch) than the
+   static run, with identical results.
+2. SEMI ON   — a low-selectivity join sized UNDER the static payoff gate
+   (``SEMI_FILTER_MIN_PAYOFF``), so the static config never builds the
+   sketch. The warm store measures the selectivity in explore mode,
+   decides ``semi_mode=on``, and the tuned run must ship fewer total
+   wire bytes than the static run, identical results.
+3. Q3        — the fused join->groupby-SUM shape: warm-store tuned
+   execution must MATCH OR BEAT the static config on traced collective
+   MB (exact, >=1.0x) and on wall (best-of-N, small tolerance for CI
+   noise), identical results.
+4. RECOMPILE PIN — each decision flip costs exactly ONE plan-cache miss,
+   and a settled warm store adds ZERO misses over repeated collects (the
+   hysteresis no-flap contract, asserted from the plan-cache counters).
+
+Usage: python tools/autotune_smoke.py [--rows 40000] [--world 8]
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+
+def _fail(msg: str) -> None:
+    print(f"AUTOTUNE SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--wall-tol", type=float, default=0.20,
+                    help="q3 wall no-regression tolerance (best-of-N "
+                    "walls on a shared CI box still jitter; the coll-MB "
+                    "gate beside it is exact)")
+    args = ap.parse_args()
+
+    devices = ge._force_cpu_mesh(args.world)
+    import time
+
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.obs import metrics as obsmetrics
+    from cylon_tpu.obs import store as obstore
+    from cylon_tpu.utils import tracing
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[: args.world])
+    )
+    rng = np.random.default_rng(7)
+
+    def wire_bytes():
+        rep = obsmetrics.report()
+        return int(
+            rep.get("shuffle.exchanged_bytes", {}).get("rows", 0)
+            + rep.get("semi_filter.sketch_bytes", {}).get("rows", 0)
+        )
+
+    def misses():
+        return tracing.get_count("plan.cache.miss")
+
+    def run_measured(lf, reps=1):
+        best = float("inf")
+        w0 = wire_bytes()
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = lf.collect()
+            best = min(best, time.perf_counter() - t0)
+        per_rep = (wire_bytes() - w0) / reps
+        return out.to_pandas(), per_rep, best
+
+    def join_pair(n_left, n_right, sel, tag):
+        """int32 key pair at ~``sel`` join selectivity (the right side's
+        keys shift out of the left keyspace for the complement).
+        ``tag`` names the value column, keeping each phase's plan a
+        DISTINCT structural fingerprint (own plan-cache entries + own
+        observation profile)."""
+        keyspace = max(n_left // 8, 16)
+        lk = rng.integers(0, keyspace, n_left).astype(np.int32)
+        rk = rng.integers(0, keyspace, n_right).astype(np.int32)
+        miss = rng.random(n_right) >= sel
+        rk = np.where(miss, rk + 10 * keyspace, rk).astype(np.int32)
+        lt = ct.Table.from_pydict(
+            ctx, {"k": lk, tag: rng.random(n_left).astype(np.float32)}
+        )
+        rt = ct.Table.from_pydict(
+            ctx, {"rk": rk, "w": rng.random(n_right).astype(np.float32)}
+        )
+        return lt.lazy().join(
+            rt.lazy(), left_on="k", right_on="rk", how="inner"
+        ).groupby("k", {tag: "sum"})
+
+    obs_dir = tempfile.mkdtemp(prefix="cylon_autotune_smoke_")
+    os.environ["CYLON_TPU_AUTOTUNE_MIN_OBS"] = "3"
+    min_obs = 3
+    results = []
+
+    def phase_semi(name, lf, expect_mode):
+        """Static baseline -> cold+warm store -> tuned measurement, with
+        the per-flip recompile pin."""
+        os.environ.pop("CYLON_TPU_OBS_DIR", None)
+        static_df, static_wire, _ = run_measured(lf, reps=2)
+        os.environ["CYLON_TPU_OBS_DIR"] = obs_dir
+        m0 = misses()
+
+        def hysteresis_state():
+            s = obstore.store()
+            return (
+                sum(p.get("flips", 0) for p in s.profiles.values()),
+                any(p.get("pend") for p in s.profiles.values()),
+            )
+
+        # cold store: explore mode measures selectivity; each decision
+        # flip costs one recompile as observations cross the hysteresis
+        # depth (and lands on the NEXT collect). Collect until fully
+        # settled: two consecutive collects with no plan-cache miss, no
+        # new flip, and no pending candidate streak.
+        stable = 0
+        for i in range(8 * (min_obs + 1)):
+            mb, state_b = misses(), hysteresis_state()
+            warm_df, _, _ = run_measured(lf)
+            if not warm_df.equals(static_df):
+                _fail(f"{name}: tuned result differs from static")
+            flips_a, pend_a = hysteresis_state()
+            quiet = (
+                misses() == mb and flips_a == state_b[0] and not pend_a
+            )
+            stable = stable + 1 if quiet else 0
+            if i >= min_obs and stable >= 2:
+                break
+        if stable < 2:
+            _fail(f"{name}: decisions never settled (still recompiling)")
+        new_misses = misses() - m0
+        s = obstore.store()
+        flips = sum(p.get("flips", 0) for p in s.profiles.values())
+        # EXACTLY one recompile per decision flip, plus the cold compile
+        # of the explore-keyed executor — the fingerprint-discipline pin
+        if flips < 1:
+            _fail(f"{name}: no tuned decision flipped in {min_obs + 1} runs")
+        if new_misses != 1 + flips:
+            _fail(
+                f"{name}: expected exactly 1 cold compile + 1 recompile "
+                f"per decision flip ({1 + flips}), saw {new_misses} "
+                "plan-cache misses"
+            )
+        decs = [
+            p["dec"].get("semi_mode") for p in s.profiles.values()
+            if p.get("sel_n") or p.get("payoff_skip")
+        ]
+        if expect_mode not in decs:
+            _fail(f"{name}: expected a semi_mode={expect_mode!r} decision, "
+                  f"store has {decs}")
+        m1 = misses()
+        tuned_df, tuned_wire, _ = run_measured(lf, reps=2)
+        if misses() != m1:
+            _fail(f"{name}: settled warm store still recompiling "
+                  "(hysteresis no-flap violated)")
+        if not tuned_df.equals(static_df):
+            _fail(f"{name}: tuned result differs from static")
+        if tuned_wire >= static_wire:
+            _fail(
+                f"{name}: tuned wire bytes {tuned_wire:.0f} must beat "
+                f"static {static_wire:.0f}"
+            )
+        results.append(
+            f"{name}: wire {static_wire / 1e3:.1f} -> "
+            f"{tuned_wire / 1e3:.1f} KB/query "
+            f"({1 - tuned_wire / static_wire:.0%} saved), "
+            f"decision={expect_mode}, "
+            f"recompiles={new_misses} (1 cold + {flips} flip(s))"
+        )
+
+    def fresh_store():
+        obstore.reset_stores()
+        shutil.rmtree(obs_dir, ignore_errors=True)
+        os.makedirs(obs_dir, exist_ok=True)
+
+    # ---- 1. semi OFF: full-overlap pair with a sketch cap small enough
+    # that the static size gate PASSES — the static config builds a
+    # sketch it never applies (selectivity 1.0), pure wasted wire the
+    # tuned "off" decision recovers
+    n = args.rows
+    os.environ["CYLON_TPU_SKETCH_BITS"] = "32768"
+    try:
+        phase_semi("semi-off", join_pair(n, n // 2, 1.0, "voff"), "off")
+    finally:
+        os.environ.pop("CYLON_TPU_SKETCH_BITS", None)
+
+    # ---- 2. semi ON: low selectivity under the static payoff gate (at
+    # the default sketch cap this schema's prunable/wire ratio sits
+    # under SEMI_FILTER_MIN_PAYOFF at every size, so the static config
+    # never builds the sketch; the warm store measures ~0.1 selectivity
+    # in explore mode and forces it on)
+    fresh_store()
+    phase_semi("semi-on", join_pair(n, n // 2, 0.1, "von"), "on")
+
+    # ---- 3. q3 match-or-beat: the standard fused join->groupby-SUM
+    # shape at full overlap — the autopilot must settle to the static
+    # plan (semi off, budget shrink is byte-neutral) and match it on
+    # both coll bytes and wall
+    fresh_store()
+    os.environ.pop("CYLON_TPU_OBS_DIR", None)
+    q3 = join_pair(n, n // 2, 1.0, "vq3")
+    q3.collect()  # compile outside the timed window
+    q3_df, q3_wire, q3_wall = run_measured(q3, reps=args.reps)
+    os.environ["CYLON_TPU_OBS_DIR"] = obs_dir
+    for _ in range(min_obs + 1):
+        q3.collect()
+    t_df, t_wire, t_wall = run_measured(q3, reps=args.reps)
+    if not t_df.equals(q3_df):
+        _fail("q3: tuned result differs from static")
+    if t_wire > q3_wire:
+        _fail(f"q3: tuned coll bytes {t_wire:.0f} regressed vs static "
+              f"{q3_wire:.0f}")
+    if t_wall > q3_wall * (1.0 + args.wall_tol):
+        _fail(
+            f"q3: tuned wall {t_wall * 1e3:.1f} ms regressed vs static "
+            f"{q3_wall * 1e3:.1f} ms (tol {args.wall_tol:.0%})"
+        )
+    results.append(
+        f"q3: coll {q3_wire / 1e6:.2f} -> {t_wire / 1e6:.2f} MB/query, "
+        f"wall best {q3_wall * 1e3:.1f} -> {t_wall * 1e3:.1f} ms"
+    )
+
+    # ---- 4. store survives a reload (journal/snapshot round-trip) -----
+    obstore.reset_stores()
+    s = obstore.store()
+    if not any(p["dec"] for p in s.profiles.values()):
+        _fail("reloaded store lost its tuned decisions")
+    q3.collect()
+    t2 = q3.collect().to_pandas()
+    if not t2.equals(q3_df):
+        _fail("post-reload result differs")
+
+    obstore.reset_stores()
+    shutil.rmtree(obs_dir, ignore_errors=True)
+    print("AUTOTUNE SMOKE OK")
+    for r in results:
+        print("  " + r)
+
+
+if __name__ == "__main__":
+    main()

@@ -956,6 +956,112 @@ def spill_round_once(seed) -> bool:
     return ok
 
 
+def autotune_round_once(seed) -> bool:
+    """Feedback-autopilot rounds (ISSUE 11): random (shape, selectivity,
+    world, dtype, hysteresis depth) plans run against the
+    CYLON_TPU_NO_AUTOTUNE=1 static-heuristic oracle, then TWICE through
+    a fresh observation store — cold (explore/measure) and warm (tuned
+    decisions active, after enough observations to flip) — asserting
+    exact result equality in every regime. Roughly half the rounds also
+    set a serving p99 target and/or a spill device budget so the
+    serve-bucket and tier-promotion proposers exercise."""
+    import shutil
+    import tempfile
+
+    from cylon_tpu.obs import store as obstore
+    from cylon_tpu.plan.feedback import autotune_disabled
+
+    rng = np.random.default_rng(seed)
+    n_l = int(rng.integers(50, max(MAX_N, 51)))
+    n_r = int(rng.integers(50, max(MAX_N, 51)))
+    keyspace = int(rng.integers(2, 120))
+    # selectivity lever: shift the right side's keyspace so only ~sel of
+    # the left keys can find partners (drives the semi proposer across
+    # its on/static/off bands)
+    sel = float(rng.choice([0.05, 0.3, 0.7, 1.0]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    dtype = str(rng.choice(["int32", "int64", "string"]))
+    null_p = float(rng.choice([0.0, 0.1]))
+    how = str(rng.choice(["inner", "left"]))
+    tail = str(rng.choice(["groupby", "sort", "none"]))
+    min_obs = int(rng.choice([1, 2, 3]))
+    p99_target = bool(rng.random() < 0.5)
+    spill_budget = bool(rng.random() < 0.5)
+    warm_reps = min_obs + 2
+    params = dict(seed=seed, profile="autotune", n_l=n_l, n_r=n_r,
+                  keyspace=keyspace, sel=sel, world=world, dtype=dtype,
+                  null_p=null_p, how=how, tail=tail, min_obs=min_obs,
+                  p99_target=p99_target, spill_budget=spill_budget)
+    ctx = ctx_for(world)
+
+    ldf = rand_frame(rng, n_l, keyspace, dtype, null_p)
+    rdf = rand_frame(rng, n_r, keyspace, dtype, null_p, vname="w").rename(
+        columns={"k": "rk"})
+    if sel < 1.0 and dtype != "string":
+        # shift (1-sel) of the right keys out of the left keyspace
+        mask = rng.random(n_r) >= sel
+        shifted = rdf["rk"].to_numpy(copy=True)
+        for i in np.nonzero(mask)[0]:
+            if shifted[i] is not None:
+                shifted[i] = shifted[i] + 10 * keyspace
+        rdf["rk"] = shifted
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+
+    def build():
+        lazy = lt.lazy().join(rt.lazy(), left_on="k", right_on="rk", how=how)
+        if tail == "groupby":
+            return lazy.groupby("k", {"v": "sum"})
+        if tail == "sort":
+            return lazy.sort("k")
+        return lazy
+
+    with autotune_disabled():
+        oracle = build().collect().to_pandas()
+
+    obs_dir = tempfile.mkdtemp(prefix="cylon_fuzz_obs_")
+    env = {
+        "CYLON_TPU_OBS_DIR": obs_dir,
+        "CYLON_TPU_AUTOTUNE_MIN_OBS": str(min_obs),
+    }
+    if p99_target:
+        env["CYLON_TPU_SERVE_P99_TARGET_MS"] = str(
+            float(rng.choice([0.01, 50.0, 5000.0]))
+        )
+    if spill_budget:
+        env["CYLON_TPU_SPILL_DEVICE_BUDGET"] = str(
+            int(rng.choice([4096, 1 << 20]))
+        )
+    prev = {k: os.environ.get(k) for k in env}
+    for k, v in env.items():
+        os.environ[k] = v
+    ok = True
+    try:
+        cold = build().collect().to_pandas()
+        ok &= check(cold, oracle, f"autotune/cold/{how}/{tail}", params)
+        for rep in range(warm_reps):
+            warm = build().collect().to_pandas()
+            ok &= check(
+                warm, oracle, f"autotune/warm{rep}/{how}/{tail}", params
+            )
+        # a second process generation: reload the store from disk (the
+        # journal/snapshot round-trip) and run once more
+        obstore.reset_stores()
+        reload_run = build().collect().to_pandas()
+        ok &= check(
+            reload_run, oracle, f"autotune/reload/{how}/{tail}", params
+        )
+    finally:
+        for k, p in prev.items():
+            if p is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = p
+        obstore.reset_stores()
+        shutil.rmtree(obs_dir, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -966,7 +1072,7 @@ def main():
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
                              "ordering", "semi", "packing", "serve",
-                             "spill"],
+                             "spill", "autotune"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -984,7 +1090,10 @@ def main():
                          "stacked serving batch path vs the serial "
                          "collect() oracle; 'spill': forced/auto spill "
                          "tiers 1-2 + skew-split schedules (random world/"
-                         "K/skew/dtype) vs the in-core tier-0 oracle")
+                         "K/skew/dtype) vs the in-core tier-0 oracle; "
+                         "'autotune': cold- and warm-store runs of random "
+                         "shapes/selectivities/worlds (+ store reload) vs "
+                         "the CYLON_TPU_NO_AUTOTUNE=1 static oracle")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -994,7 +1103,8 @@ def main():
           "semi": semi_round_once,
           "packing": packing_round_once,
           "serve": serve_round_once,
-          "spill": spill_round_once}.get(args.profile, round_once)
+          "spill": spill_round_once,
+          "autotune": autotune_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0

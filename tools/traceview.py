@@ -1,4 +1,5 @@
-"""traceview: summarize an exported cylon_tpu Chrome trace.
+"""traceview: summarize an exported cylon_tpu Chrome trace — or the
+persistent observation store.
 
 The flight-recorder ring (``cylon_tpu/obs/export.py``) dumps the last N
 query traces as Chrome trace-event JSON — Perfetto-loadable for the
@@ -12,6 +13,19 @@ visual timeline; this tool is the terminal summary for the same file::
         # hundreds of near-identical query tracks — this groups them by
         # plan fingerprint and shows counts, wall quantiles, batch
         # occupancy and the serve.* admission counters instead
+
+Observation-store modes (``CYLON_TPU_OBS_DIR`` or ``--obs-dir``)::
+
+    python -m tools.traceview --profiles            # dump every
+        # per-fingerprint profile snapshot: n, p50/p99, mean semi
+        # selectivity, bytes/row, spill evidence, the TUNED decisions
+        # the feedback re-coster is running with and their flip count
+    python -m tools.traceview --diff                # regression sentinel:
+        # compare the store's current profiles against the saved
+        # baseline (<obs-dir>/baseline.json or --baseline) and flag
+        # p99 / coll-MB regressions past --lat-tol / --coll-tol;
+        # exit 1 when any fingerprint regressed
+    python -m tools.traceview --diff --save-baseline  # bless current
 
 Produce a file with ``CYLON_TPU_TRACE_EXPORT=trace.json`` (written at
 interpreter exit) or programmatically via
@@ -108,9 +122,116 @@ def _print_serving(tracks) -> None:
             print(f"    {k}: {v}")
 
 
+def _open_store(obs_dir):
+    from cylon_tpu.obs import store as obstore
+
+    d = obs_dir or os.environ.get("CYLON_TPU_OBS_DIR", "")
+    if not d:
+        print("no observation store: set CYLON_TPU_OBS_DIR or --obs-dir",
+              file=sys.stderr)
+        return None
+    return obstore.ObsStore(d)
+
+
+def _print_profiles(obs_dir) -> int:
+    s = _open_store(obs_dir)
+    if s is None:
+        return 1
+    summ = s.summary()
+    print(f"observation store {s.dir}: {len(summ)} fingerprint profile(s)"
+          + (f", {s.skipped_lines} torn journal line(s) skipped"
+             if s.skipped_lines else ""))
+    for fp, p in sorted(summ.items(), key=lambda kv: -kv[1]["n"]):
+        line = (f"\n  {fp}: n={p['n']}  lat n={p['lat_n']} "
+                f"p50 {p['p50_ms']:.2f} ms p99 {p['p99_ms']:.2f} ms  "
+                f"coll mean {p['coll_mb_mean']:.2f} MB")
+        if p["mean_sel"] is not None:
+            line += f"  semi sel {p['mean_sel']:.2f}"
+        if p["staged_max"]:
+            line += (f"  staged max {p['staged_max']} B"
+                     f" tier<= {p['tier_max']}")
+        print(line)
+        if p["serve_b"]:
+            bs = ", ".join(f"B={b} x{n}" for b, n in sorted(
+                p["serve_b"].items(), key=lambda kv: int(kv[0])))
+            print(f"    serve batches: {bs}")
+        if p["dec"]:
+            decs = ", ".join(f"{k}={v}" for k, v in sorted(p["dec"].items()))
+            print(f"    tuned: {decs}  (flips {p['flips']})")
+        for name, a in list(p["nodes"].items())[:6]:
+            print(f"    node {name}: x{a['count']}  {a['wall_ms']:.2f} ms"
+                  f"  {a['coll_mb']:.2f} MB  rows {a['rows']}")
+    return 0
+
+
+def _print_diff(obs_dir, baseline, save, lat_tol, coll_tol) -> int:
+    s = _open_store(obs_dir)
+    if s is None:
+        return 1
+    import json as _json
+
+    base_path = baseline or os.path.join(s.dir, "baseline.json")
+    summ = s.summary()
+    if save:
+        # atomic tmp+rename: a killed --save-baseline must never leave a
+        # half-written baseline for the next --diff to choke on
+        tmp = base_path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(summ, f, indent=1, sort_keys=True)
+        os.replace(tmp, base_path)
+        print(f"baseline saved: {base_path} ({len(summ)} fingerprints)")
+        return 0
+    try:
+        with open(base_path) as f:
+            base = _json.load(f)
+    except (OSError, ValueError):
+        print(f"no usable baseline at {base_path} (run --diff "
+              "--save-baseline to bless the current profiles)",
+              file=sys.stderr)
+        return 1
+    regressions = []
+    for fp, cur in sorted(summ.items()):
+        b = base.get(fp)
+        if b is None:
+            print(f"  {fp}: new fingerprint (no baseline)")
+            continue
+        msgs = []
+        if (
+            b.get("lat_n", 0) and cur["lat_n"]
+            and cur["p99_ms"] > b["p99_ms"] * (1.0 + lat_tol)
+        ):
+            msgs.append(
+                f"p99 {b['p99_ms']:.2f} -> {cur['p99_ms']:.2f} ms "
+                f"(+{cur['p99_ms'] / max(b['p99_ms'], 1e-9) - 1:.0%})"
+            )
+        if (
+            b.get("n", 0) and cur["n"]
+            and cur["coll_mb_mean"] > b["coll_mb_mean"] * (1.0 + coll_tol)
+            and cur["coll_mb_mean"] - b["coll_mb_mean"] > 0.01
+        ):
+            msgs.append(
+                f"coll {b['coll_mb_mean']:.2f} -> "
+                f"{cur['coll_mb_mean']:.2f} MB/query"
+            )
+        if msgs:
+            regressions.append(fp)
+            print(f"  REGRESSION {fp}: " + "; ".join(msgs))
+        else:
+            print(f"  ok {fp}: p99 {cur['p99_ms']:.2f} ms, "
+                  f"coll {cur['coll_mb_mean']:.2f} MB")
+    if regressions:
+        print(f"{len(regressions)} regressed fingerprint(s) vs {base_path}",
+              file=sys.stderr)
+        return 1
+    print(f"no regressions vs {base_path} ({len(summ)} fingerprints)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome trace JSON (obs.write_chrome)")
+    ap.add_argument("trace", nargs="?",
+                    help="Chrome trace JSON (obs.write_chrome); omitted "
+                    "for the store modes (--profiles / --diff)")
     ap.add_argument("--tree", action="store_true", help="print span trees")
     ap.add_argument("--top", type=int, default=5,
                     help="hottest span names per query (default 5)")
@@ -118,7 +239,37 @@ def main(argv=None) -> int:
                     help="aggregate by plan fingerprint (loaded-server "
                     "rings: counts, wall quantiles, batch occupancy, "
                     "serve.* counters)")
+    ap.add_argument("--profiles", action="store_true",
+                    help="dump the observation store's per-fingerprint "
+                    "profile snapshots (n, p50/p99, selectivity, tuned "
+                    "decisions)")
+    ap.add_argument("--diff", action="store_true",
+                    help="compare the store's current profiles against "
+                    "the saved baseline; flag p99/coll-MB regressions "
+                    "(exit 1 on any)")
+    ap.add_argument("--obs-dir", default=None,
+                    help="observation store directory (default: "
+                    "CYLON_TPU_OBS_DIR)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON for --diff (default: "
+                    "<obs-dir>/baseline.json)")
+    ap.add_argument("--save-baseline", action="store_true",
+                    help="with --diff: bless the current profiles as the "
+                    "baseline instead of comparing")
+    ap.add_argument("--lat-tol", type=float, default=0.25,
+                    help="--diff p99 regression tolerance (default 0.25)")
+    ap.add_argument("--coll-tol", type=float, default=0.10,
+                    help="--diff coll-MB regression tolerance "
+                    "(default 0.10)")
     args = ap.parse_args(argv)
+
+    if args.profiles:
+        return _print_profiles(args.obs_dir)
+    if args.diff:
+        return _print_diff(args.obs_dir, args.baseline, args.save_baseline,
+                           args.lat_tol, args.coll_tol)
+    if args.trace is None:
+        ap.error("a trace file is required unless --profiles/--diff")
 
     from cylon_tpu.obs import export as ex
 
