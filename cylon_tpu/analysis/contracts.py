@@ -271,6 +271,23 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     "obs.metrics.observe_latency": SyncBudget(
         0, note="lock + dict bump, pure host"
     ),
+    # the ops surface (ISSUE 12): the ledger hook every Table
+    # construction pays, the query-finish stamp, the SLO evaluation and
+    # the Prometheus render are all pure host dict math — a metrics
+    # scrape (or a leak report) can NEVER sync the device
+    "obs.resource.note_table": SyncBudget(
+        0, note="ledger registration: nbytes shape reads + weakref "
+        "finalize, pure host",
+    ),
+    "obs.resource.query_finished": SyncBudget(
+        0, note="leak-detector clock stamp, dict write under lock"
+    ),
+    "SLOMonitor.evaluate": SyncBudget(
+        0, note="rule math over already-collected counter snapshots"
+    ),
+    "obs.export.prometheus_text": SyncBudget(
+        0, note="text render over rollup/ledger/SLO snapshots"
+    ),
     # the serving layer (ISSUE 9): the scheduler worker and the whole
     # submit path own ZERO sync sites — a served query's single sync is
     # QueryFuture.result, whose one budgeted site is the audited blocking
@@ -373,9 +390,20 @@ EFFECT_SIGNATURES: Dict[str, str] = {
     # QueryFuture.result is the single per-query SYNC point; the drain
     # entry points that EXECUTE plans classify like dispatch (SYNC —
     # distributed lowering delegates to the shuffle's budgeted fetches)
+    # the ops surface (ISSUE 12): ledger reads, SLO evaluation and the
+    # endpoint lifecycle are all DISPATCH_SAFE — observability can never
+    # sync the device (acceptance pin: every new obs entry point)
+    "OpsServer.start": "DISPATCH_SAFE",
+    "OpsServer.stop": "DISPATCH_SAFE",
+    "OpsServer.port": "DISPATCH_SAFE",
     "QueryFuture.done": "DISPATCH_SAFE",
     "QueryFuture.exception": "DISPATCH_SAFE",
     "QueryFuture.result": "SYNC",
+    "ResourceLedger.snapshot": "DISPATCH_SAFE",
+    "ResourceLedger.leaks": "DISPATCH_SAFE",
+    "SLOMonitor.evaluate": "DISPATCH_SAFE",
+    "SLOMonitor.states": "DISPATCH_SAFE",
+    "SLOMonitor.healthy": "DISPATCH_SAFE",
     "ServeScheduler.close": "DISPATCH_SAFE",
     "ServeScheduler.drain": "DISPATCH_SAFE",
     "ServeScheduler.pause": "DISPATCH_SAFE",

@@ -62,6 +62,12 @@ _EXTRA_ENTRY_CLASSES = {
     # QueryFuture.result is the SYNC point
     ("cylon_tpu.serve.scheduler", "ServeScheduler"),
     ("cylon_tpu.serve.future", "QueryFuture"),
+    # the ops surface (ISSUE 12): the resource ledger, SLO monitor and
+    # endpoint lifecycle must all certify DISPATCH_SAFE — a metrics
+    # scrape can never sync the device
+    ("cylon_tpu.obs.resource", "ResourceLedger"),
+    ("cylon_tpu.obs.slo", "SLOMonitor"),
+    ("cylon_tpu.obs.export", "OpsServer"),
 }
 
 _DUNDER = "__"

@@ -80,6 +80,13 @@ class CylonContext:
         # stay lock-free (engine.py). RLock: a plan compile holding the
         # lock may build kernels through get_kernel on the same context.
         self._cache_lock = threading.RLock()
+        # the live ops endpoint: /metrics + /healthz + /queries on
+        # CYLON_TPU_METRICS_PORT (idempotent no-op when unset — the
+        # server is process-wide, started by whichever context comes up
+        # first)
+        from .obs.export import ensure_ops_server
+
+        ensure_ops_server()
 
     # -- factory ------------------------------------------------------------
     @classmethod

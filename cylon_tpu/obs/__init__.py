@@ -18,15 +18,27 @@ Four modules, layered bottom-up:
   track per query.
 - :mod:`.store` — the PERSISTENT observation journal (ISSUE 11):
   per-fingerprint profiles surviving across runs under
-  ``CYLON_TPU_OBS_DIR``, the evidence the feedback re-coster
+  ``CYLON_TPU_OBS_DIR`` (one journal per writer process — opsd, workers
+  and benchmarks share a directory), the evidence the feedback re-coster
   (``plan/feedback.py``) tunes the engine's adaptive gates from.
+- :mod:`.resource` — the resource LEDGER (ISSUE 12): live device-HBM
+  accounting via per-Table weakref finalizers, host/disk arena and
+  serving-lease watermarks, per-fingerprint footprint attribution (the
+  admission re-coster's evidence), and the query-scoped leak detector.
+- :mod:`.slo` — rolling-window SLO rules (p99 burn vs target, shed
+  rate, leak, resource headroom) with OK/WARN/BREACH transitions into
+  the flight ring; the ``/healthz`` substrate.
+
+The live ops endpoint (``OpsServer`` in :mod:`.export`, started by
+``CYLON_TPU_METRICS_PORT``) serves all of it: ``/metrics`` (Prometheus
+text exposition), ``/healthz``, ``/queries``.
 
 ``utils/tracing.py`` is the thin compat shim over this package: every
 pre-existing call site (``span``/``bump``/``gauge``/``report``/...)
 keeps working, and the process-global rollup keeps feeding the
 graft-lint plan registry (``analysis/plans.py``) unchanged.
 """
-from . import export, metrics, store, trace  # noqa: F401
+from . import export, metrics, resource, slo, store, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     fingerprint_key,
     latency_quantiles,
@@ -40,22 +52,41 @@ from .trace import (  # noqa: F401
     query_trace,
     tracing_active,
 )
-from .export import traces, write_chrome  # noqa: F401
+from .export import (  # noqa: F401
+    OpsServer,
+    ensure_ops_server,
+    prometheus_text,
+    traces,
+    validate_prometheus,
+    write_chrome,
+)
+from .resource import ResourceLedger, ledger  # noqa: F401
+from .slo import SLOMonitor, monitor  # noqa: F401
 
 __all__ = [
+    "OpsServer",
     "QueryTrace",
+    "ResourceLedger",
+    "SLOMonitor",
     "Span",
     "annotate_add",
+    "ensure_ops_server",
     "export",
     "fingerprint_key",
     "latency_quantiles",
     "latency_report",
+    "ledger",
     "metrics",
+    "monitor",
     "observe_latency",
+    "prometheus_text",
     "query_trace",
+    "resource",
+    "slo",
     "store",
     "trace",
     "traces",
     "tracing_active",
+    "validate_prometheus",
     "write_chrome",
 ]

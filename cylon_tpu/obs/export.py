@@ -1,4 +1,5 @@
-"""Exporters: the flight-recorder ring and Chrome trace-event JSON.
+"""Exporters: the flight-recorder ring, Chrome trace-event JSON, and the
+live ops endpoint (Prometheus text exposition + health + ring-as-JSON).
 
 FLIGHT RING
     A bounded deque of the last N finished :class:`~.trace.QueryTrace`
@@ -18,6 +19,28 @@ CHROME TRACE
 
 ``CYLON_TPU_TRACE_EXPORT=<path>`` writes the ring to ``<path>`` at
 interpreter exit (registered lazily on first recorded trace).
+
+OPS ENDPOINT
+    :class:`OpsServer` — a stdlib ``ThreadingHTTPServer`` started by
+    context init when ``CYLON_TPU_METRICS_PORT`` is set
+    (:func:`ensure_ops_server`) — exposes the whole observability stack
+    to operators without any in-process access:
+
+    - ``/metrics``: Prometheus text exposition (version 0.0.4) of the
+      rollup counters/gauges declared in ``STABLE_METRICS``, the
+      per-fingerprint latency quantiles, the resource ledger's
+      device/host/disk/lease watermarks, and the SLO rule states —
+      exactly the load signal an autoscaler scrapes (ROADMAP item 2).
+    - ``/healthz``: 200 while no SLO rule is in BREACH, 503 otherwise
+      (the shed-storm rule flips it under overload; recovery is the
+      breach aging out of the rolling window after drain).
+    - ``/queries``: the flight-recorder ring as JSON — the "what just
+      happened" dump, scrapeable mid-incident.
+
+    Every evaluation the endpoint triggers is host dict math; scraping
+    can never sync the device. ``python -m tools.traceview --live
+    http://host:port`` renders these endpoints in the terminal, and
+    ``tools/opsd.py`` is the standalone demo/smoke driver.
 """
 from __future__ import annotations
 
@@ -208,3 +231,348 @@ def summarize(doc: Dict) -> Dict[int, Dict]:
                 agg[0] += 1
                 agg[1] += e["dur"] / 1e3
     return tracks
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition (the /metrics substrate)
+# ----------------------------------------------------------------------
+def _prom_name(name: str) -> str:
+    """Metric-name sanitization: dots and dashes become underscores; the
+    result matches the exposition grammar ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    import re
+
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_val(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text() -> str:
+    """The whole observability stack as Prometheus text exposition
+    (format version 0.0.4): rollup counters/spans/gauges (prefixed
+    ``cylon_tpu_``; spans render count + seconds-total, gauges render
+    current value + ``_peak``), per-fingerprint latency quantile
+    summaries, resource-ledger watermarks, and SLO rule states. Pure
+    host reads — a scrape can never touch the device."""
+    from . import metrics as _metrics
+    from . import resource as _resource
+    from . import slo as _slo
+
+    lines: List[str] = []
+
+    def fam(name, kind, help_text):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    # ---- the rollup: counters / spans / gauges -----------------------
+    for raw, s in sorted(_metrics.snapshot().items()):
+        if raw.startswith(("ledger.", "slo.state.")):
+            # re-exposed authoritatively by the dedicated ledger / SLO
+            # sections below (with peaks / rule labels) — emitting the
+            # rollup copies too would duplicate the family
+            continue
+        base = "cylon_tpu_" + _prom_name(raw)
+        if s.get("last") is not None:
+            # gauge family (rollup_value writers): current + process peak
+            fam(base, "gauge", f"gauge {raw} (cylon_tpu rollup)")
+            lines.append(f"{base} {_fmt_val(s['last'])}")
+            fam(base + "_peak", "gauge", f"process peak of {raw}")
+            lines.append(f"{base}_peak {_fmt_val(s['max_s'])}")
+        elif s.get("total_s", 0.0) > 0.0:
+            # span family: event count + total seconds
+            fam(base + "_count", "counter", f"span count {raw}")
+            lines.append(f"{base}_count {_fmt_val(s['count'])}")
+            fam(base + "_seconds_total", "counter", f"span seconds {raw}")
+            lines.append(f"{base}_seconds_total {_fmt_val(s['total_s'])}")
+        else:
+            fam(base + "_total", "counter", f"counter {raw}")
+            lines.append(f"{base}_total {_fmt_val(s['count'])}")
+            if s.get("rows"):
+                fam(base + "_rows_total", "counter", f"rows of {raw}")
+                lines.append(f"{base}_rows_total {_fmt_val(s['rows'])}")
+
+    # ---- per-fingerprint latency quantiles (summary form) ------------
+    rep = _metrics.latency_report()
+    if rep:
+        name = "cylon_tpu_query_latency_seconds"
+        fam(name, "summary",
+            "per-plan-fingerprint query latency (dispatch to deferred "
+            "count-fetch return)")
+        for key, q in sorted(rep.items()):
+            lbl = f'fingerprint="{_prom_escape(key)}"'
+            for quant, field in (("0.5", "p50_s"), ("0.95", "p95_s"),
+                                 ("0.99", "p99_s")):
+                lines.append(
+                    f'{name}{{{lbl},quantile="{quant}"}} '
+                    f"{_fmt_val(q[field])}"
+                )
+            lines.append(f"{name}_count{{{lbl}}} {_fmt_val(q['count'])}")
+            lines.append(
+                f"{name}_sum{{{lbl}}} "
+                f"{_fmt_val(q['mean_s'] * q['count'])}"
+            )
+
+    # ---- resource-ledger watermarks ----------------------------------
+    leds = _resource.ledgers()
+    if leds:
+        snaps = [led.snapshot() for led in leds]
+        # device bytes are per-context (summed); host/disk arenas are
+        # process-global (identical in every snapshot — take one)
+        agg = {
+            "device_bytes": sum(s["device_bytes"] for s in snaps),
+            "device_peak_bytes": sum(s["device_peak"] for s in snaps),
+            "live_tables": sum(s["live_tables"] for s in snaps),
+            "serve_lease_bytes": sum(s["serve_lease_bytes"] for s in snaps),
+            "host_bytes": snaps[0]["host_bytes"],
+            "host_peak_bytes": snaps[0]["host_peak"],
+            "disk_bytes": snaps[0]["disk_bytes"],
+            "disk_peak_bytes": snaps[0]["disk_peak"],
+            "leaked_tables": sum(len(led.leaks()) for led in leds),
+        }
+        for k, v in agg.items():
+            name = f"cylon_tpu_ledger_{k}"
+            fam(name, "gauge", f"resource ledger: {k.replace('_', ' ')}")
+            lines.append(f"{name} {_fmt_val(v)}")
+
+    # ---- SLO rule states ---------------------------------------------
+    states = _slo.state_gauges()
+    if states:
+        name = "cylon_tpu_slo_state"
+        fam(name, "gauge", "SLO rule state: 0=OK 1=WARN 2=BREACH")
+        for rule, st in sorted(states.items()):
+            lines.append(
+                f'{name}{{rule="{_prom_escape(rule)}"}} {_fmt_val(st)}'
+            )
+    return "\n".join(lines) + "\n"
+
+
+def validate_prometheus(text: str) -> List[str]:
+    """Strict line-format check of a text exposition (the ops-smoke CI
+    gate parses every scraped line with this — no client library, no new
+    deps). Returns problem strings; [] = clean."""
+    import re
+
+    name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label_re = (
+        r"\{" + name_re + r'="(?:\\.|[^"\\])*"'
+        r"(?:," + name_re + r'="(?:\\.|[^"\\])*")*\}'
+    )
+    value_re = r"(?:[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))"
+    sample = re.compile(
+        f"^{name_re}(?:{label_re})? {value_re}(?: [-+]?[0-9]+)?$"
+    )
+    help_re = re.compile(f"^# HELP {name_re} .*$")
+    type_re = re.compile(
+        f"^# TYPE ({name_re}) (counter|gauge|summary|histogram|untyped)$"
+    )
+    problems: List[str] = []
+    typed = set()
+    for i, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            if not help_re.match(line):
+                problems.append(f"line {i}: malformed HELP: {line!r}")
+        elif line.startswith("# TYPE "):
+            m = type_re.match(line)
+            if not m:
+                problems.append(f"line {i}: malformed TYPE: {line!r}")
+            elif m.group(1) in typed:
+                problems.append(f"line {i}: duplicate TYPE for {m.group(1)}")
+            else:
+                typed.add(m.group(1))
+        elif line.startswith("#"):
+            continue  # comments are legal
+        elif not sample.match(line):
+            problems.append(f"line {i}: malformed sample: {line!r}")
+    return problems
+
+
+# ----------------------------------------------------------------------
+# the flight ring as JSON (the /queries substrate)
+# ----------------------------------------------------------------------
+def queries_json(trace_list: Optional[List] = None) -> List[Dict]:
+    """The ring, oldest first, as JSON-safe dicts: qid/kind/name/
+    fingerprint/wall + device-resolved ms, attrs and counters."""
+    if trace_list is None:
+        trace_list = traces()
+    out: List[Dict] = []
+    for q in trace_list:
+        dev = q.device_resolved_s()
+        out.append({
+            "qid": q.qid,
+            "kind": q.kind,
+            "name": q.name,
+            "label": q.label,
+            "fingerprint": q.hist_key,
+            "wall_ms": round(q.wall_s() * 1e3, 3),
+            "device_resolved_ms": (
+                None if dev is None else round(dev * 1e3, 3)
+            ),
+            "thread": q.thread,
+            "attrs": {k: _json_safe(v) for k, v in q.attrs.items()},
+            "counters": {
+                k: (c if not r else [c, r])
+                for k, (c, r) in q.counters.items()
+            },
+        })
+    return out
+
+
+# ----------------------------------------------------------------------
+# the stdlib HTTP ops server
+# ----------------------------------------------------------------------
+class OpsServer:
+    """``/metrics`` + ``/healthz`` + ``/queries`` on a daemon thread.
+    Stdlib-only (http.server); start() returns the bound port (pass 0
+    for an ephemeral one — tests and the opsd smoke use that). Binds
+    LOOPBACK by default: the endpoint is unauthenticated and ``/queries``
+    carries query labels/attrs, so exposing it beyond the host is an
+    explicit operator decision (``CYLON_TPU_METRICS_PORT=0.0.0.0:9100``)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._port = int(port)
+        self._host = host
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> int:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+            def _reply(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                from . import slo as _slo
+
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        # a scrape drives the SLO evaluation cadence
+                        _slo.monitor().evaluate()
+                        self._reply(
+                            200, prometheus_text(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        ok, reasons = _slo.monitor().healthy()
+                        self._reply(
+                            200 if ok else 503,
+                            json.dumps({"ok": ok, "reasons": reasons}),
+                            "application/json",
+                        )
+                    elif path == "/queries":
+                        self._reply(
+                            200, json.dumps(queries_json()),
+                            "application/json",
+                        )
+                    else:
+                        self._reply(404, '{"error": "not found"}',
+                                    "application/json")
+                except ConnectionError:  # client went away mid-reply
+                    pass                 # (reset or broken pipe)
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        import threading as _threading
+
+        self._thread = _threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="cylon-tpu-opsd",
+        )
+        self._thread.start()
+        self._port = self._httpd.server_address[1]
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+_ops_lock = threading.Lock()
+_OPS_SERVER: List[Optional[OpsServer]] = [None]
+_OPS_FAILED: List[Optional[str]] = [None]  # knob value whose bind failed
+
+
+def ensure_ops_server() -> Optional[OpsServer]:
+    """Start the process ops server when ``CYLON_TPU_METRICS_PORT`` is
+    set (idempotent; context init calls this). Returns the server, or
+    None when the knob is unset. A failed bind (port in use) is reported
+    once and does not fail context creation — observability must never
+    take the engine down."""
+    raw = _eg.METRICS_PORT.get()
+    if not raw:
+        return None
+    with _ops_lock:
+        if _OPS_SERVER[0] is not None:
+            return _OPS_SERVER[0]
+        if _OPS_FAILED[0] == raw:
+            # this exact knob value already failed: report once, then
+            # stay quiet — a worker pool creating many contexts must not
+            # retry the bind and spam the error per context (a CHANGED
+            # value retries)
+            return None
+        # "9100" binds loopback; "host:9100" (e.g. 0.0.0.0:9100) opts
+        # into a wider bind for an off-host Prometheus scrape
+        host, _, port_s = raw.rpartition(":")
+        try:
+            srv = (
+                OpsServer(int(port_s), host=host) if host
+                else OpsServer(int(raw))
+            )
+            srv.start()
+        except (ValueError, OSError) as e:
+            import sys
+
+            _OPS_FAILED[0] = raw
+            print(
+                f"[cylon_tpu] ops server on CYLON_TPU_METRICS_PORT={raw} "
+                f"failed: {e}", file=sys.stderr,
+            )
+            return None
+        _OPS_FAILED[0] = None
+        _OPS_SERVER[0] = srv
+    return srv
+
+
+def ops_server() -> Optional[OpsServer]:
+    """The running ops server, if any."""
+    with _ops_lock:
+        return _OPS_SERVER[0]
+
+
+def stop_ops_server() -> None:
+    """Stop and drop the process ops server (tests)."""
+    with _ops_lock:
+        srv = _OPS_SERVER[0]
+        _OPS_SERVER[0] = None
+    if srv is not None:
+        srv.stop()

@@ -44,7 +44,8 @@ from ..utils import envgate as _eg
 _lock = threading.Lock()
 
 _ROLLUP: Dict[str, Dict[str, float]] = defaultdict(
-    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "rows": 0}
+    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0, "rows": 0,
+             "last": None}
 )
 
 
@@ -75,6 +76,10 @@ def rollup_value(name: str, value: float) -> None:
         s["count"] += 1
         s["total_s"] += float(value)
         s["max_s"] = max(s["max_s"], float(value))
+        # the CURRENT gauge value (max_s is the process peak): the
+        # Prometheus exposition needs both, and "last is not None" is
+        # how the exporter tells a gauge family from a counter
+        s["last"] = float(value)
 
 
 def get_count(name: str) -> int:
@@ -136,14 +141,8 @@ class Histogram:
         clamped to the observed [min, max] (exact at the extremes)."""
         if not self.n:
             return 0.0
-        target = q * self.n
-        acc = 0
-        for b in sorted(self.buckets):
-            acc += self.buckets[b]
-            if acc >= target:
-                edge = 10.0 ** ((b + 1) / BUCKETS_PER_DECADE)
-                return min(max(edge, self.min_s), self.max_s)
-        return self.max_s
+        edge = bucket_quantile(self.buckets, q)
+        return min(max(edge, self.min_s), self.max_s)
 
 
 #: the in-process histogram registry is BOUNDED: a serving process
@@ -243,6 +242,41 @@ def latency_report() -> Dict[str, Dict[str, float]]:
     return out
 
 
+def bucket_snapshot() -> Dict[str, Dict]:
+    """Raw per-key histogram buckets: ``{key: {label, n, b: {bucket:
+    count}}}``. Bucket counts are monotone, so two snapshots DIFF into
+    the window's distribution — the SLO monitor's rolling-p99 substrate
+    (obs/slo.py); the cumulative registry itself stays windowless."""
+    with _lock:
+        return {
+            k: {
+                "label": _HIST_LABELS.get(k, ""),
+                "n": h.n,
+                "b": dict(h.buckets),
+            }
+            for k, h in _HISTS.items()
+        }
+
+
+def bucket_quantile(buckets: Dict[int, int], q: float) -> float:
+    """THE geometric-bucket quantile read-off (seconds, upper edge of
+    the bucket holding the q-quantile sample), unclamped. The one copy:
+    :meth:`Histogram.quantile` wraps it with the observed min/max clamp,
+    ``obs.store.lat_quantile`` with the profile's, and the SLO monitor's
+    windowed bucket DIFFS use it bare (a diff has no extremes) — a
+    bucket-scheme change can never skew one consumer silently."""
+    n = sum(buckets.values())
+    if not n:
+        return 0.0
+    target = q * n
+    acc = 0
+    for b in sorted(buckets):
+        acc += buckets[b]
+        if acc >= target:
+            return 10.0 ** ((b + 1) / BUCKETS_PER_DECADE)
+    return 0.0
+
+
 def reset_latency() -> None:
     with _lock:
         _HISTS.clear()
@@ -279,9 +313,9 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "tail rows relayed through the host instead of padded rounds)"),
     "shuffle.spill.": (
         "mixed", "spill tiers (parallel/spill.py): tier/peak_device_bytes/"
-        "host_bytes gauges; shuffles/staged_rounds/staged_bytes/"
-        "relay_bytes/tier2_promotions/ooc_joins counters; stage/ooc_* "
-        "spans"),
+        "host_bytes/disk_bytes gauges; shuffles/staged_rounds/"
+        "staged_bytes/relay_bytes/tier2_promotions/ooc_joins counters; "
+        "stage/ooc_* spans"),
     "shuffle.semi_filter.": (
         "mixed", "semi-join gate: selectivity gauge, applied/gate_skipped/"
         "pruned_rows counters, sketch span"),
@@ -305,12 +339,30 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "serve.": (
         "mixed", "query serving (cylon_tpu/serve): queue_depth / "
         "inflight_bytes / batch_occupancy gauges; submitted / completed / "
-        "shed / backpressure.wait / budget_overflow / batches / singles "
+        "backpressure.wait / budget_overflow / batches / singles "
         "counters; batch_cache.hit/miss; serve.stack span"),
+    "serve.shed.": (
+        "counter", "admission sheds split by reason: admission_budget "
+        "(a single estimate exceeds the in-flight budget — load), "
+        "queue_depth (full queue / worker-less nowait — load), "
+        "unconsumed_cap (held results past the 2x hard cap — a consumer "
+        "leak); the SLO rules and an autoscaler read the split to tell "
+        "load from leak"),
     "query.": ("mixed", "query-level rollup: query.traces recorded"),
     "autotune.": (
         "counter", "feedback re-coster applications (plan/feedback.py): "
-        "semi_forced / semi_skipped / tier_promoted"),
+        "semi_forced / semi_skipped / tier_promoted / footprint_admit "
+        "(admission leased the tuned observed footprint instead of the "
+        "static input-bytes estimate)"),
+    "ledger.": (
+        "gauge", "resource ledger (obs/resource.py): device_bytes / "
+        "live_tables gauges (max_s = process peak watermark); the full "
+        "watermark set — host/disk/lease/leaks — is exposed by the "
+        "/metrics ledger section, which reads snapshot() directly"),
+    "slo.": (
+        "mixed", "SLO monitor (obs/slo.py): state.<rule> gauges "
+        "(0=OK 1=WARN 2=BREACH) + transitions counter (each transition "
+        "also lands a kind='slo' record in the flight ring)"),
     "obs.": (
         "counter", "obs-layer internals: hist.evicted (bounded histogram "
         "registry LRU evictions, rows=entries flushed)"),

@@ -13,27 +13,39 @@ statistics should re-plan what a fixed pipeline cannot).
 LAYOUT (under ``CYLON_TPU_OBS_DIR``; unset = the store is disabled and
 every hook here is a cheap no-op):
 
-``journal.jsonl``
-    Append-only, one JSON record per line. Crash-tolerant by design: a
-    torn or truncated tail line (the process died mid-write) is skipped
-    on load — a journal is evidence, never a source of truth that can
-    brick a deployment. Records: ``exec`` (one per plan execution: the
-    shuffle planner's measured counts, gate decisions, selectivity),
-    ``lat`` (one per resolved query latency — the device-resolved wall
-    the histogram substrate observes), ``trace`` (per-node wall/rows/
-    coll bytes from a finished query trace), ``hist`` (an in-process
-    latency histogram evicted by the bounded registry in
-    :mod:`.metrics` — flushed here so no observation is lost).
+``journal-<pid>.jsonl`` (one per writer process)
+    Append-only, one JSON record per line, each writer owning its own
+    file so opsd, worker and benchmark processes can share one
+    ``CYLON_TPU_OBS_DIR`` with no cross-process write coordination (the
+    single-writer limitation ROADMAP item 4 documented is gone; the
+    legacy single-writer ``journal.jsonl`` still reads as writer "").
+    Crash-tolerant by design: a torn or truncated tail line (the
+    process died mid-write) is skipped on load — a journal is evidence,
+    never a source of truth that can brick a deployment. Records:
+    ``exec`` (one per plan execution: the shuffle planner's measured
+    counts, gate decisions, selectivity, device bytes allocated — the
+    footprint evidence), ``lat`` (one per resolved query latency — the
+    device-resolved wall the histogram substrate observes), ``trace``
+    (per-node wall/rows/coll bytes from a finished query trace),
+    ``hist`` (an in-process latency histogram evicted by the bounded
+    registry in :mod:`.metrics` — flushed here so no observation is
+    lost).
 
 ``snapshot.json``
     The compacted store: bounded per-fingerprint PROFILES (count,
     geometric latency buckets -> p50/p99, mean selectivity, observed
-    bytes/row, hottest bucket, staged bytes, per-node aggregates) plus
-    the current tuned decisions and their hysteresis state. Every
-    ``COMPACT_EVERY`` journal records the journal folds into the
-    snapshot (atomic tmp+rename) and truncates, so neither file grows
-    unboundedly; profiles themselves are O(buckets), never O(samples),
-    and the profile set is LRU-bounded (``PROFILE_CAP``).
+    bytes/row, hottest bucket, staged bytes, footprint distribution,
+    per-node aggregates) plus the current tuned decisions and their
+    hysteresis state, and a per-writer ``jseqs`` map of the journal
+    record ids already folded in. Every ``COMPACT_EVERY`` own-journal
+    records the owner re-reads the WHOLE directory (snapshot + every
+    writer's journal) under a cross-process ``flock``, writes the
+    merged snapshot (atomic tmp+rename) and truncates ITS OWN journal
+    only — compactions serialize, only an owner ever truncates its
+    journal, and every load merges whatever is durable, so concurrent
+    writers never lose each other's records. Profiles are O(buckets),
+    never O(samples), and the profile set is LRU-bounded
+    (``PROFILE_CAP``).
 
 KEYING: profiles are keyed by the plan's BASE gated fingerprint — the
 structural fingerprint plus the ordering/semi/lane-pack/spill gate
@@ -105,6 +117,8 @@ def reset_stores() -> None:
 def new_profile() -> Dict[str, Any]:
     return {
         "n": 0,              # exec observations
+        "foot": _new_lat(),  # per-query device-bytes footprint (geometric
+                             # buckets; plan/feedback reads the p95)
         "world": 0,
         "row_bytes": 0,      # last observed exchange row bytes
         "hot": 0,            # max observed hottest-bucket rows
@@ -151,19 +165,18 @@ def lat_record(lat: Dict[str, Any], seconds: float) -> None:
 
 def lat_quantile(lat: Dict[str, Any], q: float) -> float:
     """Upper bucket edge holding the q-quantile, clamped to [min, max] —
-    the same read-off rule as obs.metrics.Histogram.quantile."""
+    the shared read-off (obs.metrics.bucket_quantile) over the profile's
+    string-keyed buckets."""
+    from .metrics import bucket_quantile
+
     n = lat.get("n", 0)
     if not n:
         return 0.0
-    target = q * n
-    acc = 0
-    for b in sorted(lat["b"], key=int):
-        acc += lat["b"][b]
-        if acc >= target:
-            edge = 10.0 ** ((int(b) + 1) / BUCKETS_PER_DECADE)
-            lo = lat["min"] if lat["min"] is not None else edge
-            return min(max(edge, lo), lat["max"])
-    return lat["max"]
+    edge = bucket_quantile(
+        {int(b): c for b, c in lat["b"].items()}, q
+    )
+    lo = lat["min"] if lat["min"] is not None else edge
+    return min(max(edge, lo), lat["max"])
 
 
 def lat_merge(into: Dict[str, Any], other: Dict[str, Any]) -> None:
@@ -178,22 +191,249 @@ def lat_merge(into: Dict[str, Any], other: Dict[str, Any]) -> None:
 
 
 # ----------------------------------------------------------------------
+# directory-level machinery (shared by load and merge-compaction)
+# ----------------------------------------------------------------------
+def _journal_files(directory: str) -> List[tuple]:
+    """``[(writer_id, path)]`` of every journal in the directory, sorted
+    for deterministic replay order; the legacy single-writer
+    ``journal.jsonl`` reads as writer ''."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in sorted(names):
+        if name == "journal.jsonl":
+            out.append(("", os.path.join(directory, name)))
+        elif name.startswith("journal-") and name.endswith(".jsonl"):
+            out.append((name[8:-6], os.path.join(directory, name)))
+    return out
+
+
+@contextlib.contextmanager
+def _dir_lock(directory: str):
+    """Exclusive CROSS-PROCESS compaction lock: ``flock`` on
+    ``<dir>/store.lock``. Two writers compacting concurrently would
+    otherwise lose the first snapshot's fold (last rename wins); under
+    the flock each fold reads the other's just-written snapshot. Reads
+    need no lock — snapshot replacement is an atomic rename and journal
+    appends are line-granular (a torn tail is the already-handled skip
+    case). Yields True when the exclusive lock is HELD; False on
+    platforms without fcntl (or an unlockable volume) — the caller must
+    then skip any multi-writer fold-and-truncate, because an unlocked
+    concurrent compaction could overwrite another writer's fold."""
+    f = None
+    try:
+        import fcntl
+
+        f = open(os.path.join(directory, "store.lock"), "a+")
+        fcntl.flock(f, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        if f is not None:
+            with contextlib.suppress(OSError):
+                f.close()
+            f = None
+    try:
+        yield f is not None
+    finally:
+        if f is not None:
+            with contextlib.suppress(OSError):
+                import fcntl
+
+                fcntl.flock(f, fcntl.LOCK_UN)
+                f.close()
+
+
+def _evict_caps(profiles: Dict, hists: Dict) -> None:
+    while len(profiles) > PROFILE_CAP:
+        oldest = min(profiles, key=lambda fp: profiles[fp].get("seq", 0))
+        del profiles[oldest]
+    while len(hists) > HIST_CAP:
+        hists.pop(next(iter(hists)))
+
+
+def _absorb_record(profiles: Dict, hists: Dict, rec: Dict, seq: int) -> int:
+    """Fold one journal record into the profile/hist dicts; returns the
+    advanced LRU clock. Pure host dict math — shared verbatim by the
+    live absorb path, initial load, and merge-compaction."""
+    kind = rec.get("k")
+    if kind == "hist":
+        h = hists.get(rec.get("key", ""))
+        lat = {
+            "b": rec.get("b", {}), "n": rec.get("n", 0),
+            "total": rec.get("total", 0.0), "min": rec.get("min"),
+            "max": rec.get("max", 0.0),
+        }
+        if h is None:
+            hists[rec.get("key", "")] = {
+                "label": rec.get("label", ""), **lat,
+            }
+        else:
+            lat_merge(h, lat)
+        return seq
+    fp = rec.get("fp")
+    if not fp:
+        return seq
+    p = profiles.get(fp)
+    if p is None:
+        p = profiles[fp] = new_profile()
+    if kind == "exec":
+        p["n"] += 1
+        if rec.get("world"):
+            p["world"] = int(rec["world"])
+        if rec.get("row_bytes"):
+            p["row_bytes"] = int(rec["row_bytes"])
+        p["hot"] = max(p["hot"], int(rec.get("hot", 0)))
+        if rec.get("mean_bucket"):
+            p["mean_bucket"] = int(rec["mean_bucket"])
+        p["staged_max"] = max(p["staged_max"], int(rec.get("staged", 0)))
+        p["tier_max"] = max(p["tier_max"], int(rec.get("tier", 0)))
+        if rec.get("budget"):
+            p["budget"] = int(rec["budget"])
+        p["coll_sum"] += int(rec.get("coll", 0))
+        p["rounds_sum"] += int(rec.get("rounds", 0))
+        p["wire_n"] += 1 if rec.get("wire") else 0
+        p["relay_n"] += 1 if rec.get("relay") else 0
+        if rec.get("static_budget"):
+            p["static_budget"] = int(rec["static_budget"])
+        sels = rec.get("sel")
+        if sels:
+            for s in sels:
+                p["sel_sum"] += float(s)
+                p["sel_n"] += 1
+        p["sketch_built"] += int(rec.get("sketch_built", 0))
+        p["payoff_skip"] += int(rec.get("payoff_skip", 0))
+        # footprint: device bytes the resource ledger attributed to this
+        # execution (a batched exec divides by its query count, so the
+        # distribution stays per-query)
+        dev = rec.get("dev")
+        if dev:
+            qn = max(int(rec.get("qn") or 1), 1)
+            lat_record(p.setdefault("foot", _new_lat()), float(dev) / qn)
+    elif kind == "lat":
+        lat_record(p["lat"], float(rec.get("s", 0.0)))
+        b = rec.get("b")
+        if b:
+            key = str(int(b))
+            p["serve_b"][key] = p["serve_b"].get(key, 0) + 1
+            lat_record(
+                p.setdefault("serve_lat", _new_lat()),
+                float(rec.get("s", 0.0)),
+            )
+    elif kind == "trace":
+        for name, wall_ms, rows, coll in rec.get("nodes", []):
+            agg = p["nodes"].setdefault(name, [0, 0.0, 0, 0])
+            agg[0] += 1
+            agg[1] += float(wall_ms)
+            agg[2] += int(rows)
+            agg[3] += int(coll)
+    else:
+        return seq
+    seq += 1
+    p["seq"] = seq
+    # re-cost the tuned decisions from the updated evidence (the
+    # hysteresis machinery lives with the proposers in plan/feedback).
+    # The record KIND scopes which gates re-propose, so a hysteresis
+    # streak counts gate-RELEVANT observations: one exec record per
+    # query for the shuffle-side gates, one latency sample for the
+    # serve bucket — never both for one query, and trace records
+    # advance nothing.
+    if kind in ("exec", "lat"):
+        from ..plan import feedback as _fb
+
+        _fb.update_profile_decisions(p, kind)
+    return seq
+
+
+def _read_dir(directory: str) -> tuple:
+    """Merged durable view of one observation directory: the snapshot
+    plus every writer's journal replayed (records a writer already
+    folded are skipped via its ``jseqs`` entry; torn/garbled lines are
+    skipped and counted). Returns ``(profiles, hists, jseqs,
+    skipped_lines, per_writer_line_counts)`` where ``jseqs`` holds the
+    max record id durable per writer — what a compaction stamps into the
+    next snapshot."""
+    profiles: Dict[str, Dict[str, Any]] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    jseqs: Dict[str, int] = {}
+    try:
+        with open(os.path.join(directory, "snapshot.json")) as f:
+            snap = json.load(f)
+        profiles = dict(snap.get("profiles", {}))
+        hists = dict(snap.get("hists", {}))
+        if "jseqs" in snap:
+            jseqs = {str(k): int(v) for k, v in snap["jseqs"].items()}
+        elif snap.get("jseq"):
+            # v1 single-writer snapshot: its folded seq covers the
+            # legacy journal.jsonl writer
+            jseqs = {"": int(snap["jseq"])}
+    except (OSError, ValueError):
+        pass  # no/garbled snapshot: profiles rebuild from the journals
+    seq = max([p.get("seq", 0) for p in profiles.values()] + [0])
+    skipped = 0
+    lines: Dict[str, int] = {}
+    for writer, path in _journal_files(directory):
+        folded = jseqs.get(writer, 0)
+        seen = folded
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        skipped += 1
+                        continue
+                    if not isinstance(rec, dict):
+                        skipped += 1
+                        continue
+                    i = rec.get("i")
+                    if isinstance(i, int):
+                        if i <= folded:
+                            continue  # already folded into the snapshot
+                        seen = max(seen, i)
+                    seq = _absorb_record(profiles, hists, rec, seq)
+                    lines[writer] = lines.get(writer, 0) + 1
+        except OSError:
+            continue
+        if seen:
+            jseqs[writer] = seen
+    _evict_caps(profiles, hists)
+    return profiles, hists, jseqs, skipped, lines
+
+
+# ----------------------------------------------------------------------
 # the store
 # ----------------------------------------------------------------------
 class ObsStore:
-    """One observation directory: profiles + journal + compaction."""
+    """One observation directory: profiles + own journal + merge-aware
+    compaction. ``writer_id`` defaults to the process id — every process
+    appends to its own ``journal-<pid>.jsonl``, so N processes share one
+    directory with no write coordination (tests pass explicit ids to
+    simulate multiple writers in one process)."""
 
-    def __init__(self, directory: str, compact_every: int = COMPACT_EVERY):
+    def __init__(
+        self,
+        directory: str,
+        compact_every: int = COMPACT_EVERY,
+        writer_id: Optional[str] = None,
+    ):
         self.dir = directory
         self.compact_every = int(compact_every)
-        self.journal_path = os.path.join(directory, "journal.jsonl")
+        self.writer_id = str(os.getpid()) if writer_id is None else writer_id
+        self.journal_path = os.path.join(
+            directory, f"journal-{self.writer_id}.jsonl"
+        )
         self.snapshot_path = os.path.join(directory, "snapshot.json")
         self._lock = threading.RLock()
         self._jf = None
         self._jlines = 0
         self._since_flush = 0
-        self._rec_seq = 0   # monotone journal record id (replay dedup)
+        self._rec_seq = 0   # own monotone journal record id (replay dedup)
         self._seq = 0
+        self._jseqs: Dict[str, int] = {}
         self.profiles: Dict[str, Dict[str, Any]] = {}
         self.hists: Dict[str, Dict[str, Any]] = {}
         self.skipped_lines = 0  # torn/garbled journal lines on load
@@ -202,48 +442,19 @@ class ObsStore:
     # -- load / persistence --------------------------------------------
     def _load(self) -> None:
         os.makedirs(self.dir, exist_ok=True)
-        jseq = 0
-        try:
-            with open(self.snapshot_path) as f:
-                snap = json.load(f)
-            self.profiles = dict(snap.get("profiles", {}))
-            self.hists = dict(snap.get("hists", {}))
-            jseq = int(snap.get("jseq", 0))
-        except (OSError, ValueError):
-            pass  # no/garbled snapshot: profiles rebuild from the journal
+        # merge-on-load: the snapshot plus EVERY writer's journal — a
+        # crash mid-append costs at most the records after the last
+        # complete line of one journal, never the store; records the
+        # snapshot already folded are skipped per-writer so the window
+        # between a compaction's snapshot rename and its journal
+        # truncate never double-absorbs.
+        (self.profiles, self.hists, self._jseqs,
+         self.skipped_lines, lines) = _read_dir(self.dir)
         self._seq = max(
             [p.get("seq", 0) for p in self.profiles.values()] + [0]
         )
-        self._rec_seq = jseq
-        # replay the journal, skipping torn/truncated lines: a crash
-        # mid-append must cost at most the records after the last
-        # complete line, never the store. Records whose id is already
-        # covered by the snapshot's jseq are skipped too — a crash in the
-        # window between compact()'s snapshot rename and its journal
-        # truncate must not double-absorb the folded records.
-        try:
-            with open(self.journal_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        self.skipped_lines += 1
-                        continue
-                    if not isinstance(rec, dict):
-                        self.skipped_lines += 1
-                        continue
-                    i = rec.get("i")
-                    if isinstance(i, int):
-                        if i <= jseq:
-                            continue  # already folded into the snapshot
-                        self._rec_seq = max(self._rec_seq, i)
-                    self._absorb(rec)
-                    self._jlines += 1
-        except OSError:
-            pass
+        self._rec_seq = self._jseqs.get(self.writer_id, 0)
+        self._jlines = lines.get(self.writer_id, 0)
         # prime the decision caches for the feedback layer
         from ..plan import feedback as _fb
 
@@ -281,45 +492,124 @@ class ObsStore:
             if self._jlines >= self.compact_every:
                 self.compact()
 
-    def compact(self) -> None:
-        """Fold the journal into the snapshot (atomic tmp+rename) and
-        truncate it; bounds both files."""
+    def flush(self) -> None:
+        """Flush the buffered journal tail to disk: multi-writer callers
+        (opsd beside a worker) use this to make records visible to other
+        processes' loads before the FLUSH_EVERY cadence would."""
         with self._lock:
-            self._evict()
-            tmp = self.snapshot_path + ".tmp"
-            try:
-                with open(tmp, "w") as f:
-                    json.dump(
-                        {"v": 1, "jseq": self._rec_seq,
-                         "profiles": self._persistable(),
-                         "hists": self.hists},
-                        f, separators=(",", ":"),
-                    )
-                os.replace(tmp, self.snapshot_path)
-                if self._jf is not None:
-                    self._jf.close()
-                    self._jf = None
-                open(self.journal_path, "w").close()
-                self._jlines = 0
-                self._since_flush = 0
-            except OSError:
+            if self._jf is not None:
                 with contextlib.suppress(OSError):
-                    os.unlink(tmp)
+                    self._jf.flush()
+                self._since_flush = 0
 
-    def _persistable(self) -> Dict[str, Dict[str, Any]]:
-        return {
-            fp: {k: v for k, v in p.items() if not k.startswith("_")}
-            for fp, p in self.profiles.items()
-        }
+    def compact(self) -> None:
+        """Fold the DIRECTORY — snapshot plus every writer's journal,
+        re-read fresh under the cross-process flock — into a new merged
+        snapshot (atomic tmp+rename), then truncate OWN journal only.
+        Concurrent writers keep appending; their durable records fold in
+        (their ``jseqs`` advance so their own later compaction skips
+        them), their journals are never touched, and the merged view is
+        adopted in memory — so a long-lived writer also SEES its
+        neighbors' profiles after each compaction, not just at load."""
+        with self._lock:
+            # flush own buffered tail first: the disk fold below must
+            # see every record this process holds
+            if self._jf is not None:
+                with contextlib.suppress(OSError):
+                    self._jf.flush()
+                self._since_flush = 0
+            with _dir_lock(self.dir) as locked:
+                if not locked and len(_journal_files(self.dir)) > 1:
+                    # no cross-process lock available and other writers
+                    # exist: an unlocked fold racing their compaction
+                    # could overwrite records. Correctness beats bounds —
+                    # leave the journal growing; single-writer
+                    # directories still compact (the pre-multi-writer
+                    # behavior, which needed no lock)
+                    return
+                profiles, hists, jseqs, _skipped, _lines = _read_dir(self.dir)
+                # own jseq stays monotone even when a record was absorbed
+                # in memory but never journaled (full/readonly volume)
+                jseqs[self.writer_id] = max(
+                    jseqs.get(self.writer_id, 0), self._rec_seq
+                )
+                # jseq entries whose journal file is ALREADY gone (reaped
+                # by an earlier compaction) have nothing left to dedup —
+                # drop them so dead pids don't accumulate in the snapshot
+                on_disk = {w for w, _p in _journal_files(self.dir)}
+                jseqs = {
+                    w: s for w, s in jseqs.items()
+                    if w in on_disk or w == self.writer_id
+                }
+                tmp = self.snapshot_path + ".tmp"
+                try:
+                    with open(tmp, "w") as f:
+                        json.dump(
+                            {"v": 2, "jseqs": jseqs,
+                             "profiles": {
+                                 fp: {k: v for k, v in p.items()
+                                      if not k.startswith("_")}
+                                 for fp, p in profiles.items()
+                             },
+                             "hists": hists},
+                            f, separators=(",", ":"),
+                        )
+                    os.replace(tmp, self.snapshot_path)
+                    if self._jf is not None:
+                        self._jf.close()
+                        self._jf = None
+                    open(self.journal_path, "w").close()
+                    # reap DEAD writers' journals: their records are all
+                    # in the snapshot just renamed (the fold read them)
+                    # and a dead pid can never append again — without
+                    # this, every short-lived process sharing the
+                    # directory leaves a file each load/compact must
+                    # re-parse forever. Live or unverifiable writers
+                    # (non-pid test ids, the legacy '' writer) are left
+                    # alone: unlinking a file a live writer holds open
+                    # would silently orphan its future appends.
+                    self._reap_dead_journals()
+                except OSError:
+                    with contextlib.suppress(OSError):
+                        os.unlink(tmp)
+                    return
+            # adopt the merged view (includes concurrent writers' records)
+            from ..plan import feedback as _fb
+
+            self.profiles = profiles
+            self.hists = hists
+            self._jseqs = jseqs
+            self._seq = max(
+                [p.get("seq", 0) for p in profiles.values()] + [0]
+            )
+            self._jlines = 0
+            self._since_flush = 0
+            for p in self.profiles.values():
+                p["_dec"] = _fb.effective_decisions(p)
 
     def _evict(self) -> None:
-        while len(self.profiles) > PROFILE_CAP:
-            oldest = min(
-                self.profiles, key=lambda fp: self.profiles[fp].get("seq", 0)
-            )
-            del self.profiles[oldest]
-        while len(self.hists) > HIST_CAP:
-            self.hists.pop(next(iter(self.hists)))
+        _evict_caps(self.profiles, self.hists)
+
+    def _reap_dead_journals(self) -> None:
+        """Unlink journals of writers that are provably dead (numeric
+        pid, ``os.kill(pid, 0)`` fails). Called under the compaction
+        flock, right after the merged snapshot rename — every record the
+        file held is durable in the snapshot, and the owner can never
+        append again. The stale ``jseqs`` entry is dropped by the NEXT
+        compaction (it keys on the file's absence), so a crash between
+        the rename and this unlink still dedups correctly."""
+        for writer, path in _journal_files(self.dir):
+            if writer == self.writer_id or not writer.isdigit():
+                continue
+            try:
+                os.kill(int(writer), 0)
+                continue  # alive (or a recycled pid): never touch it
+            except ProcessLookupError:
+                pass
+            except OSError:
+                continue  # no permission to signal: assume alive
+            with contextlib.suppress(OSError):
+                os.unlink(path)
 
     def close(self) -> None:
         with self._lock:
@@ -329,96 +619,12 @@ class ObsStore:
                 self._jf = None
 
     # -- absorption ----------------------------------------------------
-    def _profile(self, fp: str) -> Dict[str, Any]:
-        p = self.profiles.get(fp)
-        if p is None:
-            p = self.profiles[fp] = new_profile()
-            # stamp the LRU clock at creation: a freshly-admitted profile
-            # must never be the eviction victim of its own admission
-            self._seq += 1
-            p["seq"] = self._seq
-            if len(self.profiles) > PROFILE_CAP:
-                self._evict()
-        return p
-
     def _absorb(self, rec: Dict[str, Any]) -> None:
-        kind = rec.get("k")
-        if kind == "hist":
-            h = self.hists.get(rec.get("key", ""))
-            lat = {
-                "b": rec.get("b", {}), "n": rec.get("n", 0),
-                "total": rec.get("total", 0.0), "min": rec.get("min"),
-                "max": rec.get("max", 0.0),
-            }
-            if h is None:
-                self.hists[rec.get("key", "")] = {
-                    "label": rec.get("label", ""), **lat,
-                }
-            else:
-                lat_merge(h, lat)
-            return
-        fp = rec.get("fp")
-        if not fp:
-            return
-        p = self._profile(fp)
-        if kind == "exec":
-            p["n"] += 1
-            if rec.get("world"):
-                p["world"] = int(rec["world"])
-            if rec.get("row_bytes"):
-                p["row_bytes"] = int(rec["row_bytes"])
-            p["hot"] = max(p["hot"], int(rec.get("hot", 0)))
-            if rec.get("mean_bucket"):
-                p["mean_bucket"] = int(rec["mean_bucket"])
-            p["staged_max"] = max(p["staged_max"], int(rec.get("staged", 0)))
-            p["tier_max"] = max(p["tier_max"], int(rec.get("tier", 0)))
-            if rec.get("budget"):
-                p["budget"] = int(rec["budget"])
-            p["coll_sum"] += int(rec.get("coll", 0))
-            p["rounds_sum"] += int(rec.get("rounds", 0))
-            p["wire_n"] += 1 if rec.get("wire") else 0
-            p["relay_n"] += 1 if rec.get("relay") else 0
-            if rec.get("static_budget"):
-                p["static_budget"] = int(rec["static_budget"])
-            sels = rec.get("sel")
-            if sels:
-                for s in sels:
-                    p["sel_sum"] += float(s)
-                    p["sel_n"] += 1
-            p["sketch_built"] += int(rec.get("sketch_built", 0))
-            p["payoff_skip"] += int(rec.get("payoff_skip", 0))
-        elif kind == "lat":
-            lat_record(p["lat"], float(rec.get("s", 0.0)))
-            b = rec.get("b")
-            if b:
-                key = str(int(b))
-                p["serve_b"][key] = p["serve_b"].get(key, 0) + 1
-                lat_record(
-                    p.setdefault("serve_lat", _new_lat()),
-                    float(rec.get("s", 0.0)),
-                )
-        elif kind == "trace":
-            for name, wall_ms, rows, coll in rec.get("nodes", []):
-                agg = p["nodes"].setdefault(name, [0, 0.0, 0, 0])
-                agg[0] += 1
-                agg[1] += float(wall_ms)
-                agg[2] += int(rows)
-                agg[3] += int(coll)
-        else:
-            return
-        self._seq += 1
-        p["seq"] = self._seq
-        # re-cost the tuned decisions from the updated evidence (the
-        # hysteresis machinery lives with the proposers in plan/feedback).
-        # The record KIND scopes which gates re-propose, so a hysteresis
-        # streak counts gate-RELEVANT observations: one exec record per
-        # query for the shuffle-side gates, one latency sample for the
-        # serve bucket — never both for one query, and trace records
-        # advance nothing.
-        if kind in ("exec", "lat"):
-            from ..plan import feedback as _fb
-
-            _fb.update_profile_decisions(p, kind)
+        """Fold one live record into this store's state (the shared
+        :func:`_absorb_record` fold plus on-the-fly cap eviction)."""
+        self._seq = _absorb_record(self.profiles, self.hists, rec, self._seq)
+        if len(self.profiles) > PROFILE_CAP or len(self.hists) > HIST_CAP:
+            self._evict()
 
     # -- read side ------------------------------------------------------
     def dec_tuple(self, fp: str) -> Optional[tuple]:
@@ -461,6 +667,10 @@ class ObsStore:
                     "hot": p["hot"],
                     "staged_max": p["staged_max"],
                     "tier_max": p["tier_max"],
+                    "foot_n": p.get("foot", {}).get("n", 0),
+                    "foot_p95": int(
+                        lat_quantile(p.get("foot") or _new_lat(), 0.95)
+                    ),
                     "serve_b": dict(p["serve_b"]),
                     "dec": {
                         k: v for k, v in p["dec"].items() if v is not None
@@ -560,6 +770,27 @@ def note_semi(
         rec["sketch_built"] = rec.get("sketch_built", 0) + 1
     if payoff_skip:
         rec["payoff_skip"] = rec.get("payoff_skip", 0) + 1
+
+
+def note_dev_bytes(n: int) -> None:
+    """Fold device bytes the resource ledger attributed to the active
+    plan execution into its exec record — the per-fingerprint FOOTPRINT
+    evidence the admission re-coster reads (plan/feedback.py). Pure
+    contextvar + dict math; ``nbytes`` was already host-known."""
+    if not n:
+        return
+    rec = _EXEC.get()
+    if rec is None:
+        return
+    rec["dev"] = rec.get("dev", 0) + int(n)
+
+
+def note_batch_queries(qn: int) -> None:
+    """Stamp the active exec record with the number of queries a batched
+    execution served, so its footprint absorbs as per-query bytes."""
+    rec = _EXEC.get()
+    if rec is not None:
+        rec["qn"] = int(qn)
 
 
 def observe_latency(

@@ -210,6 +210,12 @@ def _maybe_finish(q: QueryTrace) -> None:
     # persist the trace's per-node wall/rows/coll bytes when the
     # observation store is on (host dict+file work only — never a sync)
     _obsstore.record_trace(q)
+    # stamp the finish time for the resource ledger's leak detector
+    # (tables attributed to this query age against THIS clock); lazy
+    # import — resource imports this module for the contextvar
+    from . import resource as _resource
+
+    _resource.query_finished(q)
 
 
 # ----------------------------------------------------------------------

@@ -260,16 +260,43 @@ def plan_schedule(
 
 _arena_lock = threading.Lock()
 _ARENA_LIVE_BYTES = 0
+_ARENA_PEAK_BYTES = 0
+_ARENA_DISK_BYTES = 0
+_ARENA_DISK_PEAK = 0
 
 
 def _arena_adjust(delta: int) -> None:
     """Track total live arena bytes; the gauge's max is the process peak
     (the satellite's 'report peak host bytes' evidence)."""
-    global _ARENA_LIVE_BYTES
+    global _ARENA_LIVE_BYTES, _ARENA_PEAK_BYTES
     with _arena_lock:
         _ARENA_LIVE_BYTES += delta
+        _ARENA_PEAK_BYTES = max(_ARENA_PEAK_BYTES, _ARENA_LIVE_BYTES)
         live = _ARENA_LIVE_BYTES
     gauge("shuffle.spill.host_bytes", live)
+
+
+def _disk_adjust(delta: int) -> None:
+    """Track the memmap-backed (tier-2) slice of the live arena bytes
+    separately, so the resource ledger can report host RAM and spill
+    disk as distinct watermarks."""
+    global _ARENA_DISK_BYTES, _ARENA_DISK_PEAK
+    with _arena_lock:
+        _ARENA_DISK_BYTES += delta
+        _ARENA_DISK_PEAK = max(_ARENA_DISK_PEAK, _ARENA_DISK_BYTES)
+        disk = _ARENA_DISK_BYTES
+    gauge("shuffle.spill.disk_bytes", disk)
+
+
+def arena_bytes() -> tuple:
+    """(live, peak, disk_live, disk_peak) total arena bytes — the
+    resource ledger's host/disk axis (obs/resource.py wraps these beside
+    the ``shuffle.spill.*`` gauges)."""
+    with _arena_lock:
+        return (
+            _ARENA_LIVE_BYTES, _ARENA_PEAK_BYTES,
+            _ARENA_DISK_BYTES, _ARENA_DISK_PEAK,
+        )
 
 
 class HostArena:
@@ -298,6 +325,7 @@ class HostArena:
         self._owns_dir = False
         self._nfiles = 0
         self._bytes = 0
+        self._disk = 0
         # per column: [data buffer, valid buffer | None]
         self._bufs: List[List[Optional[np.ndarray]]] = [
             [None, None] for _ in self.schema
@@ -344,13 +372,20 @@ class HostArena:
         dtype promotion both land here, so the host-budget check and the
         ``shuffle.spill.host_bytes`` gauge never understate memory)."""
         total = 0
+        disk = 0
         for (name, dtype, _hv), (d, v) in zip(self.schema, self._bufs):
             if d is not None:
                 total += self._cap * 8 if dtype == np.dtype(object) else d.nbytes
+                if isinstance(d, np.memmap):
+                    disk += d.nbytes
             if v is not None:
                 total += v.nbytes
+                if isinstance(v, np.memmap):
+                    disk += v.nbytes
         _arena_adjust(total - self._bytes)
+        _disk_adjust(disk - self._disk)
         self._bytes = total
+        self._disk = disk
 
     def reserve(self, extra: int) -> None:
         """Ensure capacity for ``extra`` more rows (count-pass sizing:
@@ -426,7 +461,9 @@ class HostArena:
 
     def close(self) -> None:
         _arena_adjust(-self._bytes)
+        _disk_adjust(-self._disk)
         self._bytes = 0
+        self._disk = 0
         for pair in self._bufs:
             self._release_buf(pair[0])
             self._release_buf(pair[1])

@@ -81,6 +81,9 @@ BUDGET_CEIL = 1 << 28
 #: of the device budget; release the promotion under the low-water mark
 SPILL_HIGH_WATER = 0.8
 SPILL_LOW_WATER = 0.6
+#: tuned admission footprints never lease below this (mirrors the
+#: scheduler's _EST_FLOOR: zero-size queries stay countable)
+FOOTPRINT_FLOOR = 1024
 
 
 class Decisions(NamedTuple):
@@ -92,6 +95,12 @@ class Decisions(NamedTuple):
     semi_mode: Optional[str] = None   # "explore" | "on" | "off" | None
     serve_bucket: Optional[int] = None
     spill_tier: Optional[int] = None
+    #: observed per-query device footprint (pow2-rounded p95 bytes from
+    #: the resource ledger's evidence): the serving scheduler leases
+    #: THIS instead of the static input-bytes estimate — small-footprint
+    #: shapes admit more concurrency, over-estimated shapes stop
+    #: thrashing backpressure (ROADMAP item 4's admission follow-up)
+    footprint: Optional[int] = None
 
 
 DECISIONS_OFF = Decisions()
@@ -248,6 +257,7 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         sm,
         dec.get("serve_bucket"),
         dec.get("spill_tier"),
+        dec.get("footprint"),
     )
 
 
@@ -262,6 +272,7 @@ def update_profile_decisions(p: Dict[str, Any], kind: str = "exec") -> None:
     m = min_observations()
     dec = p.setdefault("dec", {})
     pend = p.setdefault("pend", {})
+    flipped = False
     for field, (cand, margin_ok) in _proposals(p, kind).items():
         cur = dec.get(field)
         if cand == cur:
@@ -273,7 +284,14 @@ def update_profile_decisions(p: Dict[str, Any], kind: str = "exec") -> None:
             pe[1] += 1
         else:
             pe = pend[field] = [enc, 1]
-        if pe[1] >= m and margin_ok:
+        if pe[1] >= m and margin_ok and not flipped:
+            # at most ONE field flips per observation: every flip
+            # re-keys the plan, and the recompile pin (exactly one
+            # plan-cache miss per flip) must hold even when two gates'
+            # hysteresis streaks mature on the same record — the
+            # runner-up keeps its matured streak and flips on the next
+            # gate-relevant observation
+            flipped = True
             dec[field] = cand
             pend.pop(field, None)
             p["flips"] = p.get("flips", 0) + 1
@@ -322,6 +340,19 @@ def _proposals(
                 out["spill_tier"] = (_spill.TIER_HOST, True)
             elif p.get("staged_max", 0) < SPILL_LOW_WATER * budget:
                 out["spill_tier"] = (None, True)
+
+        # -- admission footprint: lease observed bytes, not the static
+        # input-size estimate. The p95 of the ledger-attributed per-query
+        # device bytes, pow2-rounded so the candidate is STABLE under
+        # run-to-run noise (hysteresis needs consecutive identical
+        # proposals; raw p95 would never repeat) -------------------------
+        foot = p.get("foot") or {}
+        if foot.get("n", 0) >= m:
+            from ..obs.store import lat_quantile
+
+            p95 = lat_quantile(foot, 0.95)
+            cand = 1 << max(int(p95) - 1, 1).bit_length()
+            out["footprint"] = (max(cand, FOOTPRINT_FLOOR), True)
 
     elif kind == "lat":
         # -- serve batch bucket vs the p99 target, judged ONLY on the
@@ -443,5 +474,11 @@ def describe(base: tuple) -> list:
         lines.append(
             f"spill_tier tuned: {d.spill_tier} "
             f"(was budget-line, n={p.get('n', 0)})"
+        )
+    if d.footprint is not None:
+        lines.append(
+            f"admission footprint tuned: {d.footprint} B "
+            f"(was input-bytes estimate, "
+            f"n={p.get('foot', {}).get('n', 0)})"
         )
     return lines

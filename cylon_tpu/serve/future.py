@@ -30,9 +30,10 @@ class ServeOverloadError(RuntimeError):
     Raised AT SUBMIT (never from ``result()``) when the query cannot be
     admitted: its estimated bytes alone exceed the in-flight budget, or
     the queue is at ``CYLON_TPU_SERVE_QUEUE_DEPTH`` and the caller asked
-    not to wait (``block=False``). The shed is counted under
-    ``serve.shed`` and sheds nothing already admitted — a loaded server
-    degrades by rejecting new work, not by OOMing the work it accepted.
+    not to wait (``block=False``). The shed is counted by reason under
+    ``serve.shed.*`` (admission_budget / queue_depth / unconsumed_cap)
+    and sheds nothing already admitted — a loaded server degrades by
+    rejecting new work, not by OOMing the work it accepted.
     """
 
 

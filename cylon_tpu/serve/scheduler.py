@@ -7,14 +7,21 @@ the submit path:
 ADMISSION (caller thread, ``submit``)
     Every query carries a bytes estimate derived from its bound input
     tables' device buffers (capacity-based, so a deferred-count handle
-    estimates without syncing). The estimate is held against the budget
+    estimates without syncing) — or, once the feedback re-coster has
+    settled a ``footprint`` decision for the shape, the OBSERVED
+    per-query p95 device footprint from the resource ledger
+    (obs/resource.py; ``CYLON_TPU_NO_AUTOTUNE=1`` restores the static
+    estimate). The estimate is held against the budget
     from admission until the query is CONSUMED — released when
     ``QueryFuture.result()`` materializes it, when it fails, or when an
     unconsumed future is garbage-collected — so the bound covers queued
     work, executing batches, AND fulfilled-but-unread result buffers. A
     query whose estimate alone exceeds
     ``CYLON_TPU_SERVE_INFLIGHT_BYTES`` is shed with
-    :class:`~.future.ServeOverloadError`; otherwise the submitter waits
+    :class:`~.future.ServeOverloadError` (sheds count by REASON —
+    ``serve.shed.admission_budget`` / ``queue_depth`` /
+    ``unconsumed_cap`` — so the SLO rules and an autoscaler can tell
+    offered load from a consumer leak); otherwise the submitter waits
     (backpressure) while held bytes would overflow the budget or the
     queue sits at ``CYLON_TPU_SERVE_QUEUE_DEPTH`` (``block=False`` — or
     any submit on a worker-less scheduler, where blocking could never
@@ -169,7 +176,16 @@ class ServeScheduler:
         plan = lf.plan
         tables = _plan_lower.scan_tables(plan)
         fingerprint = _lazy.gated_fingerprint(plan)
-        est = estimate_query_bytes(tables)
+        # admission estimate: the tuned OBSERVED footprint when the
+        # feedback re-coster has settled one for this shape (the ledger's
+        # per-query p95, riding the fingerprint under the same hysteresis
+        # + CYLON_TPU_NO_AUTOTUNE-oracle discipline as every other tuned
+        # decision), else the static input-bytes estimate
+        tuned_fp = _feedback.decisions_of(fingerprint).footprint
+        if tuned_fp:
+            est = max(int(tuned_fp), _EST_FLOOR)
+        else:
+            est = estimate_query_bytes(tables)
         fut = QueryFuture(time.perf_counter(), est, wrap=wrap)
         # batchability is structure-determined, i.e. a function of the
         # fingerprint: memoize so the hot submit path skips the
@@ -189,7 +205,7 @@ class ServeScheduler:
                 self._batchable.pop(next(iter(self._batchable)))
             self._batchable[fingerprint[0]] = batchable
             if est > cap:
-                bump("serve.shed")
+                bump("serve.shed.admission_budget")
                 raise ServeOverloadError(
                     f"query estimate {est} B exceeds the in-flight budget "
                     f"CYLON_TPU_SERVE_INFLIGHT_BYTES={cap}"
@@ -208,7 +224,7 @@ class ServeScheduler:
                     # which admission sheds — the graceful-degradation
                     # bound: memory tops out at ~2x budget, never OOM.
                     if self._inflight_bytes + est > 2 * cap:
-                        bump("serve.shed")
+                        bump("serve.shed.unconsumed_cap")
                         raise ServeOverloadError(
                             f"unconsumed results hold "
                             f"{self._inflight_bytes} B (> 2x the "
@@ -221,7 +237,7 @@ class ServeScheduler:
                 if not block or self._thread is None:
                     # a worker-less scheduler must never block: only
                     # run_pending() in THIS thread could make progress
-                    bump("serve.shed")
+                    bump("serve.shed.queue_depth")
                     raise ServeOverloadError(
                         f"serving at capacity (queue {len(self._queue)}, "
                         f"in-flight {self._inflight_bytes} B) and "
@@ -236,6 +252,10 @@ class ServeScheduler:
             self._queue.append(rec)
             self._inflight_bytes += est
             bump("serve.submitted")
+            if tuned_fp:
+                # counted only once the lease actually holds the tuned
+                # bytes — a shed/backpressured submit is not an admission
+                bump("autotune.footprint_admit")
             gauge("serve.queue_depth", len(self._queue))
             gauge("serve.inflight_bytes", self._inflight_bytes)
             self._work.notify()
@@ -493,6 +513,10 @@ class ServeScheduler:
         with _obstrace.query_trace(entry.label, kind="serve") as q:
             with _feedback.applying(orig_fp[-1]), \
                     _obsstore.exec_obs(entry.obs_key):
+                # the ledger attributes this stacked program's device
+                # bytes to ONE exec record; stamp the query count so the
+                # footprint distribution stays per-query
+                _obsstore.note_batch_queries(b)
                 stacked = [
                     _batch.stack_tables(
                         ctx, [rec.tables[s] for rec in group], bucket

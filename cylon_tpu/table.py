@@ -52,6 +52,7 @@ from .ops import sort as _sort_mod
 from .ops import stats as _st
 from .parallel import shuffle as _sh
 from .parallel import spill as _spill
+from .obs import resource as _obsres
 from .obs import store as _obsstore
 from .obs import trace as _obstrace
 from .plan import feedback as _feedback
@@ -221,6 +222,11 @@ class Table:
         # pandas-style index: None == RangeIndex; else the named column is
         # the index (reference Set_Index/ResetIndex, table.hpp + indexing/)
         self.index_name = index_name if index_name in (columns.keys() | {None}) else None
+        # resource ledger: register this table's device buffers (weakref
+        # finalizer observes the free). One enabled() check when no ops
+        # surface is on; never a sync — nbytes is a shape property
+        # (graft-lint pins obs.resource.note_table at 0 sync sites)
+        _obsres.note_table(self)
 
     # ------------------------------------------------------------------
     # basic properties
@@ -304,6 +310,10 @@ class Table:
                 self._columns = compacted._columns
                 self._shard_cap = compacted._shard_cap
                 self._counts_dev = None
+                # the in-place buffer swap must re-register with the
+                # resource ledger: the old buffers are dead, and the
+                # wrapper's finalizer must not steal the live ones
+                _obsres.note_rebuffer(self)
             # publish LAST: the lock-free fast paths (_row_counts /
             # _materialize / _rows_hint) key on _counts_host, so it must
             # never be visible while the in-place compaction is still

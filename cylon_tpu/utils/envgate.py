@@ -351,6 +351,29 @@ TRACE_EXPORT = EnvKnob(
     note="when set, the flight ring is written to this path as Chrome "
     "trace-event JSON (Perfetto-loadable) at interpreter exit",
 )
+METRICS_PORT = EnvKnob(
+    "CYLON_TPU_METRICS_PORT", "", kind="observability",
+    note="when set, context init starts the in-process ops endpoint "
+    "(obs/export.OpsServer): /metrics (Prometheus text exposition), "
+    "/healthz (SLO state), /queries (flight ring as JSON). Also "
+    "enables the resource ledger. '9100' binds loopback (the endpoint "
+    "is unauthenticated); 'host:9100' (e.g. 0.0.0.0:9100) opts into a "
+    "wider bind for off-host scrapes; 0 picks an ephemeral port "
+    "(tests)",
+)
+SLO_WINDOW_S = EnvKnob(
+    "CYLON_TPU_SLO_WINDOW_S", "60", kind="observability",
+    note="rolling evaluation window (seconds) of the SLO monitor "
+    "(obs/slo.py): p99 burn-rate, shed-rate and headroom rules judge "
+    "only the samples inside it, so /healthz recovers once a breach "
+    "ages out of the window",
+)
+LEAK_GRACE_S = EnvKnob(
+    "CYLON_TPU_LEAK_GRACE_S", "30", kind="observability",
+    note="resource-ledger leak grace (seconds): a device-resident table "
+    "still live this long after its owning query trace finished is "
+    "flagged (with its creation site) by ResourceLedger.leaks()",
+)
 NO_EFFECT_LINT = EnvKnob(
     "CYLON_TPU_NO_EFFECT_LINT", "0", kind="observability",
     keyed_via="never reaches a compiled program: read only by "

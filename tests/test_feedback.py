@@ -138,20 +138,21 @@ def test_compaction_bounds_journal(tmp_path):
 def test_compaction_crash_window_never_double_absorbs(tmp_path):
     """A crash between compact()'s snapshot rename and its journal
     truncate leaves the folded records in BOTH files; the snapshot's
-    jseq high-water mark must dedup them on load."""
+    per-writer jseq high-water mark must dedup them on load."""
     d = str(tmp_path / "s")
     s = obs_store.ObsStore(d, compact_every=10 ** 9)
+    journal_path = s.journal_path  # this writer's own journal
     recs = []
     for i in range(6):
         r = {"k": "exec", "fp": "cc", "world": 4, "row_bytes": 8,
              "hot": 10}
         s.record(r)  # record() stamps the journal id onto the dict
         recs.append(r)
-    s.compact()  # journal truncated, snapshot carries jseq=6
+    s.compact()  # own journal truncated, snapshot carries jseqs[pid]=6
     s.close()
     # simulate the crash window: the folded records are still in the
-    # journal when the process dies
-    with open(os.path.join(d, "journal.jsonl"), "a") as f:
+    # writer's journal when the process dies
+    with open(journal_path, "a") as f:
         for r in recs:
             f.write(json.dumps(r) + "\n")
     s2 = obs_store.ObsStore(d)

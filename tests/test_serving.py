@@ -258,7 +258,10 @@ def test_hammer_backpressure_zero_lost(sctx4, rng, monkeypatch):
 def test_shed_error_contract(sctx4, rng, monkeypatch):
     ta, tb = _mk_binding(sctx4, rng, 100)
     lf = _q3(ta, tb)
-    shed_before = tracing.get_count("serve.shed")
+    # sheds count by REASON (serve.shed.*), so the SLO rules and an
+    # autoscaler can tell offered load from a consumer leak
+    budget_before = tracing.get_count("serve.shed.admission_budget")
+    queue_before = tracing.get_count("serve.shed.queue_depth")
 
     # (a) a query whose estimate alone exceeds the hard cap sheds at
     # submit, blocking or not
@@ -266,7 +269,9 @@ def test_shed_error_contract(sctx4, rng, monkeypatch):
     s = ServeScheduler(sctx4, auto_start=False)
     with pytest.raises(ServeOverloadError):
         s.submit(lf)
-    assert tracing.get_count("serve.shed") == shed_before + 1
+    assert (
+        tracing.get_count("serve.shed.admission_budget") == budget_before + 1
+    )
     monkeypatch.delenv("CYLON_TPU_SERVE_INFLIGHT_BYTES")
 
     # (b) a full queue sheds nowait submitters and loses nothing admitted
@@ -275,7 +280,7 @@ def test_shed_error_contract(sctx4, rng, monkeypatch):
     f2 = s.submit(_q3(*_mk_binding(sctx4, rng, 90)))
     with pytest.raises(ServeOverloadError):
         s.submit(_q3(*_mk_binding(sctx4, rng, 80)), block=False)
-    assert tracing.get_count("serve.shed") == shed_before + 2
+    assert tracing.get_count("serve.shed.queue_depth") == queue_before + 1
     s.run_pending()
     assert f1.result(timeout=60).row_count == lf.collect().row_count
     assert f2.exception(timeout=60) is None

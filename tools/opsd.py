@@ -1,0 +1,233 @@
+"""opsd: drive a serving workload with the live ops endpoint up — the
+operator quick-start and the CI ``ops-smoke`` gate (ISSUE 12).
+
+The engine starts its own in-process endpoint whenever
+``CYLON_TPU_METRICS_PORT`` is set (``obs/export.ensure_ops_server``);
+this tool is the standalone driver around it::
+
+    python tools/opsd.py --port 9100            # demo serving load,
+        # endpoint stays up; scrape http://localhost:9100/metrics,
+        # check /healthz, dump /queries — ctrl-C to stop
+    python tools/opsd.py --smoke                # the CI gate (below)
+
+The ``--smoke`` run asserts, in one process, over HTTP (everything is
+validated through the real scrape path, never in-process peeking):
+
+1. EXPOSITION — a mid-run ``/metrics`` scrape parses under the strict
+   Prometheus line-format checker (``obs.export.validate_prometheus``)
+   and exposes per-fingerprint latency quantiles, the resource ledger's
+   device/host watermarks, and the SLO rule states.
+2. HEALTH    — ``/healthz`` is 200 under normal load, flips to 503
+   under an induced ``ServeOverloadError`` storm (the shed-rate SLO
+   rule), and RECOVERS to 200 after the queue drains and the breach
+   ages out of the rolling window.
+3. RING      — ``/queries`` returns the flight ring as JSON, including
+   the ``kind="slo"`` transition records of the storm.
+
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge  # noqa: E402
+
+
+def _fail(msg: str) -> None:
+    print(f"OPS SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _get(port: int, path: str):
+    """(status, body) of one endpoint GET; 503 is a valid answer."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _mk_tables(ct, ctx, rng, n):
+    import numpy as np
+
+    ta = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 40, n).astype(np.int32),
+         "v": rng.integers(-50, 50, n).astype(np.float32)},
+    )
+    tb = ct.Table.from_pydict(
+        ctx,
+        {"rk": rng.integers(0, 40, n).astype(np.int32),
+         "w": rng.integers(-50, 50, n).astype(np.float32)},
+    )
+    return ta, tb
+
+
+def _q3(ct, ta, tb):
+    from cylon_tpu import col
+
+    return (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(col("w") > 0.0)
+        .groupby("k", {"v": "sum"})
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral; printed at startup)")
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--queries", type=int, default=64)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the CI assertion scenario and exit")
+    args = ap.parse_args()
+
+    # the endpoint + ledger ride the knob; the SLO window is kept short
+    # in smoke mode so the induced breach can age out inside the gate
+    os.environ["CYLON_TPU_METRICS_PORT"] = str(args.port)
+    os.environ.setdefault("CYLON_TPU_TRACE", "tree")
+    if args.smoke:
+        os.environ["CYLON_TPU_SLO_WINDOW_S"] = "1.5"
+        os.environ.setdefault("CYLON_TPU_SERVE_P99_TARGET_MS", "2000")
+
+    devices = ge._force_cpu_mesh(args.world)
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.obs import export as obs_export
+    from cylon_tpu.serve import ServeOverloadError
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[: args.world])
+    )
+    srv = obs_export.ops_server()
+    if srv is None:
+        _fail("CYLON_TPU_METRICS_PORT was set but no ops server started")
+    port = srv.port
+    print(f"# opsd: endpoint up at http://127.0.0.1:{port} "
+          f"(/metrics /healthz /queries)")
+
+    rng = np.random.default_rng(0)
+    sched = ct.serve.scheduler(ctx)
+
+    def run_load(nq: int) -> int:
+        futs = [
+            _q3(ct, *_mk_tables(ct, ctx, rng, args.rows)).collect_async()
+            for _ in range(nq)
+        ]
+        total = 0
+        for f in futs:
+            total += f.result(timeout=120).row_count
+        return total
+
+    if not args.smoke:
+        print(f"# opsd: serving {args.queries}-query batches forever; "
+              "ctrl-C to stop")
+        try:
+            while True:
+                rows = run_load(args.queries)
+                st, body = _get(port, "/healthz")
+                print(f"# opsd: {args.queries} queries ok ({rows} rows), "
+                      f"healthz={st} {body.strip()}")
+        except KeyboardInterrupt:
+            return
+        return
+
+    # ---- 1. mid-run exposition ---------------------------------------
+    run_load(max(args.queries // 2, 8))  # warm + populate histograms
+    st, text = _get(port, "/metrics")
+    if st != 200:
+        _fail(f"/metrics returned {st}")
+    problems = obs_export.validate_prometheus(text)
+    if problems:
+        _fail("exposition format: " + "; ".join(problems[:5]))
+    for needle in (
+        'cylon_tpu_query_latency_seconds{fingerprint=',
+        'quantile="0.99"',
+        "cylon_tpu_ledger_device_bytes",
+        "cylon_tpu_ledger_host_bytes",
+        "cylon_tpu_slo_state",
+        "cylon_tpu_serve_submitted_total",
+    ):
+        if needle not in text:
+            _fail(f"/metrics is missing {needle!r}")
+    print(f"# exposition ok: {len(text.splitlines())} lines, "
+          "strict line-format clean, quantiles + ledger + SLO present")
+
+    st, body = _get(port, "/healthz")
+    if st != 200:
+        _fail(f"/healthz {st} before the storm: {body}")
+
+    # ---- 2. induced overload storm -> 503 -> drain -> 200 ------------
+    ta, tb = _mk_tables(ct, ctx, np.random.default_rng(7), args.rows)
+    lf = _q3(ct, ta, tb)
+    old_budget = os.environ.get("CYLON_TPU_SERVE_INFLIGHT_BYTES")
+    os.environ["CYLON_TPU_SERVE_INFLIGHT_BYTES"] = "1"
+    sheds = 0
+    for _ in range(8):
+        try:
+            sched.submit(lf, block=False)
+        except ServeOverloadError:
+            sheds += 1
+    if old_budget is None:
+        os.environ.pop("CYLON_TPU_SERVE_INFLIGHT_BYTES", None)
+    else:
+        os.environ["CYLON_TPU_SERVE_INFLIGHT_BYTES"] = old_budget
+    if sheds == 0:
+        _fail("the 1-byte budget shed nothing")
+    st, body = _get(port, "/healthz")
+    if st != 503:
+        _fail(f"/healthz {st} during the shed storm (want 503): {body}")
+    reasons = json.loads(body).get("reasons", [])
+    if not any("shed" in r for r in reasons):
+        _fail(f"healthz breach reasons missing the shed rule: {reasons}")
+    print(f"# health ok: {sheds} induced sheds flipped /healthz to 503 "
+          f"({', '.join(reasons)})")
+
+    # drain + let the breach age out of the rolling window
+    if not sched.drain(timeout=60):
+        _fail("scheduler did not drain after the storm")
+    deadline = time.monotonic() + 15
+    while True:
+        st, body = _get(port, "/healthz")
+        if st == 200:
+            break
+        if time.monotonic() > deadline:
+            _fail(f"/healthz did not recover after drain: {st} {body}")
+        time.sleep(0.25)
+    print("# recovery ok: /healthz back to 200 after drain")
+
+    # ---- 3. the ring over HTTP ---------------------------------------
+    st, body = _get(port, "/queries")
+    if st != 200:
+        _fail(f"/queries returned {st}")
+    ring = json.loads(body)
+    if not isinstance(ring, list) or not ring:
+        _fail("/queries returned no traces")
+    kinds = {q.get("kind") for q in ring}
+    if "slo" not in kinds:
+        _fail(f"/queries holds no SLO transition records (kinds: {kinds})")
+    if "serve" not in kinds and "plan" not in kinds:
+        _fail(f"/queries holds no query traces (kinds: {kinds})")
+    print(f"# ring ok: {len(ring)} traces over HTTP (kinds: "
+          f"{', '.join(sorted(k for k in kinds if k))})")
+    print("# ops smoke ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,16 @@ Observation-store modes (``CYLON_TPU_OBS_DIR`` or ``--obs-dir``)::
         # exit 1 when any fingerprint regressed
     python -m tools.traceview --diff --save-baseline  # bless current
 
+Live mode (``--live``) polls a running process's ops endpoint
+(``CYLON_TPU_METRICS_PORT`` / ``tools/opsd.py``) instead of a file::
+
+    python -m tools.traceview --live http://host:9100          # one shot
+    python -m tools.traceview --live http://host:9100 --watch 5
+        # re-render every 5 s: health + SLO states, the serve.* load
+        # gauges, ledger watermarks, per-fingerprint p50/p99, and the
+        # newest flight-ring entries — the terminal twin of a Grafana
+        # panel over the same /metrics scrape
+
 Produce a file with ``CYLON_TPU_TRACE_EXPORT=trace.json`` (written at
 interpreter exit) or programmatically via
 ``cylon_tpu.obs.write_chrome("trace.json")``.
@@ -227,6 +237,91 @@ def _print_diff(obs_dir, baseline, save, lat_tol, coll_tol) -> int:
     return 0
 
 
+def _live_fetch(base: str, path: str):
+    """(status, body) from the ops endpoint; 503 is a healthz answer,
+    not an error. (None, reason) when the endpoint is unreachable — a
+    --watch loop must survive the monitored process restarting."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (urllib.error.URLError, OSError) as e:
+        return None, str(e)
+
+
+def _print_live(base: str, top: int) -> int:
+    """One render of a live ops endpoint: health, SLO states, serving
+    load, ledger watermarks, per-fingerprint quantiles, newest traces."""
+    import json as _json
+
+    base = base.rstrip("/")
+    st, body = _live_fetch(base, "/healthz")
+    if st is None:
+        print(f"endpoint unreachable: {base} ({body})", file=sys.stderr)
+        return 1
+    try:
+        health = _json.loads(body)
+    except ValueError:
+        # not the ops server (a proxy's HTML error page, a wrong port):
+        # report and let a --watch loop keep retrying
+        print(f"endpoint answered {st} with non-JSON: {body[:200]!r}",
+              file=sys.stderr)
+        return 1
+    print(f"healthz: {st} "
+          + ("OK" if health.get("ok") else
+             "BREACH [" + ", ".join(health.get("reasons", [])) + "]"))
+    st, text = _live_fetch(base, "/metrics")
+    if st != 200:
+        print(f"/metrics returned {st}", file=sys.stderr)
+        return 1
+    gauges, quants = {}, {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name, _, val = line.rpartition(" ")
+        if name.startswith("cylon_tpu_query_latency_seconds{"):
+            labels = name[name.index("{") + 1:name.rindex("}")]
+            parts = dict(
+                kv.split("=", 1) for kv in labels.split(",") if "=" in kv
+            )
+            fp = parts.get("fingerprint", "?").strip('"')
+            q = parts.get("quantile", "").strip('"')
+            if q:
+                quants.setdefault(fp, {})[q] = float(val)
+        elif name.startswith(("cylon_tpu_serve_", "cylon_tpu_ledger_",
+                              "cylon_tpu_slo_state")):
+            gauges[name] = val
+    for prefix, title in (("cylon_tpu_slo_state", "SLO"),
+                          ("cylon_tpu_serve_", "serving"),
+                          ("cylon_tpu_ledger_", "ledger")):
+        rows = {k: v for k, v in sorted(gauges.items())
+                if k.startswith(prefix)}
+        if rows:
+            print(f"\n{title}:")
+            for k, v in rows.items():
+                print(f"  {k}: {v}")
+    if quants:
+        print("\nper-fingerprint latency:")
+        for fp, q in sorted(quants.items()):
+            print(f"  {fp}: p50 {q.get('0.5', 0) * 1e3:.2f} ms  "
+                  f"p99 {q.get('0.99', 0) * 1e3:.2f} ms")
+    st, body = _live_fetch(base, "/queries")
+    if st == 200:
+        ring = _json.loads(body)
+        if ring:
+            print(f"\nflight ring ({len(ring)} traces, newest last):")
+            for q in ring[-top:]:
+                extra = (f"  fingerprint {q['fingerprint']}"
+                         if q.get("fingerprint") else "")
+                print(f"  [{q['qid']}] {q['kind']}:{q['name']} "
+                      f"{q['wall_ms']:.2f} ms{extra}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("trace", nargs="?",
@@ -261,7 +356,26 @@ def main(argv=None) -> int:
     ap.add_argument("--coll-tol", type=float, default=0.10,
                     help="--diff coll-MB regression tolerance "
                     "(default 0.10)")
+    ap.add_argument("--live", default=None, metavar="URL",
+                    help="poll a running ops endpoint (http://host:port "
+                    "serving /metrics /healthz /queries) instead of "
+                    "reading a file")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="with --live: re-render every N seconds "
+                    "(default: one shot)")
     args = ap.parse_args(argv)
+
+    if args.live:
+        import time as _time
+
+        while True:
+            rc = _print_live(args.live, args.top)
+            if not args.watch:
+                return rc
+            # --watch keeps polling across blips (server restarting);
+            # one-shot mode reports the failure through the exit code
+            _time.sleep(args.watch)
+            print("\n" + "=" * 60)
 
     if args.profiles:
         return _print_profiles(args.obs_dir)
