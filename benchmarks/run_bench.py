@@ -265,6 +265,27 @@ def run_suite(n_rows: int, reps: int, mesh_devices, scaling: bool):
     _roofline_recorded(dj_extra, hbm, s, dist_join)
     record("dist_inner_join", s, c, 2 * n_rows, world, dj_extra, samples=laps)
 
+    # config 1a: the same join under the quantized float wire tier
+    # (ops/quant.py, CYLON_TPU_QUANT_TOL=1e-2): the f32 payload lanes —
+    # the reason this shape DECLINES bit-lossless wire narrowing — ride
+    # block-scaled int8 fields, so the coll MB cell is the win
+    # (tools/quant_smoke.py holds the CI gate and the error-bound pin)
+    prev_qt = os.environ.get("CYLON_TPU_QUANT_TOL")
+    os.environ["CYLON_TPU_QUANT_TOL"] = "1e-2"
+    try:
+        s, c, laps = _bench(dist_join, reps)
+        djq_extra = {"vs_baseline": _vs_baseline(2 * n_rows, s, world)}
+        _roofline_recorded(djq_extra, hbm, s, dist_join)
+        record(
+            "dist_inner_join_quant", s, c, 2 * n_rows, world, djq_extra,
+            samples=laps,
+        )
+    finally:
+        if prev_qt is None:
+            os.environ.pop("CYLON_TPU_QUANT_TOL", None)
+        else:
+            os.environ["CYLON_TPU_QUANT_TOL"] = prev_qt
+
     # config 1b: the same join at ~10% selectivity with the semi-join
     # sketch filter (ops/sketch.py): both sides prune provably partnerless
     # rows against the other side's broadcast key sketch before the

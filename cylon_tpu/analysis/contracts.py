@@ -527,6 +527,19 @@ CONTRACTS: Dict[str, CollectiveContract] = {
         host_syncs=SHUFFLE_HOST_SYNCS_PER_TABLE,
         sync_sites=SHUFFLE_SYNC_SITES,
     ),
+    "shuffle_quant": CollectiveContract(
+        name="shuffle_quant",
+        description=(
+            "quantized-wire shuffle (ISSUE 13): the lossy q8 tier "
+            "changes lane layout and widens the header rows (block "
+            "scales ride the count collective), never the collective "
+            "count or the sync discipline"
+        ),
+        collectives=shuffle_collectives,
+        all_to_all=shuffle_collectives,
+        host_syncs=SHUFFLE_HOST_SYNCS_PER_TABLE,
+        sync_sites=SHUFFLE_SYNC_SITES,
+    ),
     "dist_join": CollectiveContract(
         name="dist_join",
         description=(

@@ -11,6 +11,8 @@ Paths covered (the ISSUE-6 registry):
 
 - ``shuffle_single``   — one-table hash shuffle at K = 1 and K > 1;
 - ``shuffle_wire_packed`` — narrow-int table whose wire plan engages;
+- ``shuffle_quant``    — f32-payload shuffle under the lossy wire tier
+  (ISSUE 13): same collective/sync contract, quant gate engaged;
 - ``dist_join``        — eager distributed inner join, semi filter off;
 - ``dist_join_semi``   — selective pair, sketch all_gather engaged;
 - ``fused_join_step``  — the fully fused join program (jaxpr census);
@@ -144,6 +146,51 @@ def run_shuffle_wire_packed(ctx, rng) -> List[PlanResult]:
             "shuffle_wire_packed: the wire-narrowing gate never engaged — "
             "the plan is not exercising the packed-wire path"
         )
+    return [res]
+
+
+def run_shuffle_quant(ctx, rng) -> List[PlanResult]:
+    """The quantized wire tier (ISSUE 13): an f32-payload shuffle under
+    CYLON_TPU_QUANT_TOL=1e-2 keeps the K-collective / 2-sync contract —
+    the lossy codec changes lane widths and header rows, nothing else —
+    and the gate must actually engage."""
+    import os
+
+    from ..utils.tracing import get_count, report, reset_trace
+
+    import cylon_tpu as ct
+
+    n = 4096
+    t = ct.Table.from_pydict(
+        ctx,
+        {
+            "k": rng.integers(0, 1 << 10, n).astype(np.int32),
+            "v": rng.normal(size=n).astype(np.float32),
+            "u": rng.normal(size=n).astype(np.float32),
+        },
+    )
+    contract = CONTRACTS["shuffle_quant"]
+
+    def op():
+        return t.shuffle(["k"])
+
+    prev = os.environ.get("CYLON_TPU_QUANT_TOL")
+    os.environ["CYLON_TPU_QUANT_TOL"] = "1e-2"
+    try:
+        reset_trace()
+        op()
+        k = int(report("shuffle.")["shuffle.rounds"]["rows"])
+        res = _measure(op, contract, k)
+        if not get_count("shuffle.quant.applied"):
+            res.violations.append(
+                "shuffle_quant: the lossy wire tier never engaged — the "
+                "plan is not exercising the quantized path"
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_QUANT_TOL", None)
+        else:
+            os.environ["CYLON_TPU_QUANT_TOL"] = prev
     return [res]
 
 
@@ -354,6 +401,7 @@ def run_q3_dispatch(ctx, rng) -> List[PlanResult]:
 PLAN_RUNNERS = [
     run_shuffle_single,
     run_shuffle_wire_packed,
+    run_shuffle_quant,
     run_dist_join,
     run_dist_join_semi,
     run_fused_join_step,

@@ -176,6 +176,16 @@ class CylonContext:
 
         return sketch_bits(self._config.get("sketch_bits"))
 
+    @property
+    def quant_tol(self) -> float:
+        """Effective lossy-wire tolerance for this context (config KV
+        ``quant_tol`` > CYLON_TPU_QUANT_TOL env > 0.0 = exact wire; the
+        CYLON_TPU_NO_QUANT kill switch forces 0.0). See ops/quant.py for
+        the codec tiers the tolerance engages."""
+        from .ops.quant import tolerance
+
+        return tolerance(self._config.get("quant_tol"))
+
     # -- sequencing (reference GetNextSequence, cylon_context.cpp:106) ------
     def get_next_sequence(self) -> int:
         return next(self._sequence)

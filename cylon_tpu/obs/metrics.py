@@ -319,6 +319,11 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "shuffle.semi_filter.": (
         "mixed", "semi-join gate: selectivity gauge, applied/gate_skipped/"
         "pruned_rows counters, sketch span"),
+    "shuffle.quant.": (
+        "mixed", "lossy wire tier (ops/quant.py): applied/gate_skipped/"
+        "cols/bytes_saved counters + row_bytes_ratio gauge on the "
+        "shuffle wire; spill_bytes_saved/spill_reencoded/"
+        "relay_bytes_saved counters on the host crossings"),
     "semi_filter.sketch_bytes": ("counter", "sketch collective wire bytes"),
     "lane_pack.": (
         "mixed", "bit-width packing: stats_kernel/sort_fused/join_fused/"

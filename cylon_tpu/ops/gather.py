@@ -155,9 +155,14 @@ class WireField(NamedTuple):
     """One bit-field of the wire layout, in column-major field order.
 
     ``kind``: 'enc' (stats-rebased orderable encoding), 'lane' (one plain
-    32-bit lane of an un-narrowed column), 'valid' (1-bit validity).
+    32-bit lane of an un-narrowed column), 'valid' (1-bit validity),
+    'h16' (lossless native 16-bit float bits — f16/bf16 ship at their
+    real width instead of the widened f32 lane), 'q' (a LOSSY
+    quantized-tier field, ops/quant.py — opt-in via the tolerance knob).
     ``off``: for 'lane', the lane index within the column's plain codec
-    lanes. ``cls``: the encoding class of an 'enc' field."""
+    lanes. ``cls``: the encoding class of an 'enc' field; for 'h16' the
+    source float dtype; for 'q' the ``"<codec>:<dtype>"`` pair (codec
+    q8/qb16/qf32 + the column's physical dtype the decode restores)."""
 
     col: int
     kind: str
@@ -177,31 +182,57 @@ class WirePlan(NamedTuple):
     n_plain: int
 
 
-def wire_plan(cols_plan, stats_list) -> Optional[WirePlan]:
+def wire_plan(cols_plan, stats_list, quant=None) -> Optional[WirePlan]:
     """Build the wire layout for a column set.
 
     ``stats_list``: per column ``(enc_class, field_bits)`` from measured
     global range stats, or None (unknown). Columns with lossless narrow
     encodings use 'enc' fields (bool needs no stats — it is statically 1
-    bit with base 0); everything else keeps its plain 32-bit lanes as
-    'lane' fields; f64 stays passthrough; every validity mask narrows to
-    a 1-bit field unconditionally. Returns None when there is nothing to
-    pack or packing does not strictly reduce the word count."""
+    bit with base 0); f16/bf16 ship their native 16 bits as lossless
+    'h16' fields (no stats needed — the widened f32 lane doubled their
+    wire bytes for nothing); everything else keeps its plain 32-bit
+    lanes as 'lane' fields; f64 stays passthrough; every validity mask
+    narrows to a 1-bit field unconditionally.
+
+    ``quant``: optional per-column lossy codec tags from
+    :func:`cylon_tpu.ops.quant.quant_spec` (None entries = exact). A
+    quantized column — including f64, which thereby LEAVES the
+    per-column passthrough collective — ships a 'q' field at the codec
+    width instead of its plain lanes. A quantized f64 column counts as
+    two virtual plain lanes in the engagement compare (it would have
+    shipped 8 passthrough bytes).
+
+    Returns None when there is nothing to pack or packing does not
+    strictly reduce the word count."""
+    from .quant import CODEC_BITS
     from .stats import wire_narrowable
 
     fields: List[WireField] = []
     n_plain = 0
     for ci, (tag, nl, has_valid) in enumerate(cols_plan):
+        qc = quant[ci] if quant is not None else None
         if tag is not None:
             n_plain += nl
             st = stats_list[ci]
-            if tag == "bool":
+            if qc is not None:
+                fields.append(
+                    WireField(ci, "q", 0, CODEC_BITS[qc], f"{qc}:{tag}")
+                )
+            elif tag == "bool":
                 fields.append(WireField(ci, "enc", 0, 1, "bool"))
+            elif tag in ("float16", "bfloat16"):
+                fields.append(WireField(ci, "h16", 0, 16, tag))
             elif st is not None and wire_narrowable(st[0]):
                 fields.append(WireField(ci, "enc", 0, int(st[1]), st[0]))
             else:
                 for j in range(nl):
                     fields.append(WireField(ci, "lane", j, 32, ""))
+        elif qc is not None:
+            # quantized f64: rides the packed words, not the passthrough
+            n_plain += 2
+            fields.append(
+                WireField(ci, "q", 0, CODEC_BITS[qc], f"{qc}:float64")
+            )
         if has_valid:
             n_plain += 1
             fields.append(WireField(ci, "valid", 0, 1, ""))
@@ -214,25 +245,59 @@ def wire_plan(cols_plan, stats_list) -> Optional[WirePlan]:
     return WirePlan(tuple(cols_plan), tuple(fields), n_words, n_plain)
 
 
-def static_wire_plan(cols: Sequence[KeyCol]) -> Optional[WirePlan]:
-    """Stats-free wire plan: only the STATIC narrowings (bool data and
-    validity masks to 1 bit/row) — no bases needed, safe inside a single
-    compiled program with no host stats step (the fused pipeline)."""
+def static_wire_plan(
+    cols: Sequence[KeyCol], quant=None
+) -> Optional[WirePlan]:
+    """Stats-free wire plan: only the STATIC narrowings (bool data,
+    validity masks to 1 bit/row, native-width f16/bf16, and — when the
+    caller passes a ``quant`` spec — the lossy quantized fields, whose
+    block scales ride the exchange headers and need no host stats step
+    either). Safe inside a single compiled program (the fused pipeline);
+    the eager chunked engine does the stats-driven narrowing too."""
     from .stats import enabled
 
     if not enabled():
         return None
     plan = lane_plan(cols)
-    return wire_plan(plan, [None] * len(plan))
+    return wire_plan(plan, [None] * len(plan), quant=quant)
 
 
 def wire_row_bytes(wplan: WirePlan) -> int:
     """Bytes one row occupies in a wire-narrowed exchange buffer: 4 per
     packed word + 8 per f64 passthrough column (the narrowed counterpart
-    of :func:`cylon_tpu.parallel.shuffle.exchange_row_bytes`)."""
+    of :func:`cylon_tpu.parallel.shuffle.exchange_row_bytes`). Quantized
+    f64 columns ride the packed words, not the passthrough."""
+    qcols = {f.col for f in wplan.fields if f.kind == "q"}
     total = 4 * wplan.n_words
-    total += sum(8 for tag, _nl, _hv in wplan.plan if tag is None)
+    total += sum(
+        8
+        for ci, (tag, _nl, _hv) in enumerate(wplan.plan)
+        if tag is None and ci not in qcols
+    )
     return max(total, 1)
+
+
+def wire_q8_cols(wplan: WirePlan) -> Tuple[Tuple[int, str], ...]:
+    """(col, dtype) of every block-scaled 'q8' field in field order —
+    the fields whose per-block scales ride the exchange header rows."""
+    out = []
+    for f in wplan.fields:
+        if f.kind == "q" and f.cls.startswith("q8:"):
+            out.append((f.col, f.cls.split(":", 1)[1]))
+    return tuple(out)
+
+
+def wire_has_quant(wplan: Optional[WirePlan]) -> bool:
+    return wplan is not None and any(
+        f.kind == "q" for f in wplan.fields
+    )
+
+
+def wire_pt_order(wplan: WirePlan, pt_order) -> tuple:
+    """The EFFECTIVE passthrough order under a wire plan: f64 columns
+    captured by a 'q' field no longer ship a passthrough collective."""
+    qcols = {f.col for f in wplan.fields if f.kind == "q"}
+    return tuple(ci for ci in pt_order if ci not in qcols)
 
 
 def wire_bases(wplan: WirePlan, stats_by_col: dict) -> np.ndarray:
@@ -266,7 +331,10 @@ def _enc_base(bases: Optional[jax.Array], ei: int, wide: bool):
 
 
 def wire_pack_cols(
-    cols: Sequence[KeyCol], wplan: WirePlan, bases: Optional[jax.Array]
+    cols: Sequence[KeyCol],
+    wplan: WirePlan,
+    bases: Optional[jax.Array],
+    qscales: Optional[jax.Array] = None,
 ):
     """Encode every column into the plan's bit-packed word lanes.
 
@@ -274,13 +342,21 @@ def wire_pack_cols(
     'enc' fields clamp to their width: live values always fit when the
     stats were sound bounds (masked values were measured too — they ride
     the wire like any payload), and unwritten buffer slots never ship
-    live rows, so the clamp is a corruption firewall, not a data path."""
+    live rows, so the clamp is a corruption firewall, not a data path.
+
+    ``qscales``: [cap, n_q8] per-row f32 block scales for the plan's
+    'q8' fields in field order (the caller broadcasts each row's
+    destination-chunk scale; scales themselves ride the exchange header
+    rows — shuffle.quant_chunk_scales)."""
+    from . import quant as _q
     from .stats import assemble_words, encode_enc, layout_words
 
+    qcols = {f.col for f in wplan.fields if f.kind == "q"}
     field_vals: List[jax.Array] = []
     bits_list: List[int] = []
     passthrough: Dict[int, jax.Array] = {}
     ei = 0
+    qi = 0
     for f in wplan.fields:
         data, valid = cols[f.col]
         if f.kind == "enc":
@@ -295,6 +371,17 @@ def wire_pack_cols(
 
                 maxf = mask_of(min(f.bits, 64 if wide else 32), enc.dtype)
                 v = jnp.minimum(enc - base, maxf)
+        elif f.kind == "h16":
+            v = jax.lax.bitcast_convert_type(data, jnp.uint16).astype(
+                jnp.uint32
+            )
+        elif f.kind == "q":
+            codec = f.cls.split(":", 1)[0]
+            scale = None
+            if codec == "q8":
+                scale = qscales[:, qi]
+                qi += 1
+            v = _q.encode_field(codec, data, scale)
         elif f.kind == "lane":
             lane = _to_lanes(data)[0][f.off]
             v = jax.lax.bitcast_convert_type(lane, jnp.uint32)
@@ -303,7 +390,7 @@ def wire_pack_cols(
         field_vals.append(v)
         bits_list.append(f.bits)
     for ci, (tag, _nl, _hv) in enumerate(wplan.plan):
-        if tag is None:
+        if tag is None and ci not in qcols:
             passthrough[ci] = cols[ci][0]
     words = assemble_words(field_vals, layout_words(bits_list, False))
     return [
@@ -317,9 +404,14 @@ def wire_unpack_cols(
     bases: Optional[jax.Array],
     handle_passthrough,
     make_valid,
+    qscales: Optional[jax.Array] = None,
 ):
     """Decode :func:`wire_pack_cols` word lanes back into columns —
-    the wire counterpart of :func:`unpack_cols` (same callback contract)."""
+    the wire counterpart of :func:`unpack_cols` (same callback contract).
+    ``qscales``: [rows, n_q8] per-row f32 block scales for the 'q8'
+    fields, in field order (the receive side broadcasts each row's
+    source-chunk scale from the exchange headers)."""
+    from . import quant as _q
     from .stats import decode_enc, extract_fields, layout_words
 
     bits_list = [f.bits for f in wplan.fields]
@@ -328,14 +420,18 @@ def wire_unpack_cols(
     ]
     vals = extract_fields(words, layout_words(bits_list, False), bits_list)
     # regroup fields by column (fields are column-major by construction),
-    # carrying each enc field's POSITIONAL base-slot index
+    # carrying each enc/q8 field's POSITIONAL scale-slot index
     per_col: Dict[int, list] = {}
     ei = 0
+    qi = 0
     for f, v in zip(wplan.fields, vals):
         slot = -1
         if f.kind == "enc":
             slot = ei
             ei += 1
+        elif f.kind == "q" and f.cls.startswith("q8:"):
+            slot = qi
+            qi += 1
         per_col.setdefault(f.col, []).append((f, v, slot))
     out: List[KeyCol] = []
     for ci, (tag, nl, has_valid) in enumerate(wplan.plan):
@@ -355,6 +451,14 @@ def wire_unpack_cols(
                 if wide:
                     v = v.astype(jnp.uint64)
                 data = decode_enc(v + base, f.cls, np.dtype(tag))
+            elif f.kind == "h16":
+                data = jax.lax.bitcast_convert_type(
+                    v.astype(jnp.uint16), jnp.dtype(f.cls)
+                )
+            elif f.kind == "q":
+                codec, out_dt = f.cls.split(":", 1)
+                scale = qscales[:, slot] if codec == "q8" else None
+                data = _q.decode_field(codec, v, scale, out_dt)
             elif f.kind == "lane":
                 lane_frags.append(
                     jax.lax.bitcast_convert_type(
@@ -363,7 +467,7 @@ def wire_unpack_cols(
                 )
             else:
                 vlane = v.astype(jnp.int32)
-        if tag is None:
+        if data is None and tag is None:
             data = handle_passthrough(ci)
         elif data is None:
             data = _from_lanes(lane_frags, tag)
@@ -401,6 +505,92 @@ def np_from_lanes(lanes: List[np.ndarray], tag: str) -> np.ndarray:
     lo = lanes[1].view(np.uint32).astype(np.uint64)
     u = (hi << np.uint64(32)) | lo
     return u.view(dt) if dt.kind in ("i", "u") else u.astype(dt)
+
+
+def quant_lane_parts(plan, qspec):
+    """The quantized host-crossing layout of a column set: plan entries
+    for quantized columns are rewritten to ``("q8:<dtype>", 0,
+    has_valid)`` — their DATA leaves the int32 lane matrix for a uint8
+    code matrix (1 byte/row over PCIe and in the spill arenas instead of
+    4-8) while their validity lane stays in the matrix. Only the 'q8'
+    codec stages through host crossings (bf16/qf32 are wire-only tiers).
+    Returns (qplan, q_cols) with q_cols = [(col, dtype_str)] in plan
+    order."""
+    qplan = []
+    q_cols = []
+    for ci, (tag, nl, has_valid) in enumerate(plan):
+        qc = qspec[ci] if qspec is not None else None
+        if qc == "q8":
+            dt = tag if tag is not None else "float64"
+            qplan.append((f"q8:{dt}", 0, has_valid))
+            q_cols.append((ci, dt))
+        else:
+            qplan.append((tag, nl, has_valid))
+    return tuple(qplan), tuple(q_cols)
+
+
+def pack_cols_quant(cols: Sequence[KeyCol], qplan, q_cols, live=None):
+    """Device twin of :func:`pack_cols` under a :func:`quant_lane_parts`
+    layout: quantized columns' data is diverted to uint8 q8 codes with
+    ONE block scale per column (finite max-abs over the live rows —
+    ``live`` is an optional [cap] bool mask keeping garbage rows past
+    the live count out of the scale). Returns (lanes, passthrough,
+    qcodes [cap, nq] uint8, qscales [1, nq] f32)."""
+    from . import quant as _q
+
+    qset = {ci for ci, _dt in q_cols}
+    lanes: List[jax.Array] = []
+    passthrough = {}
+    codes = []
+    scales = []
+    for ci, (data, valid) in enumerate(cols):
+        if ci in qset:
+            s = _q.safe_scale(_q.block_maxabs(data, live))
+            codes.append(
+                _q.encode_q8(data, s).astype(jnp.uint8)
+            )
+            scales.append(s)
+        elif qplan[ci][0] is None:
+            passthrough[ci] = data
+        else:
+            dl, _tag = _to_lanes(data)
+            lanes.extend(dl)
+        if valid is not None:
+            lanes.append(valid.astype(jnp.int32))
+    cap = cols[0][0].shape[0] if cols else 0
+    if codes:
+        qcodes = jnp.stack(codes, axis=1)
+        qscales = jnp.stack(scales).reshape(1, len(scales))
+    else:
+        qcodes = jnp.zeros((cap, 0), jnp.uint8)
+        qscales = jnp.zeros((1, 0), jnp.float32)
+    return lanes, passthrough, qcodes, qscales
+
+
+def host_unpack_cols_quant(
+    qplan, lane_cols, handle_passthrough, handle_quant
+):
+    """Host twin of :func:`host_unpack_cols` for a quantized layout:
+    ``handle_quant(ci, dtype_str)`` supplies a quantized column — either
+    still-encoded ``(codes_u8, scale)`` (the arena staging path keeps
+    bytes quantized) or already-decoded data. Validity lanes of
+    quantized columns still ride ``lane_cols``."""
+    out = []
+    pos = 0
+    for ci, (tag, nl, has_valid) in enumerate(qplan):
+        if tag is not None and tag.startswith("q8:"):
+            data = handle_quant(ci, tag.split(":", 1)[1])
+        elif tag is None:
+            data = handle_passthrough(ci)
+        else:
+            data = np_from_lanes(lane_cols[pos : pos + nl], tag)
+            pos += nl
+        valid = None
+        if has_valid:
+            valid = lane_cols[pos].astype(np.bool_)
+            pos += 1
+        out.append((data, valid))
+    return out
 
 
 def host_unpack_cols(plan, lane_cols, handle_passthrough):

@@ -76,6 +76,7 @@ def _shuffle_rounds(
     bucket_cap: int,
     axis_name: str,
     respill: int,
+    quant=None,
 ) -> Tuple[ShardTable, jax.Array]:
     """The shared respill-round loop: ``dest_fn(r) -> (dest, leftover)``
     supplies each round's send slots (plain hash shuffle or one hash
@@ -88,12 +89,16 @@ def _shuffle_rounds(
 
     Wire narrowing: a fully fused program has no host stats step, so only
     the STATIC narrowings engage here — validity masks and bool data pack
-    to 1 bit/row (gather.static_wire_plan); value lanes ride full width.
+    to 1 bit/row, f16/bf16 ship native 16 bits, and (under ``quant``, the
+    per-column lossy-codec spec from ops.quant.quant_spec) float payload
+    columns ride the quantized tier, whose block scales travel in the
+    exchange headers and need no host step either
+    (gather.static_wire_plan); remaining value lanes ride full width.
     The eager chunked engine (table._shuffle_many) does the stats-driven
     narrowing."""
     from ..ops.gather import static_wire_plan
 
-    wire = static_wire_plan(st.cols)
+    wire = static_wire_plan(st.cols, quant=quant)
     rounds = 1 + respill
     parts = [[] for _ in st.cols]  # per column: one [P*cap] block per round
     masks = []
@@ -127,6 +132,7 @@ def shuffle_shard(
     bucket_cap: int,
     axis_name: str,
     respill: int = 1,
+    quant=None,
 ) -> Tuple[ShardTable, jax.Array]:
     """Static-capacity hash shuffle of one table (per-shard code).
 
@@ -144,7 +150,7 @@ def shuffle_shard(
     return _shuffle_rounds(
         st, cnt,
         lambda r: _sh.build_send_slots_round(pid, cnt, world, bucket_cap, r),
-        world, bucket_cap, axis_name, respill,
+        world, bucket_cap, axis_name, respill, quant=quant,
     )
 
 
@@ -166,6 +172,7 @@ def sliced_shuffle_shard(
     bucket_cap: int,
     axis_name: str,
     respill: int = 1,
+    quant=None,
 ) -> Tuple[ShardTable, jax.Array]:
     """One hash-slice's shuffle, driven by the precomputed
     :class:`shuffle.SlicePlan` (one combined sort serves every slice —
@@ -175,7 +182,7 @@ def sliced_shuffle_shard(
     return _shuffle_rounds(
         st, cnt,
         lambda r: _sh.slice_round_dest(plan, slice_idx, bucket_cap, r),
-        world, bucket_cap, axis_name, respill,
+        world, bucket_cap, axis_name, respill, quant=quant,
     )
 
 
@@ -220,8 +227,17 @@ def make_distributed_join_step(
     join_cap: int,
     respill: int = 1,
     num_slices: int = 1,
+    quant_l=None,
+    quant_r=None,
 ):
     """Build the jittable distributed-join step over the mesh.
+
+    ``quant_l`` / ``quant_r``: optional per-column lossy-codec specs
+    (ops.quant.quant_spec over each side's dtypes with its key columns
+    excluded) — float payload lanes then ride the quantized wire tier
+    through each fused shuffle, block scales in the exchange headers.
+    Static build parameters: the caller's kernel cache key must include
+    them (table._fused_join appends the pair).
 
     Signature of the returned fn (global, row-sharded arrays):
       (l_cols, l_counts[P], r_cols, r_counts[P]) ->
@@ -265,10 +281,12 @@ def make_distributed_join_step(
             return list(jt.cols), jt.n.reshape(1), overflow
         if num_slices == 1:
             lt, ovl = shuffle_shard(
-                lt0, l_key_idx, world, bucket_cap, axis_name, respill
+                lt0, l_key_idx, world, bucket_cap, axis_name, respill,
+                quant=quant_l,
             )
             rt, ovr = shuffle_shard(
-                rt0, r_key_idx, world, bucket_cap, axis_name, respill
+                rt0, r_key_idx, world, bucket_cap, axis_name, respill,
+                quant=quant_r,
             )
             jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
             overflow = jnp.stack([ovl + ovr, ovj])
@@ -293,10 +311,12 @@ def make_distributed_join_step(
         def slice_body(carry, s):
             ov_sh, ov_j = carry
             lt, ovl = sliced_shuffle_shard(
-                lt0, plan_l, s, world, bucket_cap, axis_name, respill
+                lt0, plan_l, s, world, bucket_cap, axis_name, respill,
+                quant=quant_l,
             )
             rt, ovr = sliced_shuffle_shard(
-                rt0, plan_r, s, world, bucket_cap, axis_name, respill
+                rt0, plan_r, s, world, bucket_cap, axis_name, respill,
+                quant=quant_r,
             )
             jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
             # validity presence is a STATIC per-column property (identical
@@ -388,11 +408,23 @@ def make_join_groupby_step(
     join_cap: int,
     group_cap: int,
     respill: int = 1,
+    quant_l=None,
+    quant_r=None,
+    quant_tol: float = 0.0,
 ):
     """Distributed join followed by groupby-sum on the join key and a global
     psum'd total — the TPC-H Q3-ish fused step used by benchmarks and the
-    multi-chip dry run."""
+    multi-chip dry run.
+
+    ``quant_l`` / ``quant_r`` thread the lossy wire tier through the two
+    fused shuffles (see :func:`make_distributed_join_step`);
+    ``quant_tol`` additionally quantizes the grand-total psum — each
+    shard's partial of the fused join->groupby-SUM overflow reduction is
+    bf16-rounded before an exact reduction when the tolerance covers one
+    2^-9 crossing per partial (ops.quant.QB16_TOL). All three are static
+    build parameters the caller's cache key must include."""
     from ..ops import groupby as _g
+    from ..ops.quant import QB16_TOL
 
     world = mesh.shape[axis_name]
 
@@ -401,8 +433,14 @@ def make_join_groupby_step(
         lt = ShardTable(tuple(l_cols), l_counts[0])
         rt = ShardTable(tuple(r_cols), r_counts[0])
         if world > 1:
-            lt, _ = shuffle_shard(lt, l_key_idx, world, bucket_cap, axis_name, respill)
-            rt, _ = shuffle_shard(rt, r_key_idx, world, bucket_cap, axis_name, respill)
+            lt, _ = shuffle_shard(
+                lt, l_key_idx, world, bucket_cap, axis_name, respill,
+                quant=quant_l,
+            )
+            rt, _ = shuffle_shard(
+                rt, r_key_idx, world, bucket_cap, axis_name, respill,
+                quant=quant_r,
+            )
         # group key == join key and SUM over a floating LEFT column: the
         # whole join+groupby collapses into the probe sort (per key run,
         # sum = c_r * sum(v_l)) — ops/join.join_sum_by_key_pushdown. ~2
@@ -436,7 +474,19 @@ def make_join_groupby_step(
             n_join = jt.n
         total = s.sum()
         if world > 1:
-            total = jax.lax.psum(total, axis_name)
+            if quant_tol >= QB16_TOL and jnp.issubdtype(
+                total.dtype, jnp.floating
+            ):
+                # quantized psum: each shard's grand-total PARTIAL is
+                # bf16-quantized (one RNE crossing per partial, rel err
+                # <= 2^-9 of the partial magnitudes) and the reduction
+                # itself runs exactly in the original dtype — reducing
+                # IN bf16 would compound (world-1) rounding steps and
+                # break the single-crossing error budget
+                q = total.astype(jnp.bfloat16).astype(total.dtype)
+                total = jax.lax.psum(q, axis_name)
+            else:
+                total = jax.lax.psum(total, axis_name)
         return s, ng.reshape(1), n_join.reshape(1), total.reshape(1)
 
     return jax.jit(

@@ -35,6 +35,7 @@ from ..ops.gather import (
     pack_gather,
     unpack_cols,
     wire_pack_cols,
+    wire_q8_cols,
     wire_unpack_cols,
 )
 
@@ -357,15 +358,31 @@ def scatter_send(
     ].set(data, mode="drop")
 
 
-def header_slots(dest: jax.Array, num_partitions: int, bucket_cap: int) -> jax.Array:
+def wire_header_rows(wplan) -> int:
+    """Header rows one chunk of a wire-narrowed exchange needs: the round
+    send count plus one f32 block scale per 'q8' field (the quantized
+    tier, ops/quant.py), packed into the plan's L word lanes. Plans with
+    no q8 fields keep today's single header row."""
+    nq8 = len(wire_q8_cols(wplan)) if wplan is not None else 0
+    if nq8 == 0:
+        return HEADER_ROWS
+    return max(1, -(-(1 + nq8) // wplan.n_words))
+
+
+def header_slots(
+    dest: jax.Array,
+    num_partitions: int,
+    bucket_cap: int,
+    n_header: int = HEADER_ROWS,
+) -> jax.Array:
     """Remap plain send slots into the header-augmented buffer layout
-    [P * (bucket_cap + HEADER_ROWS)]: each chunk's data rows shift down by
+    [P * (bucket_cap + n_header)]: each chunk's data rows shift down by
     its header row(s); the dropped sentinel follows along."""
     pid = dest // bucket_cap  # == num_partitions for the dropped sentinel
     return jnp.where(
         dest >= num_partitions * bucket_cap,
-        num_partitions * (bucket_cap + HEADER_ROWS),
-        dest + (pid + 1) * HEADER_ROWS,
+        num_partitions * (bucket_cap + n_header),
+        dest + (pid + 1) * n_header,
     ).astype(jnp.int32)
 
 
@@ -375,21 +392,37 @@ def pack_lane_buffer(
     counts_round: jax.Array,
     num_partitions: int,
     bucket_cap: int,
+    header_extra: Optional[jax.Array] = None,
+    n_header: int = HEADER_ROWS,
 ) -> jax.Array:
     """Stack the int32 lanes and scatter them into the header-augmented
-    send buffer [P * (bucket_cap + 1), L]; row 0 of each destination chunk
-    carries this shard's round send count for that destination in lane 0
-    (the fused count exchange)."""
+    send buffer [P * (bucket_cap + n_header), L]; the header rows of each
+    destination chunk carry this shard's round send count for that
+    destination (lane 0) followed by ``header_extra`` — [P, E] int32
+    per-chunk metadata (the quantized tier's bitcast block scales) —
+    wrapped across ``n_header`` rows (the fused count/scale exchange)."""
     packed = jnp.stack(lanes, axis=1)  # [cap, L]
     L = packed.shape[1]
-    rows = bucket_cap + HEADER_ROWS
+    rows = bucket_cap + n_header
     buf = jnp.zeros((num_partitions * rows, L), jnp.int32)
-    buf = buf.at[
-        jnp.arange(num_partitions, dtype=jnp.int32) * rows, 0
-    ].set(counts_round.astype(jnp.int32))
-    return buf.at[header_slots(dest, num_partitions, bucket_cap)].set(
-        packed, mode="drop"
-    )
+    if header_extra is None and n_header == 1:
+        buf = buf.at[
+            jnp.arange(num_partitions, dtype=jnp.int32) * rows, 0
+        ].set(counts_round.astype(jnp.int32))
+    else:
+        hv = jnp.zeros((num_partitions, n_header * L), jnp.int32)
+        hv = hv.at[:, 0].set(counts_round.astype(jnp.int32))
+        if header_extra is not None:
+            E = header_extra.shape[1]
+            hv = hv.at[:, 1 : 1 + E].set(header_extra.astype(jnp.int32))
+        hidx = (
+            jnp.arange(num_partitions, dtype=jnp.int32)[:, None] * rows
+            + jnp.arange(n_header, dtype=jnp.int32)[None, :]
+        ).reshape(-1)
+        buf = buf.at[hidx].set(hv.reshape(num_partitions * n_header, L))
+    return buf.at[
+        header_slots(dest, num_partitions, bucket_cap, n_header)
+    ].set(packed, mode="drop")
 
 
 def exchange_buffer(buf: jax.Array, num_partitions: int, axis_name: str) -> jax.Array:
@@ -407,7 +440,7 @@ def exchange_buffer(buf: jax.Array, num_partitions: int, axis_name: str) -> jax.
 
 
 def split_header(
-    got: jax.Array, num_partitions: int
+    got: jax.Array, num_partitions: int, n_header: int = HEADER_ROWS
 ) -> Tuple[jax.Array, jax.Array]:
     """Strip the header rows off a received lane buffer: (data rows
     [P * bucket_cap, L], recv_counts [P] — entry s = rows source shard s
@@ -415,10 +448,76 @@ def split_header(
     rows = got.shape[0] // num_partitions
     g = got.reshape(num_partitions, rows, *got.shape[1:])
     recv_counts = g[:, 0, 0].astype(jnp.int32)
-    data = g[:, HEADER_ROWS:].reshape(
-        num_partitions * (rows - HEADER_ROWS), *got.shape[1:]
+    data = g[:, n_header:].reshape(
+        num_partitions * (rows - n_header), *got.shape[1:]
     )
     return data, recv_counts
+
+
+def split_header_scales(
+    got: jax.Array, num_partitions: int, n_header: int, nq8: int
+) -> jax.Array:
+    """[P, nq8] f32 per-source-chunk block scales from a received
+    buffer's header rows (written by :func:`pack_lane_buffer`'s
+    ``header_extra`` — lane positions 1..nq8 of the flattened header)."""
+    rows = got.shape[0] // num_partitions
+    L = got.shape[1]
+    g = got.reshape(num_partitions, rows, L)
+    flat = g[:, :n_header].reshape(num_partitions, n_header * L)
+    return jax.lax.bitcast_convert_type(
+        flat[:, 1 : 1 + nq8], jnp.float32
+    )
+
+
+# ----------------------------------------------------------------------
+# quantized-tier block scales (ops/quant.py): one f32 max-abs scale per
+# (destination chunk, q8 field) computed at pack, shipped in the header
+# rows, broadcast back per received row at compact
+# ----------------------------------------------------------------------
+
+def quant_chunk_scales(
+    cols: Cols, wplan, dest: jax.Array, num_partitions: int,
+    bucket_cap: int,
+) -> jax.Array:
+    """[P, nq8] strictly-positive f32 block scales: the finite max-abs of
+    every q8 column over THIS round's rows bound for each destination
+    chunk (rows outside the round window carry the dropped sentinel and
+    never contribute — their magnitudes belong to their own round's or
+    the relay's block)."""
+    from ..ops import quant as _q
+
+    chunk = dest // bucket_cap  # sentinel rows -> num_partitions (dropped)
+    scales = []
+    for ci, _dt in wire_q8_cols(wplan):
+        x = cols[ci][0].astype(jnp.float32)
+        mag = jnp.where(jnp.isfinite(x), jnp.abs(x), jnp.float32(0.0))
+        bm = jnp.zeros((num_partitions,), jnp.float32).at[chunk].max(
+            mag, mode="drop"
+        )
+        scales.append(_q.safe_scale(bm))
+    return jnp.stack(scales, axis=1)
+
+
+def send_row_scales(
+    scales: jax.Array, dest: jax.Array, bucket_cap: int
+) -> jax.Array:
+    """[cap, nq8] per-row scales for :func:`~cylon_tpu.ops.gather
+    .wire_pack_cols`: each row reads its destination chunk's scale
+    (dropped rows clamp to the last chunk — they never ship)."""
+    chunk = jnp.clip(dest // bucket_cap, 0, scales.shape[0] - 1)
+    return scales[chunk]
+
+
+def recv_row_scales(
+    scales_recv: jax.Array, num_partitions: int, bucket_cap: int
+) -> jax.Array:
+    """[P * bucket_cap, nq8] per-row scales on the receive side: row i of
+    the stripped data buffer came from source chunk i // bucket_cap."""
+    src = (
+        jnp.arange(num_partitions * bucket_cap, dtype=jnp.int32)
+        // bucket_cap
+    )
+    return scales_recv[src]
 
 
 def exchange_column(
@@ -486,22 +585,45 @@ def exchange_columns_fused(
     exchanged lanes are then the plan's bit-packed words (validity masks
     at 1 bit/row, values at their measured width) instead of full int32
     lanes; ``bases`` carries the global rebase words (None = every
-    narrowed field is static-base, the stats-free plan).
+    narrowed field is static-base, the stats-free plan). A wire plan
+    with quantized 'q8' fields is self-contained: the per-chunk block
+    scales are computed here at pack time and ride the (widened) header
+    rows beside the counts, so the fused pipeline quantizes with no host
+    stats step.
 
     Returns (received cols, recv_counts [P]). Tables with no int32 lanes at
     all (pure f64, no validity masks) fall back to a dedicated tiny count
     exchange — there is no lane buffer for the header to ride.
     """
+    qrows = None
+    header_extra = None
+    nq8 = len(wire_q8_cols(wire)) if wire is not None else 0
+    n_header = wire_header_rows(wire) if wire is not None else HEADER_ROWS
     if wire is not None:
-        lanes, passthrough = wire_pack_cols(cols, wire, bases)
+        if nq8:
+            scales = quant_chunk_scales(
+                cols, wire, dest, num_partitions, bucket_cap
+            )
+            qrows = send_row_scales(scales, dest, bucket_cap)
+            header_extra = jax.lax.bitcast_convert_type(scales, jnp.int32)
+        lanes, passthrough = wire_pack_cols(cols, wire, bases, qscales=qrows)
         plan = list(wire.plan)
     else:
         plan, lanes, passthrough = pack_cols(cols)
     out_lanes: List[jax.Array] = []
+    qsc_rows = None
     if lanes:
-        buf = pack_lane_buffer(lanes, dest, counts_round, num_partitions, bucket_cap)
+        buf = pack_lane_buffer(
+            lanes, dest, counts_round, num_partitions, bucket_cap,
+            header_extra=header_extra, n_header=n_header,
+        )
         got = exchange_buffer(buf, num_partitions, axis_name)
-        data, recv_counts = split_header(got, num_partitions)
+        data, recv_counts = split_header(got, num_partitions, n_header)
+        if nq8:
+            qsc_rows = recv_row_scales(
+                split_header_scales(got, num_partitions, n_header, nq8),
+                num_partitions, bucket_cap,
+            )
         out_lanes = [data[:, j] for j in range(data.shape[1])]
     else:
         recv_counts = exchange_counts(counts_round, axis_name)
@@ -515,7 +637,9 @@ def exchange_columns_fused(
         return None if lane is None else lane.astype(jnp.bool_)
 
     if wire is not None:
-        out = wire_unpack_cols(out_lanes, wire, bases, handle_pt, make_valid)
+        out = wire_unpack_cols(
+            out_lanes, wire, bases, handle_pt, make_valid, qscales=qsc_rows
+        )
     else:
         out, _ = unpack_cols(plan, out_lanes, handle_pt, make_valid)
     return out, recv_counts
@@ -563,21 +687,27 @@ def compact_received_wire(
     lane_rows: jax.Array,
     pt_cols: dict,
     mask: jax.Array,
+    qscale_rows: Optional[jax.Array] = None,
 ) -> List[Tuple[jax.Array, Optional[jax.Array]]]:
     """:func:`compact_received_lanes` for a wire-narrowed exchange: the
     received rows ARE packed words, so the liveness sort + gather runs on
     the narrow [rows, n_words] matrix and the bit-unpack happens once, on
-    the compacted rows."""
+    the compacted rows. ``qscale_rows``: [rows, nq8] per-row block scales
+    of the quantized fields (broadcast from the headers BEFORE this
+    permutation — they ride the same gather so each row dequantizes with
+    its own source chunk's scale)."""
     order = jnp.argsort(~mask, stable=True).astype(jnp.int32)
     g = lane_rows[order]
     word_lanes = [g[:, j] for j in range(g.shape[1])]
     sorted_pt = {ci: d[order] for ci, d in pt_cols.items()}
+    qsc = None if qscale_rows is None else qscale_rows[order]
     return wire_unpack_cols(
         word_lanes,
         wire,
         bases,
         lambda ci: sorted_pt[ci],
         lambda lane: None if lane is None else lane.astype(jnp.bool_),
+        qscales=qsc,
     )
 
 

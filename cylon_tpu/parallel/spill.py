@@ -486,18 +486,73 @@ class ShardArenaSink:
     """The engine-internal tier-1/2 sink: one PHYSICAL-encoding arena per
     destination shard; :func:`arena_result` rebuilds the device table at
     the end with the source table's dtype/dictionary metadata, so a
-    spilled shuffle's result is bit-identical to the in-HBM path."""
+    spilled shuffle's result is bit-identical to the in-HBM path.
 
-    def __init__(self, world: int, schema, backing: int) -> None:
+    ``quant``: the lossy-tier column map ``{col_index: original
+    np.dtype}`` (ops/quant.py q8). Quantized columns LIVE in the arenas
+    as uint8 codes — 1 byte/row instead of 4-8, so the host/disk spill
+    budgets stretch ~4x on float-heavy tables — with one block scale
+    recorded per appended batch; :func:`arena_result` dequantizes at
+    rebuild. Staged batches arrive pre-encoded from the device pack
+    (codes + scale); host-side float batches (the skew relay's decoded
+    tails) are re-encoded here with their own batch max-abs scale."""
+
+    def __init__(self, world: int, schema, backing: int, quant=None) -> None:
         self.arenas = [HostArena(schema, backing) for _ in range(world)]
+        self.quant = dict(quant) if quant else {}
+        #: per (shard, col): [(row_end, scale)] quantized-batch segments
+        self.qsegs = [
+            {ci: [] for ci in self.quant} for _ in range(world)
+        ]
         self.device_rows_peak = 0  # engine-reported, per shard
 
-    def accept(self, table, shard_cols, counts) -> None:
+    def accept(self, table, shard_cols, counts, scales=None) -> None:
         """``shard_cols[s]`` = physical (data, valid) pairs of shard s's
-        rows (host arrays); ``table`` carries metadata only."""
+        rows (host arrays); ``table`` carries metadata only. For
+        quantized columns the data is either uint8 codes with
+        ``scales[s][ci]`` supplied (the staged-round path), or float
+        values to re-encode here (the relay path)."""
+        from ..ops import quant as _q
+
         for s, cols in enumerate(shard_cols):
-            if int(counts[s]):
-                self.arenas[s].append_batch(cols)
+            if not int(counts[s]):
+                continue
+            if self.quant:
+                cols = list(cols)
+                for ci in self.quant:
+                    data, valid = cols[ci]
+                    if data.dtype == np.uint8:
+                        scale = float(scales[s][ci])
+                    else:
+                        scale = _q.np_maxabs(data)
+                        data = _q.np_encode_q8(data, scale)
+                        bump("shuffle.quant.spill_reencoded")
+                    cols[ci] = (data, valid)
+                    self.qsegs[s][ci].append(
+                        (self.arenas[s].rows + int(counts[s]), scale)
+                    )
+            self.arenas[s].append_batch(cols)
+
+    def dequantized_columns(self, s: int):
+        """Shard ``s``'s physical columns with quantized columns decoded
+        back to their original float dtype (segment-by-segment, each
+        with its recorded block scale)."""
+        from ..ops import quant as _q
+
+        cols = self.arenas[s].columns()
+        if not self.quant:
+            return cols
+        out = list(cols)
+        for ci, dt in self.quant.items():
+            codes, valid = out[ci]
+            data = np.empty(codes.shape, dt)
+            lo = 0
+            for end, scale in self.qsegs[s][ci]:
+                data[lo:end] = _q.np_decode_q8(codes[lo:end], scale, dt)
+                lo = end
+            assert lo == len(codes), "quantized segment bookkeeping hole"
+            out[ci] = (data, valid)
+        return out
 
     def counts(self) -> np.ndarray:
         return np.asarray([a.rows for a in self.arenas], np.int64)
@@ -528,92 +583,214 @@ def _unpack_host_shard(plan, pt_order, mat_s, pts_s, n):
     return _g.host_unpack_cols(plan, lanes, lambda ci: pt_map[ci])
 
 
-def stage_table(sink, table, counts: np.ndarray) -> None:
+def stage_table(sink, table, counts: np.ndarray, qspec=None) -> None:
     """Fetch one staged round's table into ``sink`` through the
     spill-aware lane codec: every int32-lane column rides ONE packed
     [rows, L] transfer (plus one per f64 passthrough column) and is
     decoded on the host (ops/gather.host_unpack_cols) — instead of one
     device round-trip per column. ``counts`` are the host-known received
     rows per shard (the engine's planned expectation; no extra count
-    fetch). This function owns the spill staging sync sites
-    (analysis/contracts.py 'spill.stage_table')."""
+    fetch).
+
+    ``qspec``: the quantized-tier column signature (ops/quant.py; 'q8'
+    entries only). Quantized float columns leave the int32 lane matrix
+    as a uint8 code matrix + one block scale per (shard, column) — the
+    PCIe crossing and the arena both hold 1 byte/row — and the codes
+    ride into the sink still encoded (the arena stores quantized bytes;
+    arena_result decodes). This function owns the spill staging sync
+    sites (analysis/contracts.py 'spill.stage_table'); the quantized
+    extras ride the existing passthrough fetch, adding no site."""
     from ..table import _fetch, get_kernel
     import jax.numpy as jnp
 
     ctx = table.ctx
     world = ctx.world_size
     plan, pt_order, flat = _table_lane_parts(table)
-    key = ("spill_pack", tuple(plan))
+    if qspec is not None and not any(c == "q8" for c in qspec):
+        qspec = None
+    qplan, q_cols = (
+        _g.quant_lane_parts(plan, qspec)
+        if qspec is not None
+        else (tuple(plan), ())
+    )
+    pt_eff = tuple(
+        ci for ci in pt_order
+        if qspec is None or qspec[ci] != "q8"
+    )
+    key = ("spill_pack", tuple(qplan))
 
     def build():
         def kern(dp, rep):
-            (cols,) = dp
-            _plan, lanes, passthrough = _g.pack_cols(list(cols))
-            cap = cols[0][0].shape[0]
+            # lint: keyed=q_cols -- pure function of the quantized lane
+            # plan, which is the ("spill_pack", qplan) cache key itself
+            if q_cols:
+                (cols, cnts) = dp
+                cap = cols[0][0].shape[0]
+                live = jnp.arange(cap, dtype=jnp.int32) < cnts[0]
+                lanes, passthrough, qcodes, qscales = _g.pack_cols_quant(
+                    list(cols), qplan, q_cols, live=live
+                )
+            else:
+                (cols,) = dp
+                _plan, lanes, passthrough = _g.pack_cols(list(cols))
+                cap = cols[0][0].shape[0]
             mat = (
                 jnp.stack(lanes, axis=1)
                 if lanes
                 else jnp.zeros((cap, 0), jnp.int32)
             )
-            # lint: keyed=pt_order -- pure function of the lane plan,
-            # which is the ("spill_pack", plan) cache key itself
-            return mat, tuple(passthrough[ci] for ci in pt_order)
+            # lint: keyed=pt_eff -- pure function of the (quantized) lane
+            # plan, which is the ("spill_pack", qplan) cache key itself
+            pts = tuple(passthrough[ci] for ci in pt_eff)
+            if q_cols:
+                pts = pts + (qcodes, qscales)
+            return mat, pts
 
         return kern
 
     with span("shuffle.spill.stage", rows=int(np.sum(counts))):
-        mat, pts = get_kernel(ctx, key, build)((flat,), ())
+        dp = (flat, table.counts_dev) if q_cols else (flat,)
+        mat, pts = get_kernel(ctx, key, build)(dp, ())
         bump("host_sync")
         mat_np = np.asarray(_fetch(mat))
         pts_np = [np.asarray(_fetch(p)) for p in pts]
     cap = mat_np.shape[0] // world
     mat_np = mat_np.reshape(world, cap, mat_np.shape[1])
+    qmat_np = qsc_np = None
+    if q_cols:
+        qsc_np = pts_np[-1].reshape(world, len(q_cols))
+        qmat_np = pts_np[-2].reshape(world, cap, len(q_cols))
+        pts_np = pts_np[:-2]
     pts_np = [p.reshape(world, cap) for p in pts_np]
     shard_cols = []
+    scales = []
     staged = 0
     for s in range(world):
         n = int(counts[s])
-        shard_cols.append(
-            _unpack_host_shard(
-                plan, pt_order, mat_np[s], [p[s] for p in pts_np], n
+        if q_cols:
+            qmap = {
+                ci: np.ascontiguousarray(qmat_np[s, :n, k])
+                for k, (ci, _dt) in enumerate(q_cols)
+            }
+            shard_cols.append(
+                _g.host_unpack_cols_quant(
+                    qplan,
+                    [
+                        np.ascontiguousarray(mat_np[s, :n, j])
+                        for j in range(mat_np.shape[2])
+                    ],
+                    lambda ci, _pt=dict(
+                        zip(pt_eff, [p[s][:n] for p in pts_np])
+                    ): _pt[ci],
+                    lambda ci, _dt: qmap[ci],
+                )
             )
-        )
+            scales.append(
+                {
+                    ci: float(qsc_np[s, k])
+                    for k, (ci, _dt) in enumerate(q_cols)
+                }
+            )
+        else:
+            shard_cols.append(
+                _unpack_host_shard(
+                    plan, pt_order, mat_np[s], [p[s] for p in pts_np], n
+                )
+            )
         staged += n
     bump("shuffle.spill.staged_rounds")
-    bump(
-        "shuffle.spill.staged_bytes",
-        rows=staged * _sh.exchange_row_bytes(flat),
-    )
-    sink.accept(table, shard_cols, counts)
+    row_bytes = _sh.exchange_row_bytes(flat)
+    bump("shuffle.spill.staged_bytes", rows=staged * row_bytes)
+    if q_cols:
+        # each quantized column staged 1 byte/row where the plain lane
+        # codec ships 4 (8 for f64) — the arena-budget stretch evidence
+        saved = sum(
+            (8 if dt == "float64" else 4) - 1 for _ci, dt in q_cols
+        )
+        bump("shuffle.quant.spill_bytes_saved", rows=staged * saved)
+    if q_cols:
+        sink.accept(table, shard_cols, counts, scales=scales)
+    else:
+        # caller-owned sinks (the out-of-core ingestion path) keep the
+        # original 3-arg accept contract
+        sink.accept(table, shard_cols, counts)
 
 
 def fetch_relay(
-    ctx, plan, pt_order, mat, pts, relay: np.ndarray
+    ctx, plan, pt_order, mat, pts, relay: np.ndarray, qspec=None
 ):
     """Fetch the relay extraction kernel's output and regroup rows by
     DESTINATION shard on the host. ``relay`` is the planner's [src, dst]
     over-quota row matrix — the per-source buffers are destination-major
     (shuffle.relay_send_slots), so regrouping is pure slicing. Returns
     ``(per_dst_cols, per_dst_counts)`` where ``per_dst_cols[d]`` holds
-    physical (data, valid) pairs of every row relayed to shard d. Owns
-    the relay fetch sync sites ('spill.fetch_relay')."""
+    physical (data, valid) pairs of every row relayed to shard d.
+
+    ``qspec``: the quantized-tier 'q8' signature — quantized float
+    columns arrive as uint8 codes + one block scale per source shard
+    (1 byte/row over PCIe) and are decoded here; a relayed row pays
+    exactly one lossy crossing. Owns the relay fetch sync sites
+    ('spill.fetch_relay'); the quantized extras ride the existing
+    passthrough fetch, adding no site."""
+    from ..ops import quant as _q
     from ..table import _fetch
 
     world = ctx.world_size
+    if qspec is not None and not any(c == "q8" for c in qspec):
+        qspec = None
+    qplan, q_cols = (
+        _g.quant_lane_parts(plan, qspec)
+        if qspec is not None
+        else (tuple(plan), ())
+    )
+    pt_eff = tuple(
+        ci for ci in pt_order if qspec is None or qspec[ci] != "q8"
+    )
     bump("host_sync")
     mat_np = np.asarray(_fetch(mat))
     pts_np = [np.asarray(_fetch(p)) for p in pts]
     cap = mat_np.shape[0] // world
     mat_np = mat_np.reshape(world, cap, mat_np.shape[1])
+    qmat_np = qsc_np = None
+    if q_cols:
+        qsc_np = pts_np[-1].reshape(world, len(q_cols))
+        qmat_np = pts_np[-2].reshape(world, cap, len(q_cols))
+        pts_np = pts_np[:-2]
+        bump(
+            "shuffle.quant.relay_bytes_saved",
+            rows=int(relay.sum())
+            * sum((8 if dt == "float64" else 4) - 1 for _c, dt in q_cols),
+        )
     pts_np = [p.reshape(world, cap) for p in pts_np]
     pieces: List[List[list]] = [[] for _ in range(world)]
     for s in range(world):
         n_s = int(relay[s].sum())
         if n_s == 0:
             continue
-        cols_s = _unpack_host_shard(
-            plan, pt_order, mat_np[s], [p[s] for p in pts_np], n_s
-        )
+        if q_cols:
+            qdec = {
+                ci: _q.np_decode_q8(
+                    np.ascontiguousarray(qmat_np[s, :n_s, k]),
+                    float(qsc_np[s, k]),
+                    dt,
+                )
+                for k, (ci, dt) in enumerate(q_cols)
+            }
+            cols_s = _g.host_unpack_cols_quant(
+                qplan,
+                [
+                    np.ascontiguousarray(mat_np[s, :n_s, j])
+                    for j in range(mat_np.shape[2])
+                ],
+                lambda ci, _pt=dict(
+                    zip(pt_eff, [p[s][:n_s] for p in pts_np])
+                ): _pt[ci],
+                lambda ci, _dt: qdec[ci],
+            )
+        else:
+            cols_s = _unpack_host_shard(
+                plan, pt_order, mat_np[s], [p[s] for p in pts_np], n_s
+            )
         offs = np.concatenate([[0], np.cumsum(relay[s])]).astype(np.int64)
         for d in range(world):
             lo, hi = int(offs[d]), int(offs[d + 1])
@@ -680,8 +857,13 @@ def shards_to_table(template, per_shard_cols, counts: np.ndarray):
 
 def arena_result(sink: ShardArenaSink, template):
     """A spilled shuffle's final device table, rebuilt from the sink's
-    per-shard arenas (tier-1/2 counterpart of the in-HBM round concat)."""
-    per_shard = [a.columns() if a.rows else None for a in sink.arenas]
+    per-shard arenas (tier-1/2 counterpart of the in-HBM round concat).
+    Quantized-tier columns decode from their staged uint8 codes here —
+    the arenas never held the full-width floats."""
+    per_shard = [
+        sink.dequantized_columns(s) if a.rows else None
+        for s, a in enumerate(sink.arenas)
+    ]
     res = shards_to_table(template, per_shard, sink.counts())
     sink.close()
     return res

@@ -52,6 +52,7 @@ def gated_fingerprint(plan: Node) -> tuple:
     the plan cache with it and the serving scheduler groups/keys batches
     with it (graft-lint L1 sees the gate reads threaded into both cache
     keys through this carrier)."""
+    from ..ops.quant import gate_state as _quant_gate
     from ..ops.sketch import enabled as _semi_enabled
     from ..ops.stats import enabled as _pack_enabled
     from ..ordering import enabled as _ord_enabled
@@ -61,10 +62,14 @@ def gated_fingerprint(plan: Node) -> tuple:
     # gate: both are host dispatch policy, but a cached executor's lowered
     # shuffles re-read them per run THROUGH this identity — a flip must
     # re-enter the cache, never serve a result staged under the other
-    # tier/schedule regime
+    # tier/schedule regime. The quant component carries the lossy-wire
+    # kill switch + tolerance: the tolerance decides every lowered
+    # shuffle's codec picks, so a flip (including turning the tier on)
+    # re-optimizes and re-keys the serving batch cache instead of
+    # aliasing an exact-wire executor
     base = (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
-        _spill_gate(),
+        _spill_gate(), _quant_gate(),
     )
     # the feedback component: (autotune active, tuned Decisions) — every
     # telemetry-driven override (shuffle budget, semi mode, serve bucket,

@@ -47,6 +47,7 @@ from .ops import join as _j
 from .ops import partition as _p
 from .ops import setops as _s
 from .ops import gather as _g_pack
+from .ops import quant as _quant
 from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
 from .ops import stats as _st
@@ -1928,10 +1929,20 @@ class Table:
                         num_slices,
                     ),
                 )
+            # the quantized wire tier rides the fused shuffles too: per-
+            # side codec specs (key columns excluded) are static build
+            # parameters, so they join the step cache key — a tolerance
+            # flip builds a fresh program, never aliases
+            quant_l = _quant.quant_spec(
+                [d.dtype for d, _v in lflat], lk_idx, ctx.quant_tol
+            )
+            quant_r = _quant.quant_spec(
+                [d.dtype for d, _v in rflat], rk_idx, ctx.quant_tol
+            )
             key = (
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
                 bucket_cap, join_cap, respill, num_slices,
-                _st.enabled(),
+                _st.enabled(), quant_l, quant_r,
             ) + _j.impl_tag()
             cache = ctx.__dict__.setdefault("_jit_cache", {})
             step = cache.get(key)
@@ -1939,6 +1950,7 @@ class Table:
                 step = make_distributed_join_step(
                     ctx.mesh, ctx.axis_name, lk_idx, rk_idx, howi,
                     bucket_cap, join_cap, respill, num_slices,
+                    quant_l=quant_l, quant_r=quant_r,
                 )
                 cache[key] = step
             with span("join.fused", rows=self._rows_hint()):
@@ -3263,6 +3275,27 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         ci for ci, (d, _v) in enumerate(flat)
         if stats_on and _st.enc_class(d.dtype) is not None
     )
+    # quantized float wire tier (ops/quant.py): payload float columns may
+    # ride lossy block-scaled codecs under the per-context tolerance —
+    # join/groupby KEY columns are never quantized (exact identity is the
+    # contract), and the decided per-column codec joins the kernel cache
+    # key below AND the WirePlan the pack/compact keys already carry. The
+    # relay and spill host crossings engage only the byte-staged 'q8'
+    # tier of the signature. The lossy tier rides the wire codec, so the
+    # CYLON_TPU_NO_LANE_PACK oracle disables it too (``stats_on`` — same
+    # behavior as the fused path's gated static_wire_plan).
+    quant_sig = _quant.quant_spec(
+        [d.dtype for d, _v in flat], key_idx,
+        ctx.quant_tol if stats_on else 0.0,
+    )
+    relay_qsig = tuple(c if c == "q8" else None for c in quant_sig)
+    if not any(c is not None for c in relay_qsig):
+        relay_qsig = None
+    relay_qplan, relay_qcols = (
+        _g_pack.quant_lane_parts(plan_sig, relay_qsig)
+        if relay_qsig is not None
+        else (plan_sig, ())
+    )
 
     def probe_ok(cols, sk_view):
         """Per-row semi-filter survival against the OTHER side's combined
@@ -3274,8 +3307,13 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     # builders bake the passthrough layout in, so same-arity tables with
     # different dtypes must not alias to one cache entry; the semi-filter
     # probe changes both kernels' bodies, so its statics join the key,
-    # and so do the stats columns the count pass measures
-    key = ("shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key, stat_cols) + (
+    # and so do the stats columns the count pass measures and the
+    # quantized-tier codec signature (tolerance flips recompile, never
+    # alias)
+    key = (
+        "shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key, stat_cols,
+        quant_sig,
+    ) + (
         ("semi", spec.probe_row, spec.use_range) if semi else ()
     )
     has_lanes = any(
@@ -3342,25 +3380,43 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
             cnt = _sh.bucket_counts(pid, world)
             dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
             rc = _sh.round_counts(cnt, bc, rnd)
+            hx = None
+            n_header = _sh.HEADER_ROWS
             if wire is not None:
                 # bit-width-adaptive wire narrowing: lanes are the packed
                 # words of the stats-driven wire plan (validity at 1
                 # bit/row, values at measured width, global rebase words
-                # riding in as the tiny replicated `bases` operand)
+                # riding in as the tiny replicated `bases` operand).
+                # Quantized 'q8' fields additionally compute one block
+                # scale per destination chunk here and ship it in the
+                # (widened) header rows beside the counts.
+                n_header = _sh.wire_header_rows(wire)
+                qrows = None
+                if _g_pack.wire_q8_cols(wire):
+                    scales = _sh.quant_chunk_scales(
+                        cols, wire, dest, world, bc
+                    )
+                    qrows = _sh.send_row_scales(scales, dest, bc)
+                    hx = jax.lax.bitcast_convert_type(scales, jnp.int32)
                 lanes, passthrough = _g_pack.wire_pack_cols(
-                    list(cols), wire, bases
+                    list(cols), wire, bases, qscales=qrows
                 )
+                pt_eff = _g_pack.wire_pt_order(wire, pt_order)
             else:
                 _plan, lanes, passthrough = _g_pack.pack_cols(list(cols))
+                pt_eff = pt_order
             if lanes:
                 # the fused count/payload exchange: this round's per-
                 # destination send counts ride the lane buffer's header row
-                head = _sh.pack_lane_buffer(lanes, dest, rc, world, bc)
+                head = _sh.pack_lane_buffer(
+                    lanes, dest, rc, world, bc,
+                    header_extra=hx, n_header=n_header,
+                )
             else:
                 head = rc  # pure-f64 table: dedicated count lane
             pts = tuple(
                 _sh.scatter_send(passthrough[ci], dest, world, bc)
-                for ci in pt_order
+                for ci in pt_eff
             )
             return head, pts
 
@@ -3369,7 +3425,9 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     def build_coll():
         def kern(dp, rep):
             (head, pts) = dp
-            if has_lanes:
+            # a decided wire plan guarantees word lanes even when the
+            # plain codec had none (pure-f64 quantized tables)
+            if has_lanes or st["wire"] is not None:
                 out_head = _sh.exchange_buffer(head, world, ax)
             else:
                 out_head = _sh.exchange_counts(head, ax)
@@ -3385,8 +3443,11 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         # codec ops/gather.host_unpack_cols decodes them; wire narrowing
         # never applies, the rows do not ride a collective), destination-
         # major so the host splits per-source buffers with the planner's
-        # own relay counts. Dispatched under the separately-keyed
-        # ("relay",) suffix only when the schedule is adaptive.
+        # own relay counts. Under the quantized tier, eligible float
+        # payload columns leave the lane matrix as uint8 q8 codes (one
+        # block scale per source shard) so the double host crossing ships
+        # 1 byte/row instead of 4-8. Dispatched under the separately-
+        # keyed ("relay",) suffix only when the schedule is adaptive.
         def kern(dp, rep):
             if semi:
                 (cols, kcols, counts, sk) = dp
@@ -3403,7 +3464,15 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
             rc = dummy.shape[0]
             cnt = _sh.bucket_counts(pid, world)
             dest = _sh.relay_send_slots(pid, cnt, world, quota, rc)
-            _plan2, lanes, passthrough = _g_pack.pack_cols(list(cols))
+            if relay_qcols:
+                lanes, passthrough, qcodes, qscales = (
+                    _g_pack.pack_cols_quant(
+                        list(cols), relay_qplan, relay_qcols,
+                        live=dest < rc,
+                    )
+                )
+            else:
+                _plan2, lanes, passthrough = _g_pack.pack_cols(list(cols))
             if lanes:
                 mat = _sh.scatter_send(
                     jnp.stack(lanes, axis=1), dest, 1, rc
@@ -3413,7 +3482,12 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
             pts = tuple(
                 _sh.scatter_send(passthrough[ci], dest, 1, rc)
                 for ci in pt_order
+                if not relay_qcols or relay_qsig[ci] != "q8"
             )
+            if relay_qcols:
+                pts = pts + (
+                    _sh.scatter_send(qcodes, dest, 1, rc), qscales
+                )
             return mat, pts
 
         return kern
@@ -3422,18 +3496,41 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         def kern(dp, rep):
             wire = st["wire"]
             (head, pts) = dp
-            if has_lanes:
+            qsc_rows = None
+            if wire is not None:
+                n_header = _sh.wire_header_rows(wire)
+                lane_rows, recv_counts = _sh.split_header(
+                    head, world, n_header
+                )
+                bc = lane_rows.shape[0] // world
+                nq8 = len(_g_pack.wire_q8_cols(wire))
+                if nq8:
+                    # each received row dequantizes with its SOURCE
+                    # chunk's block scale, broadcast from the header rows
+                    # before the compaction permutes anything
+                    qsc_rows = _sh.recv_row_scales(
+                        _sh.split_header_scales(
+                            head, world, n_header, nq8
+                        ),
+                        world, bc,
+                    )
+                pt_cols = dict(
+                    zip(_g_pack.wire_pt_order(wire, pt_order), pts)
+                )
+            elif has_lanes:
                 lane_rows, recv_counts = _sh.split_header(head, world)
                 bc = lane_rows.shape[0] // world
+                pt_cols = dict(zip(pt_order, pts))
             else:
                 lane_rows, recv_counts = None, head
                 bc = pts[0].shape[0] // world
+                pt_cols = dict(zip(pt_order, pts))
             mask, total = _sh.received_row_mask(recv_counts, world, bc)
-            pt_cols = dict(zip(pt_order, pts))
             if wire is not None:
                 (bases,) = rep
                 out = _sh.compact_received_wire(
-                    wire, bases, lane_rows, pt_cols, mask
+                    wire, bases, lane_rows, pt_cols, mask,
+                    qscale_rows=qsc_rows,
                 )
             else:
                 out = _sh.compact_received_lanes(
@@ -3447,6 +3544,7 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         spec=spec, t=t, ctx=ctx, world=world, flat=flat, khash=khash,
         key=key, plan_sig=plan_sig, has_lanes=has_lanes, n_pt=len(pt_order),
         pt_order=pt_order, stat_cols=stat_cols, wire=None, bases=None,
+        quant_sig=quant_sig, relay_qsig=relay_qsig,
         build_count=build_count, build_pack=build_pack,
         build_coll=build_coll, build_compact=build_compact,
         build_relay=build_relay, pending_spill=None,
@@ -3593,13 +3691,19 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         # bit-width-adaptive wire narrowing, gated plan-aware like the
         # semi filter and now schedule-aware: decision cost = global
         # collective row slots x row bytes + the relay tail's double host
-        # crossing (relay rows always ride the PLAIN codec — they never
-        # touch a collective — so only the collective part narrows)
-        if st["col_stats"]:
+        # crossing (relay rows never touch a collective; under the
+        # quantized tier they stage as q8 bytes, else plain lanes — so
+        # only the collective part narrows here). The lossy quant fields
+        # (ops/quant.py) ride the same plan: float payload columns whose
+        # codec the tolerance picked ship 8/16/32-bit fields with block
+        # scales in the headers.
+        if st["col_stats"] or any(c is not None for c in st["quant_sig"]):
             stats_list = [None] * len(st["plan_sig"])
             for ci, stat in st["col_stats"].items():
                 stats_list[ci] = (stat.cls, _st.field_bits(stat))
-            wplan = _g_pack.wire_plan(list(st["plan_sig"]), stats_list)
+            wplan = _g_pack.wire_plan(
+                list(st["plan_sig"]), stats_list, quant=st["quant_sig"]
+            )
             if wplan is not None:
                 rb_w = _g_pack.wire_row_bytes(wplan)
                 sched_w = _spill.plan_schedule(
@@ -3633,8 +3737,24 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                         "lane_pack.wire.row_bytes_ratio",
                         rb_w / max(row_bytes, 1),
                     )
+                    if _g_pack.wire_has_quant(wplan):
+                        nq = sum(
+                            1 for f in wplan.fields if f.kind == "q"
+                        )
+                        bump("shuffle.quant.applied")
+                        bump("shuffle.quant.cols", rows=nq)
+                        bump(
+                            "shuffle.quant.bytes_saved",
+                            rows=int(total_plain - total_wire),
+                        )
+                        gauge(
+                            "shuffle.quant.row_bytes_ratio",
+                            rb_w / max(row_bytes, 1),
+                        )
                 else:
                     bump("lane_pack.wire.gate_skipped")
+                    if _g_pack.wire_has_quant(wplan):
+                        bump("shuffle.quant.gate_skipped")
         # per-exchange wire accounting for the active query trace: total
         # shipped bytes = K rounds x world^2 bucket blocks x effective
         # (possibly wire-narrowed) row bytes, plus the plain-codec relay
@@ -3642,6 +3762,16 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         # open span — the owning plan.node.* during lowered execution —
         # so explain(analyze=True) prints per-node coll MB. Host
         # arithmetic only; adds no sync and no dispatch.
+        # effective lane/passthrough layout under the decided wire plan:
+        # quantized f64 columns leave the passthrough set, and a wire
+        # plan guarantees word lanes exist even for tables whose plain
+        # codec had none (pure-f64 quantized)
+        st["pt_eff"] = (
+            _g_pack.wire_pt_order(st["wire"], st["pt_order"])
+            if st["wire"] is not None
+            else st["pt_order"]
+        )
+        st["has_lanes_eff"] = st["has_lanes"] or st["wire"] is not None
         rb_eff = (
             row_bytes if st["wire"] is None
             else _g_pack.wire_row_bytes(st["wire"])
@@ -3682,23 +3812,37 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             bump("shuffle.spill.shuffles")
             gauge("shuffle.spill.tier", tier)
             if st["spec"].sink is not None:
+                # caller-owned sinks (the out-of-core ingestion path) keep
+                # the original 3-arg accept contract and receive decoded
+                # physical columns — the quantized staging tier applies
+                # only to the engine's own arenas
                 st["sink_obj"] = st["spec"].sink
+                st["spill_qsig"] = None
             else:
                 names = st["t"].column_names
-                schema = [
-                    (
-                        names[ci],
-                        np.dtype(st["flat"][ci][0].dtype),
-                        bool(st["plan_sig"][ci][2]),
+                # quantized spill arenas: q8-tier columns stage and LIVE
+                # in the arenas as uint8 codes (+ per-batch scales), so
+                # tier-1/2 host/disk budgets stretch ~4x on float-heavy
+                # tables; arena_result dequantizes at rebuild
+                qsig = st["relay_qsig"]
+                quant_map = {}
+                schema = []
+                for ci in range(len(names)):
+                    dt = np.dtype(st["flat"][ci][0].dtype)
+                    if qsig is not None and qsig[ci] == "q8":
+                        quant_map[ci] = dt
+                        dt = np.dtype(np.uint8)
+                    schema.append(
+                        (names[ci], dt, bool(st["plan_sig"][ci][2]))
                     )
-                    for ci in range(len(names))
-                ]
                 st["sink_obj"] = _spill.ShardArenaSink(
                     w, schema,
                     _spill.TIER_DISK
                     if tier == _spill.TIER_DISK
                     else _spill.TIER_HOST,
+                    quant=quant_map or None,
                 )
+                st["spill_qsig"] = st["relay_qsig"]
         # analytic peak-device accounting (per shard, bytes): input +
         # double-buffered round exchange buffers + staged round outputs
         # (every round device-resident at tier 0; at most the two-deep
@@ -3710,9 +3854,14 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             if tier == _spill.TIER_HBM
             else min(st["n_rounds"], 2)
         )
+        hdr_rows = (
+            _sh.wire_header_rows(st["wire"])
+            if st["wire"] is not None
+            else _sh.HEADER_ROWS
+        )
         peak_rows = (
             st["t"].shard_cap
-            + 2 * w * (bc + _sh.HEADER_ROWS)
+            + 2 * w * (bc + hdr_rows)
             + staged_rounds * w * bc
             + sched.relay_cap()
         )
@@ -3794,7 +3943,8 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 with span("shuffle.round.collective"):
                     head, pts = get_kernel(
                         ctx,
-                        ("shuffle_coll", st["has_lanes"], st["n_pt"]),
+                        ("shuffle_coll", st["has_lanes_eff"],
+                         len(st["pt_eff"])),
                         st["build_coll"],
                     )((head, pts), ())
                 with span("shuffle.round.compact"):
@@ -3838,7 +3988,9 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 prev = st["pending_spill"]
                 st["pending_spill"] = fresh
                 if prev is not None:
-                    _spill.stage_table(st["sink_obj"], *prev)
+                    _spill.stage_table(
+                        st["sink_obj"], *prev, qspec=st["spill_qsig"]
+                    )
         t_disp = _time.perf_counter()
 
         # the ONE deferred sync per table: every round's received counts
@@ -3876,7 +4028,9 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             if spilled and st["pending_spill"] is not None:
                 # flush the one-deep staging window
                 pend, st["pending_spill"] = st["pending_spill"], None
-                _spill.stage_table(st["sink_obj"], *pend)
+                _spill.stage_table(
+                    st["sink_obj"], *pend, qspec=st["spill_qsig"]
+                )
             # skew-split relay tails: fetched once, regrouped by owner
             # shard on the host. Spilled shuffles merge them straight into
             # the arenas; in-HBM shuffles restage them as one extra table
@@ -3886,6 +4040,7 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
                 per_dst, rcounts = _spill.fetch_relay(
                     st["ctx"], list(st["plan_sig"]), st["pt_order"],
                     *st["relay_out"], st["sched"].relay,
+                    qspec=st["relay_qsig"],
                 )
                 if spilled:
                     st["sink_obj"].accept(t, per_dst, rcounts)

@@ -202,6 +202,22 @@ SKETCH_BITS = EnvKnob(
     note="semi-join sketch bit cap (config.py)",
 )
 
+# -- quantized float wire tier (ops/quant.py; the CYLON_TPU_NO_QUANT
+# kill switch is declared at its consumer module via env_gate) ----------
+QUANT_TOL = EnvKnob(
+    "CYLON_TPU_QUANT_TOL", "", kind="dispatch",
+    keyed_via="host-side codec selection: the tolerance picks each float "
+    "payload column's lossy codec (ops.quant.codec_for), and the decided "
+    "codecs ride the WirePlan 'q' fields already appended to every "
+    "pack/compact kernel cache key (plus the relay/spill quant "
+    "signatures); the plan fingerprint carries ops.quant.gate_state — "
+    "no program aliasing across a tolerance flip",
+    note="per-column relative error tolerance of the lossy float wire "
+    "tier (shuffle wire, spill staging, skew relay, fused psum): "
+    ">= 1e-2 engages block-scaled int8, >= 2^-8 bf16, >= 2^-23 "
+    "f64->f32 demotion; unset/empty = exact wire (today's behavior)",
+)
+
 # -- spill tiers (parallel/spill.py; the CYLON_TPU_NO_SKEW_SPLIT kill
 # switch is declared at its consumer module via env_gate) ---------------
 SPILL_TIER = EnvKnob(

@@ -1,0 +1,467 @@
+"""Quantized float wire tier tests (the lossy lane codec, ops/quant.py).
+
+Four layers:
+  1. codec round trips — q8/qb16/qf32 error bounds (negative values,
+     inf/NaN passthrough, all-zero blocks) and the lossless h16 satellite
+     (f16/bf16 at native 16-bit wire width, bit-exact);
+  2. differentials — quantized join / groupby-SUM / sort / shuffle vs
+     the CYLON_TPU_NO_QUANT=1 oracle at worlds {1, 4, 8}: exact keys,
+     group identity and row counts, per-value rel-err <= tolerance on
+     float payload columns;
+  3. gate pins — tolerance-unset results bit-identical to the kill
+     switch (the wire tier adds NOTHING when off), the plan fingerprint
+     carries the tolerance, and the kernel cache key carries the codec
+     signature;
+  4. the spill/relay tier — a tier-1/2 forced shuffle stages q8 bytes
+     through the host arenas (uint8 storage + per-batch scales) and a
+     one-hot skew shape relays quantized tails, both within the doubled-
+     crossing error budget.
+"""
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+import cylon_tpu as ct
+from cylon_tpu.ops import gather as gmod
+from cylon_tpu.ops import quant as qmod
+from cylon_tpu.utils.tracing import get_count, reset_trace
+
+TOL = 1e-2
+
+
+@pytest.fixture(scope="module")
+def ctx1(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:1]))
+
+
+@pytest.fixture(scope="module")
+def ctx4(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+
+
+@pytest.fixture(scope="module")
+def ctx8(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:8]))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env():
+    saved = {
+        k: os.environ.get(k)
+        for k in ("CYLON_TPU_QUANT_TOL", "CYLON_TPU_NO_QUANT",
+                  "CYLON_TPU_SPILL_TIER")
+    }
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _tol(tol):
+    os.environ["CYLON_TPU_QUANT_TOL"] = str(tol)
+
+
+# ----------------------------------------------------------------------
+# 1. codec round trips
+# ----------------------------------------------------------------------
+
+def test_q8_round_trip_error_bound():
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=512) * 50).astype(np.float32)
+    xj = jnp.asarray(x)
+    s = qmod.safe_scale(qmod.block_maxabs(xj))
+    sv = jnp.full(x.shape, s)
+    back = np.asarray(qmod.decode_q8(qmod.encode_q8(xj, sv), sv, "float32"))
+    bm = float(np.abs(x).max())
+    assert np.abs(back - x).max() <= bm / 252 + 1e-7
+    # negative values survive with the same bound
+    assert (np.sign(back[np.abs(x) > bm / 100]) ==
+            np.sign(x[np.abs(x) > bm / 100])).all()
+
+
+def test_q8_specials_and_zero_block():
+    x = jnp.asarray(
+        np.array([0.0, -0.0, np.nan, np.inf, -np.inf], np.float32)
+    )
+    s = qmod.safe_scale(qmod.block_maxabs(x))
+    assert float(s) == 1.0  # no finite magnitude: the zero-block scale
+    sv = jnp.full(x.shape, s)
+    back = np.asarray(qmod.decode_q8(qmod.encode_q8(x, sv), sv, "float32"))
+    assert back[0] == 0.0 and back[1] == 0.0  # all-zero block is exact
+    assert np.isnan(back[2])
+    assert back[3] == np.inf and back[4] == -np.inf
+    # numpy mirror is bit-identical on codes
+    xn = np.asarray(x)
+    codes_np = qmod.np_encode_q8(xn, 1.0)
+    codes_dev = np.asarray(qmod.encode_q8(x, sv)).astype(np.uint8)
+    assert (codes_np == codes_dev).all()
+    assert np.array_equal(
+        qmod.np_decode_q8(codes_np, 1.0, "float32"), back, equal_nan=True
+    )
+
+
+def test_qb16_qf32_round_trips():
+    rng = np.random.default_rng(1)
+    x = np.concatenate(
+        [rng.normal(size=256) * 1e3, [np.nan, np.inf, -np.inf, 0.0]]
+    ).astype(np.float64)
+    xj = jnp.asarray(x)
+    b16 = np.asarray(qmod.decode_qb16(qmod.encode_qb16(xj), "float64"))
+    fin = np.isfinite(x)
+    assert np.abs(b16[fin] - x[fin]).max() <= 2.0 ** -8 * np.abs(x[fin]).max()
+    assert np.isnan(b16[~fin][0]) and b16[-3] == np.inf and b16[-2] == -np.inf
+    f32 = np.asarray(qmod.decode_qf32(qmod.encode_qf32(xj), "float64"))
+    assert np.abs(f32[fin] - x[fin]).max() <= 2.0 ** -23 * np.abs(x[fin]).max()
+
+
+def test_codec_for_tiers():
+    assert qmod.codec_for(np.float32, 0.0) is None
+    assert qmod.codec_for(np.int32, 1.0) is None
+    assert qmod.codec_for(np.float32, 1e-2) == "q8"
+    assert qmod.codec_for(np.float32, 5e-3) == "qb16"
+    assert qmod.codec_for(np.float32, 1e-4) is None
+    assert qmod.codec_for(np.float64, 1e-4) == "qf32"
+    assert qmod.codec_for(np.float64, 1e-8) is None
+    assert qmod.codec_for(np.float16, 1e-2) == "q8"
+    assert qmod.codec_for(np.float16, 5e-3) is None  # h16 already 16-bit
+
+
+def test_h16_wire_field_lossless(ctx4):
+    rng = np.random.default_rng(2)
+    n = 2000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 64, n).astype(np.int32),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+    df["h"] = rng.normal(size=n).astype(np.float16)
+    t = ct.Table.from_pandas(ctx4, df)
+    got = t.shuffle(["k"]).to_pandas().sort_values("rid")
+    want = df.sort_values("rid")
+    assert (got["h"].values == want["h"].values).all()
+    assert (got["k"].values == want["k"].values).all()
+
+
+def test_h16_field_in_plan():
+    # two f16 columns: 2x16 lossless bits share ONE word where the
+    # widened codec shipped two full f32-bitcast lanes (a LONE f16
+    # correctly declines — 16 bits still occupy one 32-bit word)
+    plan = gmod.lane_plan(
+        [(jnp.zeros(8, jnp.float16), None),
+         (jnp.zeros(8, jnp.bfloat16), None)]
+    )
+    wp = gmod.wire_plan(list(plan), [None, None])
+    assert wp is not None and wp.n_words == 1
+    assert [f.kind for f in wp.fields] == ["h16", "h16"]
+    assert [f.cls for f in wp.fields] == ["float16", "bfloat16"]
+    alone = gmod.wire_plan(list(plan[:1]), [None])
+    assert alone is None
+
+
+# ----------------------------------------------------------------------
+# 2. differentials vs the CYLON_TPU_NO_QUANT=1 oracle
+# ----------------------------------------------------------------------
+
+def _pair(rng, n, dtype=np.float32):
+    ldf = pd.DataFrame({
+        "k": rng.integers(0, max(n // 20, 2), n).astype(np.int32),
+        "v": (rng.normal(size=n) * 10).astype(dtype),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+    rdf = pd.DataFrame({
+        "rk": rng.integers(0, max(n // 20, 2), n // 2).astype(np.int32),
+        "w": (rng.normal(size=n // 2) * 10).astype(dtype),
+        "sid": np.arange(n // 2, dtype=np.int64),
+    })
+    return ldf, rdf
+
+
+def _join(ctx, ldf, rdf):
+    lt = ct.Table.from_pandas(ctx, ldf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    out = lt.distributed_join(
+        rt, left_on=["k"], right_on=["rk"], how="inner"
+    ).to_pandas()
+    return out.sort_values(["rid", "sid"]).reset_index(drop=True)
+
+
+@pytest.mark.parametrize("world", [1, 4, 8])
+def test_join_differential(world, devices, request):
+    ctx = request.getfixturevalue(f"ctx{world}")
+    rng = np.random.default_rng(world)
+    ldf, rdf = _pair(rng, 3000)
+    with qmod.disabled():
+        exact = _join(ctx, ldf, rdf)
+    _tol(TOL)
+    got = _join(ctx, ldf, rdf)
+    # exact row identity: join keys and row ids are NEVER quantized
+    assert len(exact) == len(got)
+    for c in ("k", "rid", "sid"):
+        assert (exact[c].values == got[c].values).all()
+    # float payloads: per-value relative error within tolerance
+    for c in ("v", "w"):
+        ref = np.abs(exact[c].values).max()
+        assert np.abs(exact[c].values - got[c].values).max() <= TOL * ref
+
+
+@pytest.mark.parametrize("world", [1, 4, 8])
+def test_groupby_sum_differential(world, devices, request):
+    ctx = request.getfixturevalue(f"ctx{world}")
+    rng = np.random.default_rng(10 + world)
+    n = 4000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 100, n).astype(np.int32),
+        "v": (rng.normal(size=n) * 5).astype(np.float32),
+    })
+
+    def gb():
+        t = ct.Table.from_pandas(ctx, df)
+        return (
+            t.distributed_groupby(["k"], {"v": "sum"})
+            .to_pandas().sort_values("k").reset_index(drop=True)
+        )
+
+    with qmod.disabled():
+        exact = gb()
+    _tol(TOL)
+    got = gb()
+    # group identity is exact; the summed payload is tolerance-bounded
+    # (per-group sums accumulate per-value errors, so the bound scales
+    # with the max group's magnitude sum)
+    assert (exact["k"].values == got["k"].values).all()
+    budget = TOL * np.abs(df["v"]).sum()
+    assert np.abs(exact["v_sum"].values - got["v_sum"].values).max() <= budget
+
+
+def test_sort_differential_keys_exact(ctx4):
+    rng = np.random.default_rng(20)
+    n = 3000
+    df = pd.DataFrame({
+        "k": rng.integers(-500, 500, n).astype(np.int32),
+        "v": rng.normal(size=n).astype(np.float32),
+    })
+
+    def srt():
+        return (
+            ct.Table.from_pandas(ctx4, df).sort(["k"]).to_pandas()
+            .reset_index(drop=True)
+        )
+
+    with qmod.disabled():
+        exact = srt()
+    _tol(TOL)
+    got = srt()
+    assert (exact["k"].values == got["k"].values).all()
+    ref = np.abs(exact["v"].values).max()
+    # local sorts do not ride the wire; only shuffled payloads quantize —
+    # a 1-table local sort must stay exact
+    assert np.abs(exact["v"].values - got["v"].values).max() <= TOL * ref
+
+
+def test_f64_passthrough_leaves_wire(ctx4):
+    """A quantized f64 column leaves the per-column passthrough
+    collective AND meets its tier's bound."""
+    rng = np.random.default_rng(30)
+    n = 2000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 64, n).astype(np.int32),
+        "d": rng.normal(size=n).astype(np.float64),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+
+    def shuf():
+        return (
+            ct.Table.from_pandas(ctx4, df).shuffle(["k"]).to_pandas()
+            .sort_values("rid").reset_index(drop=True)
+        )
+
+    with qmod.disabled():
+        exact = shuf()
+    for tol, bound in ((1e-2, 1e-2), (1e-6, 2.0 ** -23)):
+        _tol(tol)
+        got = shuf()
+        assert (exact["rid"].values == got["rid"].values).all()
+        rel = (
+            np.abs(exact["d"].values - got["d"].values).max()
+            / np.abs(exact["d"].values).max()
+        )
+        assert rel <= bound
+
+
+# ----------------------------------------------------------------------
+# 3. gate pins
+# ----------------------------------------------------------------------
+
+def test_knob_off_is_identical(ctx4):
+    """Tolerance unset == kill switch == today's exact wire: results are
+    BIT-identical and the quant gate never engages."""
+    rng = np.random.default_rng(40)
+    ldf, rdf = _pair(rng, 2000)
+    reset_trace()
+    base = _join(ctx4, ldf, rdf)
+    assert get_count("shuffle.quant.applied") == 0
+    _tol(TOL)
+    os.environ["CYLON_TPU_NO_QUANT"] = "1"  # kill switch beats tolerance
+    killed = _join(ctx4, ldf, rdf)
+    assert get_count("shuffle.quant.applied") == 0
+    for c in base.columns:
+        assert (base[c].values == killed[c].values).all()
+
+
+def test_config_zero_overrides_env():
+    """An explicit per-context quant_tol=0 opts back into the exact wire
+    even under a process-wide env tolerance (config > env, including
+    falsy values)."""
+    _tol(TOL)
+    assert qmod.tolerance(None) == TOL
+    assert qmod.tolerance("0") == 0.0
+    assert qmod.tolerance(0.0) == 0.0
+    assert qmod.tolerance("") == 0.0
+    assert qmod.tolerance("5e-3") == 5e-3
+
+
+def test_lane_pack_oracle_disables_quant(ctx4):
+    """CYLON_TPU_NO_LANE_PACK=1 disables the whole wire codec, the lossy
+    tier included — the packing differential oracle keeps isolating the
+    codec even when a tolerance is set (matches the fused path's gated
+    static_wire_plan)."""
+    from cylon_tpu.ops import stats as stmod
+
+    rng = np.random.default_rng(45)
+    ldf, rdf = _pair(rng, 1500)
+    _tol(TOL)
+    with stmod.disabled():
+        reset_trace()
+        got = _join(ctx4, ldf, rdf)
+        assert get_count("shuffle.quant.applied") == 0
+        with qmod.disabled():
+            exact = _join(ctx4, ldf, rdf)
+    for c in got.columns:
+        assert (exact[c].values == got[c].values).all()
+
+
+def test_fingerprint_carries_tolerance(ctx1):
+    from cylon_tpu.plan.lazy import gated_fingerprint
+
+    t = ct.Table.from_pydict(
+        ctx1, {"k": np.arange(8, dtype=np.int32),
+               "v": np.ones(8, np.float32)}
+    )
+    plan = t.lazy().groupby("k", {"v": "sum"}).plan
+    fp_off = gated_fingerprint(plan)
+    _tol(TOL)
+    fp_on = gated_fingerprint(plan)
+    os.environ["CYLON_TPU_NO_QUANT"] = "1"
+    fp_kill = gated_fingerprint(plan)
+    assert fp_off != fp_on
+    assert fp_kill != fp_on
+
+
+def test_wire_plan_key_carries_codec():
+    """The codec decision lands in the WirePlan the kernel cache keys
+    carry — different tolerances must never alias one program."""
+    plan = gmod.lane_plan(
+        [(jnp.zeros(8, jnp.int32), None), (jnp.zeros(8, jnp.float32), None)]
+    )
+    stats = [("i32", 8), None]
+    wp_q8 = gmod.wire_plan(list(plan), stats, quant=(None, "q8"))
+    wp_b16 = gmod.wire_plan(list(plan), stats, quant=(None, "qb16"))
+    wp_off = gmod.wire_plan(list(plan), stats, quant=None)
+    assert wp_q8 != wp_b16
+    assert wp_off is None or wp_off != wp_q8
+    assert hash(wp_q8) != hash(wp_b16)  # both usable as cache-key parts
+
+
+# ----------------------------------------------------------------------
+# 4. quantized spill tiers + skew relay
+# ----------------------------------------------------------------------
+
+def test_quantized_spill_tier_differential(ctx4):
+    rng = np.random.default_rng(50)
+    n = 4000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 64, n).astype(np.int32),
+        "v": (rng.normal(size=n) * 7).astype(np.float32),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+
+    def shuf():
+        return (
+            ct.Table.from_pandas(ctx4, df).shuffle(["k"]).to_pandas()
+            .sort_values("rid").reset_index(drop=True)
+        )
+
+    with qmod.disabled():
+        exact = shuf()
+    _tol(TOL)
+    os.environ["CYLON_TPU_SPILL_TIER"] = "1"
+    reset_trace()
+    got = shuf()
+    assert get_count("shuffle.spill.staged_rounds") >= 1
+    assert get_count("shuffle.quant.spill_bytes_saved") >= 1
+    assert (exact["rid"].values == got["rid"].values).all()
+    assert (exact["k"].values == got["k"].values).all()
+    ref = np.abs(exact["v"].values).max()
+    # two lossy crossings (wire + arena restage) stay under the budget
+    assert np.abs(exact["v"].values - got["v"].values).max() <= TOL * ref
+
+
+def test_arena_stores_uint8(ctx4):
+    """The spill arenas hold quantized BYTES, not floats — the ~4x
+    budget stretch the tier exists for."""
+    from cylon_tpu.parallel.spill import ShardArenaSink
+
+    sink = ShardArenaSink(
+        2,
+        [("k", np.dtype(np.int32), False), ("v", np.dtype(np.uint8), False)],
+        1,
+        quant={1: np.dtype(np.float32)},
+    )
+    v = np.array([1.0, -2.0, 0.5], np.float32)
+    sink.accept(None, [
+        [(np.array([1, 2, 3], np.int32), None), (v, None)],
+        [(np.array([4], np.int32), None), (np.array([9.0], np.float32), None)],
+    ], np.array([3, 1]))
+    assert sink.arenas[0]._bufs[1][0].dtype == np.uint8
+    back = sink.dequantized_columns(0)[1][0]
+    assert back.dtype == np.float32
+    assert np.abs(back - v).max() <= np.abs(v).max() / 252 + 1e-7
+    sink.close()
+
+
+def test_skew_relay_quantized(ctx8):
+    rng = np.random.default_rng(60)
+    n = 8000
+    k = np.where(
+        rng.random(n) < 0.95, 0, rng.integers(1, 128, n)
+    ).astype(np.int32)
+    df = pd.DataFrame({
+        "k": k,
+        "v": (rng.normal(size=n) * 3).astype(np.float32),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+
+    def shuf():
+        return (
+            ct.Table.from_pandas(ctx8, df).shuffle(["k"]).to_pandas()
+            .sort_values("rid").reset_index(drop=True)
+        )
+
+    with qmod.disabled():
+        exact = shuf()
+    _tol(TOL)
+    reset_trace()
+    got = shuf()
+    assert get_count("shuffle.skew_split") >= 1
+    assert get_count("shuffle.quant.relay_bytes_saved") >= 1
+    assert (exact["rid"].values == got["rid"].values).all()
+    ref = np.abs(exact["v"].values).max()
+    assert np.abs(exact["v"].values - got["v"].values).max() <= TOL * ref

@@ -808,6 +808,148 @@ def packing_round_once(seed) -> bool:
     return ok
 
 
+def quant_round_once(seed) -> bool:
+    """Quantized-wire oracle round (ISSUE 13): random tolerance tier
+    (q8 / qb16 / qf32 / off), dtype mix (f32 / f64 / f16 payloads beside
+    int/string keys), world size, keyspace selectivity and optional
+    forced spill tier — join, groupby-SUM and shuffle each checked
+    against the CYLON_TPU_NO_QUANT=1 exact oracle on identical inputs:
+    join/groupby keys, row identity and group identity must match
+    EXACTLY; float payload columns must sit within the per-column
+    relative error bound of the engaged tier (rows aligned by exact
+    integer row ids, never by the lossy payload)."""
+    from cylon_tpu.ops.quant import disabled as quant_off
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(32, MAX_N))
+    world = int(rng.choice([1, 2, 4, 8]))
+    keyspace = int(rng.integers(2, max(n // 2, 3)))
+    tol = float(rng.choice([1e-2, 5e-2, 5e-3, 1e-6, 0.0]))
+    pdt = str(rng.choice(["float32", "float64", "float16"]))
+    spill = int(rng.choice([0, 0, 1]))  # 1-in-3 rounds force tier 1
+    params = dict(seed=seed, profile="quant", n=n, world=world,
+                  keyspace=keyspace, tol=tol, payload=pdt, spill=spill)
+    ctx = ctx_for(world)
+    np_pdt = np.dtype(pdt)
+    ldf = pd.DataFrame({
+        "k": rng.integers(-keyspace, keyspace, n).astype(np.int32),
+        "v": (rng.normal(size=n) * 10).astype(np_pdt),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+    rdf = pd.DataFrame({
+        "rk": rng.integers(-keyspace, keyspace, max(n // 2, 1)).astype(np.int32),
+        "w": (rng.normal(size=max(n // 2, 1)) * 10).astype(np_pdt),
+        "sid": np.arange(max(n // 2, 1), dtype=np.int64),
+    })
+
+    def run_all():
+        lt = ct.Table.from_pandas(ctx, ldf)
+        rt = ct.Table.from_pandas(ctx, rdf)
+        join = lt.distributed_join(
+            rt, left_on=["k"], right_on=["rk"], how="inner"
+        ).to_pandas().sort_values(["rid", "sid"]).reset_index(drop=True)
+        gb = ct.Table.from_pandas(ctx, ldf).distributed_groupby(
+            ["k"], {"v": "sum"}
+        ).to_pandas().sort_values("k").reset_index(drop=True)
+        shuf = None
+        if world > 1:
+            shuf = ct.Table.from_pandas(ctx, ldf).shuffle(
+                ["k"]
+            ).to_pandas().sort_values("rid").reset_index(drop=True)
+        return join, gb, shuf
+
+    prev_tol = os.environ.get("CYLON_TPU_QUANT_TOL")
+    prev_tier = os.environ.get("CYLON_TPU_SPILL_TIER")
+    try:
+        with quant_off():
+            ej, eg, es = run_all()
+        if tol:
+            os.environ["CYLON_TPU_QUANT_TOL"] = str(tol)
+        if spill:
+            os.environ["CYLON_TPU_SPILL_TIER"] = str(spill)
+        gj, gg, gs = run_all()
+    finally:
+        for var, prev in (("CYLON_TPU_QUANT_TOL", prev_tol),
+                          ("CYLON_TPU_SPILL_TIER", prev_tier)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+
+    ok = True
+
+    def bound_for(val_tol):
+        # the engaged tier's END-TO-END bound (2 lossy crossings max)
+        return val_tol if val_tol else 0.0
+
+    def cmp_float(name, e, g, scale_ref):
+        nonlocal ok
+        # NaN passthrough is part of the codec contract (q8 reserves
+        # codes for NaN/±inf): the masks must MATCH exactly — comparing
+        # nan_to_num'd deltas would zero a NaN-vs-finite corruption
+        if not (np.isnan(e) == np.isnan(g)).all():
+            print(f"MISMATCH quant/{name} nan-mask params={params}",
+                  flush=True)
+            ok = False
+            return
+        fin = np.isfinite(e)
+        if not (fin == np.isfinite(g)).all() or not (
+            np.sign(e[~fin & ~np.isnan(e)])
+            == np.sign(g[~fin & ~np.isnan(g)])
+        ).all():
+            print(f"MISMATCH quant/{name} inf params={params}", flush=True)
+            ok = False
+            return
+        err = float(np.abs(e[fin] - g[fin]).max()) if fin.any() else 0.0
+        ref = float(np.abs(scale_ref[np.isfinite(scale_ref)]).max()) if (
+            np.isfinite(scale_ref).any()
+        ) else 1.0
+        ref = ref or 1.0
+        if err > bound_for(tol) * ref + 1e-12:
+            print(f"MISMATCH quant/{name} err={err} ref={ref} "
+                  f"params={params}", flush=True)
+            ok = False
+
+    # join: exact identity on keys/ids, bounded payload error
+    if len(ej) != len(gj) or not (
+        (ej["rid"].values == gj["rid"].values).all()
+        and (ej["sid"].values == gj["sid"].values).all()
+        and (ej["k"].values == gj["k"].values).all()
+    ):
+        print(f"MISMATCH quant/join_identity params={params}", flush=True)
+        ok = False
+    else:
+        for c in ("v", "w"):
+            cmp_float(f"join.{c}", ej[c].values.astype(np.float64),
+                      gj[c].values.astype(np.float64),
+                      ej[c].values.astype(np.float64))
+    # groupby-SUM: exact group identity, error budget scales with the
+    # summed magnitudes (per-value errors accumulate across a group)
+    if not (eg["k"].values == gg["k"].values).all():
+        print(f"MISMATCH quant/group_identity params={params}", flush=True)
+        ok = False
+    else:
+        e = eg["v_sum"].values.astype(np.float64)
+        g = gg["v_sum"].values.astype(np.float64)
+        budget = bound_for(tol) * float(
+            np.abs(ldf["v"].values.astype(np.float64)).sum()
+        )
+        if float(np.abs(e - g).max()) > budget + 1e-9:
+            print(f"MISMATCH quant/groupby params={params}", flush=True)
+            ok = False
+    # shuffle: pure routing — rid identity exact, payload bounded
+    if es is not None:
+        if not (es["rid"].values == gs["rid"].values).all():
+            print(f"MISMATCH quant/shuffle_identity params={params}",
+                  flush=True)
+            ok = False
+        else:
+            cmp_float("shuffle.v", es["v"].values.astype(np.float64),
+                      gs["v"].values.astype(np.float64),
+                      es["v"].values.astype(np.float64))
+    return ok
+
+
 def serve_round_once(seed) -> bool:
     """Serving-batch oracle round (ISSUE 9): a random set of
     same-fingerprint parameter bindings (random per-binding sizes, shared
@@ -1072,7 +1214,7 @@ def main():
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
                              "ordering", "semi", "packing", "serve",
-                             "spill", "autotune"],
+                             "spill", "autotune", "quant"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -1093,7 +1235,12 @@ def main():
                          "K/skew/dtype) vs the in-core tier-0 oracle; "
                          "'autotune': cold- and warm-store runs of random "
                          "shapes/selectivities/worlds (+ store reload) vs "
-                         "the CYLON_TPU_NO_AUTOTUNE=1 static oracle")
+                         "the CYLON_TPU_NO_AUTOTUNE=1 static oracle; "
+                         "'quant': lossy-wire-tier rounds (random "
+                         "tolerance/dtype-mix/world/selectivity/spill "
+                         "tier) vs the CYLON_TPU_NO_QUANT=1 exact oracle "
+                         "— exact key/group identity, per-column error "
+                         "bounds on float payloads")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -1104,7 +1251,8 @@ def main():
           "packing": packing_round_once,
           "serve": serve_round_once,
           "spill": spill_round_once,
-          "autotune": autotune_round_once}.get(args.profile, round_once)
+          "autotune": autotune_round_once,
+          "quant": quant_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0

@@ -1,0 +1,182 @@
+"""quant-smoke: the CI quantized-wire-tier gate (ISSUE 13).
+
+Runs on the 8-virtual-device CPU mesh, in one process:
+
+1. COLL-MB   — the f32-payload ``dist_inner_join`` shape (the BENCH row
+   that DECLINES bit-lossless lane packing because its float payload
+   dominates the wire) at ``CYLON_TPU_QUANT_TOL=1e-2``: the quantized
+   run must ship >= 30%% fewer traced collective bytes
+   (``shuffle.exchanged_bytes``) than the exact-wire oracle, with the
+   ``shuffle.quant.applied`` gate engaged on both shuffled sides.
+2. ERROR     — exact join identity (row count, key columns, integer row
+   ids) against the ``CYLON_TPU_NO_QUANT=1`` oracle, and per-value
+   relative error on every float payload column within the tolerance.
+3. EXACT OFF — with the tolerance unset (and again under the kill
+   switch), results are BIT-identical to the oracle and the quant gate
+   never engages: the lossy tier adds nothing when off.
+4. SPILL     — the same shape forced through tier 1 under the
+   tolerance: the staged rounds cross as q8 bytes
+   (``shuffle.quant.spill_bytes_saved`` engaged) and the doubled-
+   crossing result still meets the tolerance.
+
+Usage: python tools/quant_smoke.py [--rows 40000] [--world 8]
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+TOL = 1e-2
+MIN_COLL_SAVING = 0.30
+
+
+def _fail(msg: str) -> None:
+    print(f"QUANT SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--world", type=int, default=8)
+    args = ap.parse_args()
+
+    devices = ge._force_cpu_mesh(args.world)
+
+    import numpy as np
+    import pandas as pd
+
+    import cylon_tpu as ct
+    from cylon_tpu.utils.tracing import get_count, report, reset_trace
+
+    def get_rows(name: str) -> int:
+        return int(report().get(name, {}).get("rows", 0))
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[: args.world])
+    )
+    rng = np.random.default_rng(13)
+    n = args.rows
+    # the BENCH dist_inner_join shape: narrow int keys, DOMINANT f32
+    # payload (3 payload columns per side) — the row where bit-lossless
+    # narrowing declines and the lossy tier is the only lever
+    ldf = pd.DataFrame({
+        "k": rng.integers(0, n // 10, n).astype(np.int32),
+        "rid": np.arange(n, dtype=np.int64),
+    })
+    for i in range(3):
+        ldf[f"v{i}"] = (rng.normal(size=n) * 10).astype(np.float32)
+    rdf = pd.DataFrame({
+        "rk": rng.integers(0, n // 10, n // 2).astype(np.int32),
+        "sid": np.arange(n // 2, dtype=np.int64),
+    })
+    for i in range(3):
+        rdf[f"w{i}"] = (rng.normal(size=n // 2) * 10).astype(np.float32)
+
+    def run_join():
+        lt = ct.Table.from_pandas(ctx, ldf)
+        rt = ct.Table.from_pandas(ctx, rdf)
+        out = lt.distributed_join(
+            rt, left_on=["k"], right_on=["rk"], how="inner"
+        ).to_pandas()
+        return out.sort_values(["rid", "sid"]).reset_index(drop=True)
+
+    float_cols = [f"v{i}" for i in range(3)] + [f"w{i}" for i in range(3)]
+
+    # ---- oracle: exact wire ------------------------------------------
+    os.environ["CYLON_TPU_NO_QUANT"] = "1"
+    reset_trace()
+    exact = run_join()
+    coll_exact = get_rows("shuffle.exchanged_bytes")
+    if get_count("shuffle.quant.applied"):
+        _fail("quant gate engaged under the kill switch")
+
+    # ---- tolerance unset: byte-identical, gate off -------------------
+    os.environ.pop("CYLON_TPU_NO_QUANT")
+    reset_trace()
+    off = run_join()
+    if get_count("shuffle.quant.applied"):
+        _fail("quant gate engaged with the tolerance unset")
+    for c in exact.columns:
+        if not (exact[c].values == off[c].values).all():
+            _fail(f"tolerance-unset run differs from the oracle on {c!r}")
+    print(f"exact-off: bit-identical, gate disengaged (coll bytes "
+          f"{coll_exact/1e6:.2f} MB)")
+
+    # ---- quantized: coll-MB + error gates ----------------------------
+    os.environ["CYLON_TPU_QUANT_TOL"] = str(TOL)
+    try:
+        reset_trace()
+        got = run_join()
+        coll_q = get_rows("shuffle.exchanged_bytes")
+        applied = get_count("shuffle.quant.applied")
+    finally:
+        os.environ.pop("CYLON_TPU_QUANT_TOL")
+    if applied < 2:
+        _fail(f"quant gate engaged on {applied}/2 shuffled sides")
+    saving = 1.0 - coll_q / max(coll_exact, 1)
+    print(f"quantized: coll bytes {coll_q/1e6:.2f} MB vs "
+          f"{coll_exact/1e6:.2f} MB exact -> {saving:.1%} saved")
+    if saving < MIN_COLL_SAVING:
+        _fail(
+            f"collective-byte saving {saving:.1%} under the "
+            f"{MIN_COLL_SAVING:.0%} gate"
+        )
+    if len(got) != len(exact):
+        _fail(f"row count drifted: {len(got)} vs {len(exact)}")
+    for c in ("k", "rid", "sid"):
+        if not (exact[c].values == got[c].values).all():
+            _fail(f"key/id column {c!r} not exact under quantization")
+    worst = 0.0
+    for c in float_cols:
+        ref = float(np.abs(exact[c].values).max()) or 1.0
+        rel = float(np.abs(exact[c].values - got[c].values).max()) / ref
+        worst = max(worst, rel)
+        if rel > TOL:
+            _fail(f"column {c!r} rel err {rel:.2e} over tol {TOL}")
+    print(f"error: worst per-value rel err {worst:.2e} <= {TOL}")
+
+    # ---- quantized spill tier ----------------------------------------
+    os.environ["CYLON_TPU_QUANT_TOL"] = str(TOL)
+    os.environ["CYLON_TPU_SPILL_TIER"] = "1"
+    try:
+        reset_trace()
+        spilled = run_join()
+        staged = get_count("shuffle.spill.staged_rounds")
+        qsaved = get_count("shuffle.quant.spill_bytes_saved")
+        qsaved_rows = get_rows("shuffle.quant.spill_bytes_saved")
+    finally:
+        os.environ.pop("CYLON_TPU_QUANT_TOL")
+        os.environ.pop("CYLON_TPU_SPILL_TIER")
+    if staged < 1 or qsaved < 1:
+        _fail(
+            f"quantized spill staging never engaged "
+            f"(staged={staged}, quant-staged={qsaved})"
+        )
+    for c in ("k", "rid", "sid"):
+        if not (exact[c].values == spilled[c].values).all():
+            _fail(f"key/id column {c!r} not exact through quantized spill")
+    for c in float_cols:
+        ref = float(np.abs(exact[c].values).max()) or 1.0
+        rel = float(np.abs(exact[c].values - spilled[c].values).max()) / ref
+        if rel > TOL:
+            _fail(
+                f"spilled column {c!r} rel err {rel:.2e} over tol {TOL} "
+                "(two lossy crossings must fit the budget)"
+            )
+    print(f"spill: staged quantized rounds ok "
+          f"({qsaved_rows/1e6:.2f} MB arena bytes saved)")
+
+    print("QUANT SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()
