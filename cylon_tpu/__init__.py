@@ -74,6 +74,15 @@ from .join_config import JoinAlgorithm, JoinConfig  # noqa: E402
 from . import obs  # noqa: E402
 from . import plan  # noqa: E402
 from .plan import LazyFrame, col, lit  # noqa: E402
+from . import fault  # noqa: E402
+from .fault import (  # noqa: E402
+    CylonError,
+    QueryExecError,
+    QueryTimeoutError,
+    SchedulerClosedError,
+    SpillIOError,
+    WorkerDiedError,
+)
 from . import serve  # noqa: E402
 from .serve import QueryFuture, ServeOverloadError  # noqa: E402
 from .indexing.index import (  # noqa: E402
@@ -121,8 +130,15 @@ __all__ = [
     "LocalConfig",
     "MPIConfig",
     "TPUConfig",
+    "CylonError",
+    "QueryExecError",
     "QueryFuture",
+    "QueryTimeoutError",
+    "SchedulerClosedError",
     "ServeOverloadError",
+    "SpillIOError",
+    "WorkerDiedError",
+    "fault",
     "serve",
     "Table",
     "concat",

@@ -159,7 +159,13 @@ SKEW_RELAY_HOST_SYNCS = 1
 #: count INPUT (applies the pending overshoot compaction before the pack
 #: kernels specialize on the capacity; see docs/ARCHITECTURE.md "Static
 #: invariants")
-SHUFFLE_SYNC_SITES = ("_shuffle_many", "_materialize_counts")
+SHUFFLE_SYNC_SITES = (
+    "_shuffle_many",
+    "_shuffle_many_rounds",  # phase 2 (the round loop + deferred fetch),
+    # split out so the failure-domain wrapper in _shuffle_many can close
+    # spill sinks and type errors without a 300-line try block
+    "_materialize_counts",
+)
 
 
 # ----------------------------------------------------------------------
@@ -296,6 +302,20 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     "QueryFuture.result": SyncBudget(
         1, note="THE per-query sync point: blocks on fulfillment, then "
         "forces the deferred count fetch in the caller's thread",
+    ),
+    # the fault-injection seams (ISSUE 14): a seam hook can raise, count
+    # and read env — it can NEVER touch the device. `check` itself is a
+    # REBOUND module attribute (no-op <-> armed), so the budgets pin the
+    # two concrete hook functions it can resolve to; this is what
+    # "graft-lint keeps every seam DISPATCH_SAFE" means mechanically: a
+    # future edit that fetches inside either hook (or anything it
+    # calls) fails CI with the call path.
+    "inject._check_armed": SyncBudget(
+        0, note="armed seam hook: seeded RNG draw + counter + typed "
+        "raise, pure host",
+    ),
+    "inject._check_noop": SyncBudget(
+        0, note="disabled seam hook: a bare return",
     ),
     # amortized machinery: paid once, cached
     "Table._materialize_counts": SyncBudget(

@@ -87,6 +87,13 @@ class CylonContext:
         from .obs.export import ensure_ops_server
 
         ensure_ops_server()
+        # reclaim spill directories orphaned by dead processes (pid-
+        # stamped by HostArena._ensure_dir; age-guarded; never raises) —
+        # the spill-volume analog of the obs store's dead-writer journal
+        # reaping, at the same lifecycle point
+        from .parallel.spill import reap_stale_spill
+
+        reap_stale_spill()
 
     # -- factory ------------------------------------------------------------
     @classmethod

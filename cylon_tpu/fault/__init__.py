@@ -1,0 +1,28 @@
+"""Chaos-certified execution: typed failure domains + deterministic
+fault injection (see ``errors.py`` for the taxonomy and ``inject.py``
+for the seam registry / ``CYLON_TPU_FAULTS`` grammar)."""
+from .errors import (  # noqa: F401
+    SCOPE_CONTEXT,
+    SCOPE_QUERY,
+    SCOPE_TABLE,
+    CylonError,
+    QueryExecError,
+    QueryTimeoutError,
+    SchedulerClosedError,
+    SpillIOError,
+    WorkerDiedError,
+)
+from . import inject  # noqa: F401
+from .inject import (  # noqa: F401
+    SEAMS,
+    FaultSpecError,
+    active,
+    fired,
+    parse_spec,
+    refresh,
+    reset,
+)
+
+# NOTE: inject.check is deliberately NOT re-exported by value — refresh()
+# REBINDS it (no-op <-> armed), so seam sites and tools must reach it
+# through the module attribute: ``fault.inject.check(...)``.

@@ -1,0 +1,117 @@
+"""The typed error taxonomy: every failure the engine can SURFACE.
+
+Thirteen PRs of happy path left failure handling untyped: a stacked-batch
+exception poisoned all B futures with whatever Python raised, a
+disk-full tier-2 memmap was a bare ``OSError`` three layers up, and a
+caller could not tell "this one query is lost" from "the process is
+compromised". This module is the contract the degradation machinery
+(serve fallback, spill retry ladder, worker supervision) fails THROUGH:
+
+``CylonError``
+    Base of every engine-raised failure. Two classification axes:
+
+    - ``scope`` — what the failure poisons: ``"query"`` (this one query
+      failed; the context, its caches, tables, scheduler, and every
+      other in-flight query are untouched), ``"table"`` (one table's
+      buffers are suspect), ``"context"`` (the owning component is done
+      — e.g. a closed scheduler).
+    - ``retryable`` — resubmitting the SAME work may succeed (the cause
+      was load or transient I/O, not the query itself).
+
+THE INVARIANT every error path in the engine must uphold (mechanically
+exercised by ``tools/chaos_smoke.py``): a failure ends in exactly one of
+{oracle-identical result, typed CylonError} — with every admission
+lease, host arena, and ledger entry released — and never kills the
+process or strands a future.
+
+Kept dependency-free (no engine imports) so ``serve/``, ``parallel/``
+and ``obs/`` can all raise through it without cycles. Pre-existing
+public error types keep their old bases for compatibility:
+``ServeOverloadError`` (serve/future.py) and ``Unbatchable``
+(serve/batch.py) are re-parented onto this hierarchy, and the scheduler
+errors double as ``RuntimeError``/``TimeoutError`` where callers
+historically caught those.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+#: the scope axis: what a failure poisons
+SCOPE_QUERY = "query"
+SCOPE_TABLE = "table"
+SCOPE_CONTEXT = "context"
+SCOPES = (SCOPE_QUERY, SCOPE_TABLE, SCOPE_CONTEXT)
+
+
+class CylonError(Exception):
+    """Base of every typed engine failure (see module docstring for the
+    ``scope`` / ``retryable`` axes)."""
+
+    #: resubmitting the same work may succeed
+    retryable: bool = False
+    #: what this failure poisons: query | table | context
+    scope: str = SCOPE_QUERY
+
+
+class SpillIOError(CylonError, OSError):
+    """Spill-tier I/O failed past the whole degradation ladder: the
+    bounded-backoff retries (``CYLON_TPU_SPILL_RETRIES``) were exhausted
+    AND the disk arenas could not re-plan onto the host-RAM tier (host
+    budget exceeded, or the degradation copy itself failed). Fails ONLY
+    the owning query — its sink arenas are closed, its lease released —
+    never the process. ``retryable``: the spill volume may recover."""
+
+    retryable = True
+    scope = SCOPE_QUERY
+
+    def __init__(self, what: str = "spill I/O failed",
+                 cause: Optional[BaseException] = None):
+        super().__init__(what if cause is None else f"{what}: {cause}")
+        self.what = what
+
+
+class QueryExecError(CylonError):
+    """One query's execution failed. Carries the plan ``fingerprint``
+    (the shape identity — what a quarantine or a dashboard keys on) and
+    the ``binding`` label of the failed parameter binding, so a batched
+    group's fallback can report WHICH of the B bindings was poisoned."""
+
+    retryable = False
+    scope = SCOPE_QUERY
+
+    def __init__(self, message: str, fingerprint=None,
+                 binding: Optional[str] = None):
+        super().__init__(message)
+        self.fingerprint = fingerprint
+        self.binding = binding
+
+
+class QueryTimeoutError(CylonError, TimeoutError):
+    """The query exceeded ``CYLON_TPU_SERVE_DEADLINE_MS`` from submit:
+    its future is FAILED (not left hanging) and its admission lease
+    released. ``retryable``: the same query may well fit the deadline on
+    a less loaded scheduler."""
+
+    retryable = True
+    scope = SCOPE_QUERY
+
+
+class WorkerDiedError(CylonError):
+    """The serving worker thread died while this query was in flight.
+    The supervisor fails the in-flight group with this error, releases
+    the leases, and respawns the worker on the next submit — queued work
+    and new submits proceed; only the group the dying worker held is
+    lost (resubmit it)."""
+
+    retryable = True
+    scope = SCOPE_QUERY
+
+
+class SchedulerClosedError(CylonError, RuntimeError):
+    """The serving scheduler was closed with this query still pending
+    (or a submit raced ``close()``). ``scope="context"``: this scheduler
+    is done — resubmit against a fresh one (``serve.scheduler(ctx)``
+    replaces a closed scheduler on next use)."""
+
+    retryable = True
+    scope = SCOPE_CONTEXT

@@ -1,0 +1,333 @@
+"""Deterministic, seeded fault injection: named seams on the engine's
+failure-prone host paths, armed from ``CYLON_TPU_FAULTS``.
+
+Failure handling that cannot be EXERCISED is decoration — Exoshuffle's
+production-trust argument (PAPERS.md 2203.05072) is precisely that the
+failure paths must be externally drivable parts of the architecture.
+Every degradation mechanism this PR ships (spill retry ladder, batched-
+serving fallback, worker supervision, journal degrade) is exercised
+through a seam here, by CI (``tools/chaos_smoke.py``) and the chaos fuzz
+profile, with a SEEDED RNG so a failing campaign replays exactly.
+
+SEAMS (the catalog; ``check(seam)`` sites in the engine):
+
+========================  ==============================================
+``spill.write``           arena append path (fires only while the arena
+                          holds/targets disk-backed buffers — RAM writes
+                          cannot ENOSPC, and the tier-degradation escape
+                          must genuinely escape)
+``spill.read``            arena read-back at result rebuild (disk-backed
+                          only, same rule)
+``arena.alloc``           host/disk arena buffer allocation
+``serve.batch_exec``      the stacked B-binding batch program
+``serve.single_exec``     one binding's single execution
+``serve.worker``          the scheduler worker loop (thread death)
+``obs.journal``           the observation-store journal append
+========================  ==============================================
+
+SPEC GRAMMAR — comma-separated seam clauses, ``:``-separated fields::
+
+    CYLON_TPU_FAULTS="spill.write:p=0.05:kind=ENOSPC,serve.worker:n=1"
+
+    p=<float>     injection probability per check (default 1.0)
+    kind=<name>   ENOSPC | EIO | ENOMEM (OSError with that errno; the
+                  only kinds valid on the I/O seams — their sites sit
+                  inside `except OSError` degradation ladders), or
+                  exec | timeout | die (typed CylonError family;
+                  serve.* seams only); default per seam (spill/arena/
+                  obs -> the natural errno, serve.* -> exec,
+                  serve.worker -> die)
+    n=<int>       total injection cap (default unlimited)
+    seed=<int>    RNG seed for this seam's draw sequence (default 0)
+    match=<str>   inject only when the check's ``key`` contains this
+                  substring (digit-bounded: a match ending in digits
+                  never continues into more digits, so ``#q2`` does NOT
+                  fire on ``#q20``). The serve seams key PER BINDING as
+                  ``<PlanRoot>#q<admission-seq>`` (the batch seam's key
+                  joins its whole group's), so ``match=#q3`` poisons
+                  exactly the scheduler's fourth admitted query —
+                  through batch formation AND the single fallback
+
+DETERMINISM: each armed seam draws from ``random.Random(f"{seed}:{seam}")``
+— the k-th check of a seam injects or not as a pure function of
+(seed, seam, k), so a campaign is replayable from its spec alone.
+
+DISABLED COST: :func:`check` is COMPILED TO A MODULE-LEVEL NO-OP when
+nothing is armed — every call site reaches it through the module
+attribute (``_fault.check(...)``), so disabling rebinds one name and
+the per-hook cost is a bare function call (``tools/chaos_smoke.py``
+pins it under 2% of a serving wall at a generous hooks-per-query
+budget, the same calibration discipline as ``tools/trace_smoke.py``'s
+tracer pin). The env is read ONCE, at import — an in-process
+``CYLON_TPU_FAULTS`` flip takes effect at the next explicit
+:func:`refresh` / :func:`reset` (the chaos harness, fuzz profile and
+tests all re-arm that way; a per-check env read costs ~0.7 us on CI
+boxes, two orders past the budget).
+
+graft-lint: ``CYLON_TPU_FAULTS`` is a declared observability knob
+(host-only reads), ``fault.inject.check`` holds a 0-site sync budget
+(a seam can never touch the device), and all registry mutation is
+lock-dominated.
+"""
+from __future__ import annotations
+
+import errno
+import random
+import re
+import threading
+from typing import Dict, Optional
+
+from ..utils import envgate as _eg
+from .errors import (
+    QueryExecError,
+    QueryTimeoutError,
+    WorkerDiedError,
+)
+
+#: the seam catalog (docs + chaos_smoke enumerate this; check() accepts
+#: only these names so a typo'd seam fails loudly in tests, not silently
+#: in production)
+SEAMS = (
+    "spill.write",
+    "spill.read",
+    "arena.alloc",
+    "serve.batch_exec",
+    "serve.single_exec",
+    "serve.worker",
+    "obs.journal",
+)
+
+#: seams whose check() sites pass a key (a binding label) — the only
+#: ones a ``match=`` clause can ever select on
+_KEYED_SEAMS = frozenset({"serve.batch_exec", "serve.single_exec"})
+
+_ERRNO_KINDS = {
+    "ENOSPC": errno.ENOSPC,
+    "EIO": errno.EIO,
+    "ENOMEM": errno.ENOMEM,
+}
+
+#: default fault kind per seam: the failure that path sees in the wild
+_DEFAULT_KIND = {
+    "spill.write": "ENOSPC",
+    "spill.read": "EIO",
+    "arena.alloc": "ENOSPC",
+    "serve.batch_exec": "exec",
+    "serve.single_exec": "exec",
+    "serve.worker": "die",
+    "obs.journal": "EIO",
+}
+
+
+class FaultSpec:
+    """One armed seam's parsed clause + its deterministic draw state."""
+
+    __slots__ = ("seam", "p", "kind", "n", "seed", "match", "match_re",
+                 "rng", "draws", "fired")
+
+    def __init__(self, seam: str, p: float, kind: str, n: Optional[int],
+                 seed: int, match: Optional[str]):
+        self.seam = seam
+        self.p = p
+        self.kind = kind
+        self.n = n
+        self.seed = seed
+        self.match = match
+        # substring match with a digit-boundary guard: a match ending in
+        # digits must not continue into more digits in the key, or
+        # ``match=#q2`` would also poison admission seqs 20-29, 200-299…
+        # — silently breaking the 'exactly one binding' contract on any
+        # campaign past 10 admissions
+        self.match_re = (
+            None if match is None
+            else re.compile(re.escape(match) + r"(?!\d)")
+        )
+        # str seeds hash via sha512 — deterministic across processes
+        # (a tuple seed would ride PYTHONHASHSEED and is deprecated)
+        self.rng = random.Random(f"{seed}:{seam}")
+        self.draws = 0
+        self.fired = 0
+
+
+class _Plan:
+    __slots__ = ("raw", "specs")
+
+    def __init__(self, raw: str, specs: Dict[str, FaultSpec]):
+        self.raw = raw
+        self.specs = specs
+
+
+_lock = threading.Lock()
+_PLAN = _Plan("", {})
+
+
+class FaultSpecError(ValueError):
+    """CYLON_TPU_FAULTS failed to parse — misarmed chaos must fail the
+    campaign loudly, not silently run fault-free."""
+
+
+def parse_spec(raw: str) -> Dict[str, FaultSpec]:
+    """Parse one CYLON_TPU_FAULTS value into {seam: FaultSpec}."""
+    specs: Dict[str, FaultSpec] = {}
+    for clause in raw.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        seam = parts[0].strip()
+        if seam not in SEAMS:
+            raise FaultSpecError(
+                f"unknown fault seam {seam!r} (seams: {', '.join(SEAMS)})"
+            )
+        p, kind, n, seed, match = 1.0, _DEFAULT_KIND[seam], None, 0, None
+        for f in parts[1:]:
+            if "=" not in f:
+                raise FaultSpecError(f"bad fault field {f!r} in {clause!r}")
+            k, v = f.split("=", 1)
+            k = k.strip()
+            try:
+                if k == "p":
+                    p = float(v)
+                elif k == "kind":
+                    kind = v.strip()
+                elif k == "n":
+                    n = int(v)
+                elif k == "seed":
+                    seed = int(v)
+                elif k == "match":
+                    match = v
+                else:
+                    raise FaultSpecError(
+                        f"unknown fault field {k!r} in {clause!r}"
+                    )
+            except ValueError as e:
+                if isinstance(e, FaultSpecError):
+                    raise
+                raise FaultSpecError(
+                    f"bad value for {k!r} in {clause!r}: {v!r}"
+                ) from e
+        if kind not in _ERRNO_KINDS and kind not in ("exec", "timeout", "die"):
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} in {clause!r}"
+            )
+        if kind not in _ERRNO_KINDS and not seam.startswith("serve."):
+            # the I/O seams sit inside `except OSError` degradation
+            # ladders (spill retry, journal degrade): a typed
+            # CylonError kind there would ESCAPE the ladder and fail
+            # queries the contract says must survive — reject the spec
+            # instead of silently breaking the invariant
+            raise FaultSpecError(
+                f"kind {kind!r} is not valid for seam {seam!r}: "
+                "I/O seams take errno kinds (ENOSPC/EIO/ENOMEM) only"
+            )
+        if match is not None and seam not in _KEYED_SEAMS:
+            # keyless seams never pass a key to check(), so a match
+            # clause there can NEVER fire — a campaign that reports
+            # itself armed while running fault-free proves nothing;
+            # reject the spec instead (the kind-restriction's twin)
+            raise FaultSpecError(
+                f"match= is not valid for seam {seam!r}: only keyed "
+                f"seams ({', '.join(sorted(_KEYED_SEAMS))}) pass a key"
+            )
+        if not (0.0 <= p <= 1.0):
+            raise FaultSpecError(f"p={p} out of [0,1] in {clause!r}")
+        specs[seam] = FaultSpec(seam, p, kind, n, seed, match)
+    return specs
+
+
+def active() -> bool:
+    """Any seam armed (as of the last import/refresh)?"""
+    return bool(_PLAN.specs)
+
+
+def _exception(spec: FaultSpec, key: Optional[str]) -> BaseException:
+    at = f"injected at seam {spec.seam}" + (f" key={key}" if key else "")
+    kind = spec.kind
+    if kind in _ERRNO_KINDS:
+        return OSError(_ERRNO_KINDS[kind], f"{kind} {at} (fault injection)")
+    if kind == "timeout":
+        return QueryTimeoutError(f"timeout {at} (fault injection)")
+    if kind == "die":
+        return WorkerDiedError(f"worker death {at} (fault injection)")
+    return QueryExecError(f"exec failure {at} (fault injection)",
+                          binding=key)
+
+
+def _check_noop(seam: str, key: Optional[str] = None) -> None:
+    """The disabled hook: what every seam site pays in production.
+    ``check`` IS this function until :func:`refresh` arms a spec."""
+    return None
+
+
+_SEAM_SET = frozenset(SEAMS)
+
+
+def _check_armed(seam: str, key: Optional[str] = None) -> None:
+    """The armed hook: the seam's seeded RNG decides whether THIS check
+    injects — raising the armed fault kind (an ``OSError`` with the
+    armed errno, or the typed CylonError family). ``key`` carries site
+    context (a binding label) for ``match=`` targeting.
+
+    Never touches the device (graft-lint budget: 0 sync sites)."""
+    spec = _PLAN.specs.get(seam)
+    if spec is None:
+        # a typo'd SITE name must fail loudly under an armed campaign —
+        # an unarmable seam silently proves nothing (spec-side names are
+        # validated by parse_spec; this is the site-side twin)
+        if seam not in _SEAM_SET:
+            raise FaultSpecError(
+                f"check() called with unknown seam {seam!r} "
+                f"(seams: {', '.join(SEAMS)})"
+            )
+        return
+    if spec.match is not None and (
+        key is None or spec.match_re.search(str(key)) is None
+    ):
+        return
+    with _lock:
+        if spec.n is not None and spec.fired >= spec.n:
+            return
+        spec.draws += 1
+        if spec.p < 1.0 and spec.rng.random() >= spec.p:
+            return
+        spec.fired += 1
+    # counter bump via obs.metrics directly (lazy: utils.tracing routes
+    # through obs.trace -> obs.store, which itself holds a seam — the
+    # metrics rollup is the cycle-free primitive underneath)
+    from ..obs import metrics as _metrics
+
+    _metrics.rollup_count(f"fault.injected.{seam}")
+    raise _exception(spec, key)
+
+
+def refresh() -> bool:
+    """Re-read ``CYLON_TPU_FAULTS``, rebuild the plan with FRESH draw
+    state, and swap the module-level ``check`` hook (no-op when nothing
+    is armed). Returns whether any seam is now armed. Raises
+    :class:`FaultSpecError` on a malformed spec — misarmed chaos fails
+    loudly, never runs silently fault-free."""
+    global _PLAN, check
+    raw = _eg.FAULTS.get()
+    specs = parse_spec(raw)
+    with _lock:
+        _PLAN = _Plan(raw, specs)
+        check = _check_armed if specs else _check_noop
+    return bool(specs)
+
+
+#: alias with the semantics tests want by name: re-arm from the current
+#: env with fresh draw counters / RNG streams
+reset = refresh
+
+#: the live hook (rebound by refresh); arm at import so a process
+#: STARTED with CYLON_TPU_FAULTS set is armed with no further calls
+check = _check_noop
+refresh()
+
+
+def fired(seam: str) -> int:
+    """How many injections ``seam`` has delivered since the last
+    refresh (tests + chaos_smoke assert the campaign actually exercised
+    the seam — a chaos run whose fault never fired proves nothing)."""
+    spec = _PLAN.specs.get(seam)
+    return 0 if spec is None else spec.fired

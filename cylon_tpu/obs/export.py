@@ -334,6 +334,9 @@ def prometheus_text() -> str:
             "device_peak_bytes": sum(s["device_peak"] for s in snaps),
             "live_tables": sum(s["live_tables"] for s in snaps),
             "serve_lease_bytes": sum(s["serve_lease_bytes"] for s in snaps),
+            "serve_lease_count": sum(
+                s.get("serve_lease_count", 0) for s in snaps
+            ),
             "host_bytes": snaps[0]["host_bytes"],
             "host_peak_bytes": snaps[0]["host_peak"],
             "disk_bytes": snaps[0]["disk_bytes"],

@@ -315,7 +315,10 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "mixed", "spill tiers (parallel/spill.py): tier/peak_device_bytes/"
         "host_bytes/disk_bytes gauges; shuffles/staged_rounds/"
         "staged_bytes/relay_bytes/tier2_promotions/ooc_joins counters; "
-        "stage/ooc_* spans"),
+        "stage/ooc_* spans; I/O degradation ladder (ISSUE 14): "
+        "io_retries / tier_degraded (disk arenas re-planned onto host "
+        "RAM) / io_failures (ladder exhausted -> typed SpillIOError) / "
+        "reaped_dirs (dead-pid spill dirs reclaimed at context init)"),
     "shuffle.semi_filter.": (
         "mixed", "semi-join gate: selectivity gauge, applied/gate_skipped/"
         "pruned_rows counters, sketch span"),
@@ -343,9 +346,19 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "cached executor entry: flat across cached collects)"),
     "serve.": (
         "mixed", "query serving (cylon_tpu/serve): queue_depth / "
-        "inflight_bytes / batch_occupancy gauges; submitted / completed / "
-        "backpressure.wait / budget_overflow / batches / singles "
-        "counters; batch_cache.hit/miss; serve.stack span"),
+        "inflight_bytes / leases / batch_occupancy gauges; submitted / "
+        "completed / backpressure.wait / budget_overflow / batches / "
+        "singles counters; batch_cache.hit/miss; serve.stack span; "
+        "degradation counters (ISSUE 14): batch_fallback (stacked-batch "
+        "failure fell back to per-binding singles), batch_quarantined "
+        "(group formed as a single under the poisoned-shape cooldown), "
+        "worker_died / worker_respawn (supervision), close_orphans "
+        "(queries failed typed by close())"),
+    "serve.errors": (
+        "counter", "typed query failures (one per future failed with a "
+        "CylonError; split by scope under serve.errors.<scope>) — the "
+        "error-rate SLO rule's substrate"),
+    "serve.errors.": ("counter", "serve.errors split by failure scope"),
     "serve.shed.": (
         "counter", "admission sheds split by reason: admission_budget "
         "(a single estimate exceeds the in-flight budget — load), "
@@ -370,7 +383,13 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "also lands a kind='slo' record in the flight ring)"),
     "obs.": (
         "counter", "obs-layer internals: hist.evicted (bounded histogram "
-        "registry LRU evictions, rows=entries flushed)"),
+        "registry LRU evictions, rows=entries flushed); "
+        "journal_degraded (a journal write failed — the store flipped "
+        "to in-memory-only telemetry; queries unaffected)"),
+    "fault.injected.": (
+        "counter", "fault injections delivered per seam "
+        "(cylon_tpu/fault/inject.py; armed via CYLON_TPU_FAULTS — zero "
+        "in production)"),
     "overhead.": ("span", "trace_smoke calibration probes (tools only)"),
 }
 

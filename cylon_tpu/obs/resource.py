@@ -314,11 +314,14 @@ class ResourceLedger:
         gauge("ledger.live_tables", ntab)
         host, host_peak, disk, disk_peak = _spill.arena_bytes()
         lease = 0
+        lease_count = 0
         ctx = self._ctx_ref()
         if ctx is not None:
             sched = ctx.__dict__.get("_serve_sched")
             if sched is not None:
-                lease = sched.stats()["inflight_bytes"]
+                st = sched.stats()
+                lease = st["inflight_bytes"]
+                lease_count = st.get("leases", 0)
         return {
             "device_bytes": dev,
             "device_peak": peak,
@@ -328,6 +331,12 @@ class ResourceLedger:
             "disk_bytes": disk,
             "disk_peak": disk_peak,
             "serve_lease_bytes": lease,
+            # lease-LEAK accounting (ISSUE 14): the number of admitted-
+            # but-unreleased leases. The chaos harness asserts this
+            # returns to 0 after every fault campaign — a failure path
+            # that forgets to release shows up here, not as a slow
+            # admission-budget starvation in production
+            "serve_lease_count": lease_count,
         }
 
     def leaks(self, grace_s: Optional[float] = None) -> List[Dict[str, Any]]:

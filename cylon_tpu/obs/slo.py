@@ -29,6 +29,15 @@ prerequisite):
     autoscaler can fix — the reason-split shed counters exist exactly so
     this rule can tell a leak from load.
 
+``errors``
+    Windowed rate of typed query failures (``serve.errors`` — every
+    future the scheduler fails with a CylonError: execution failures,
+    spill-ladder exhaustion, worker deaths, deadline expiries). Any
+    error in the window is WARN; a sustained storm
+    (>= ``ERROR_BREACH_PER_S``/s) is BREACH — the signal ``/healthz``
+    flips on when the degradation machinery is failing queries faster
+    than retries can absorb (the ISSUE-14 error-rate rule).
+
 ``headroom``
     Live resource usage against the configured budgets: serving lease
     bytes vs ``CYLON_TPU_SERVE_INFLIGHT_BYTES``, host arena bytes vs
@@ -63,6 +72,9 @@ STATE_NAMES = {STATE_OK: "OK", STATE_WARN: "WARN", STATE_BREACH: "BREACH"}
 BREACH_RATIO = 2.0
 #: sustained shed rate (events/s over the window) that is BREACH
 SHED_BREACH_PER_S = 1.0
+#: sustained typed-query-failure rate (events/s over the window) that is
+#: BREACH (any failure in the window is already WARN)
+ERROR_BREACH_PER_S = 1.0
 #: budget-usage fractions for the headroom rule
 HEADROOM_WARN = 0.80
 HEADROOM_BREACH = 0.95
@@ -94,7 +106,7 @@ class SLOMonitor:
     def __init__(self, window: Optional[float] = None):
         self._window = window
         self._lock = threading.Lock()
-        # (t, load_sheds, leak_sheds, bucket_snapshot)
+        # (t, load_sheds, leak_sheds, query_errors, bucket_snapshot)
         self._samples: "deque" = deque()
         self._states: Dict[str, int] = {}
 
@@ -109,9 +121,10 @@ class SLOMonitor:
         now = time.monotonic()
         win = self._window_s()
         load, leak = _shed_counts()
+        errs = _metrics.get_count("serve.errors")
         buckets = _metrics.bucket_snapshot()
         with self._lock:
-            self._samples.append((now, load, leak, buckets))
+            self._samples.append((now, load, leak, errs, buckets))
             # retain exactly ONE sample at-or-older than the window edge:
             # it is the diff baseline; everything older is history
             while (
@@ -119,14 +132,15 @@ class SLOMonitor:
                 and self._samples[1][0] <= now - win
             ):
                 self._samples.popleft()
-            base_t, base_load, base_leak, base_buckets = self._samples[0]
+            (base_t, base_load, base_leak, base_errs,
+             base_buckets) = self._samples[0]
             # rate denominators clamp to the FULL window: a young
             # baseline (fresh process, two scrapes seconds apart) must
             # not turn one shed into a "sustained storm" BREACH — the
             # rule's semantics are events per window, not per gap
             dt = max(now - base_t, win)
             new_states = self._evaluate_rules(
-                load - base_load, leak - base_leak, dt,
+                load - base_load, leak - base_leak, errs - base_errs, dt,
                 buckets, base_buckets,
             )
             transitions = []
@@ -149,7 +163,7 @@ class SLOMonitor:
         return dict(new_states)
 
     def _evaluate_rules(
-        self, d_load: int, d_leak: int, dt: float,
+        self, d_load: int, d_leak: int, d_errs: int, dt: float,
         buckets: Dict, base_buckets: Dict,
     ) -> Dict[str, int]:
         states: Dict[str, int] = {}
@@ -164,6 +178,13 @@ class SLOMonitor:
             states["shed"] = STATE_WARN
         else:
             states["shed"] = STATE_BREACH
+        # -- typed-failure rate (the ISSUE-14 error-rate rule) ---------
+        if d_errs <= 0:
+            states["errors"] = STATE_OK
+        elif d_errs / dt < ERROR_BREACH_PER_S:
+            states["errors"] = STATE_WARN
+        else:
+            states["errors"] = STATE_BREACH
         # -- per-fingerprint p99 burn ----------------------------------
         from ..plan.feedback import p99_target_s
 

@@ -69,6 +69,7 @@ import threading
 from contextvars import ContextVar
 from typing import Any, Dict, List, Optional
 
+from ..fault import inject as _fault
 from ..utils import envgate as _eg
 
 #: journal records folded into the snapshot per compaction cycle; the
@@ -431,6 +432,13 @@ class ObsStore:
         self._jf = None
         self._jlines = 0
         self._since_flush = 0
+        #: journal write failed (disk full / readonly / fault seam): the
+        #: store DEGRADES to in-memory-only telemetry — profiles keep
+        #: absorbing and the feedback re-coster keeps deciding, we just
+        #: stop persisting. Never re-armed for this store's lifetime
+        #: (a flapping volume must not turn every query into a failed
+        #: syscall); a fresh process / reset_stores() retries.
+        self.journal_degraded = False
         self._rec_seq = 0   # own monotone journal record id (replay dedup)
         self._seq = 0
         self._jseqs: Dict[str, int] = {}
@@ -474,12 +482,22 @@ class ObsStore:
 
     def record(self, rec: Dict[str, Any]) -> None:
         """Absorb one observation record into its profile AND append it
-        to the journal; compacts past ``compact_every`` records."""
+        to the journal; compacts past ``compact_every`` records.
+
+        GRACEFUL DEGRADATION (the ``obs.journal`` fault seam exercises
+        this): a journal write failure — a full/readonly volume — must
+        never fail the query that produced the observation. The in-
+        memory absorb above already happened; the store flips to
+        in-memory-only mode (``journal_degraded``, counted once under
+        ``obs.journal_degraded``) and stops issuing writes."""
         with self._lock:
             self._rec_seq += 1
             rec.setdefault("i", self._rec_seq)
             self._absorb(rec)
+            if self.journal_degraded:
+                return
             try:
+                _fault.check("obs.journal")
                 jf = self._journal_file()
                 jf.write(json.dumps(rec, separators=(",", ":")) + "\n")
                 self._since_flush += 1
@@ -487,7 +505,13 @@ class ObsStore:
                     jf.flush()
                     self._since_flush = 0
             except OSError:
-                return  # a full/readonly volume must never fail a query
+                self.journal_degraded = True
+                # lazy: utils.tracing routes through obs.trace -> this
+                # module; the rollup primitive underneath is cycle-free
+                from .metrics import rollup_count
+
+                rollup_count("obs.journal_degraded")
+                return
             self._jlines += 1
             if self._jlines >= self.compact_every:
                 self.compact()

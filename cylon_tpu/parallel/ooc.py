@@ -49,6 +49,7 @@ import numpy as np
 from ..column import Column
 from ..dtypes import DataType, Type
 from ..engine import get_kernel
+from ..fault import errors as _flt
 from ..ops import partition as _p
 from ..table import Table, _ShuffleSpec, _shuffle_many
 from ..utils.tracing import bump, span
@@ -248,11 +249,20 @@ class HostSink:
     def result_pydict(self) -> Dict[str, np.ndarray]:
         if self._arena is None:
             return {}
-        return {
-            nm: col for nm, (col, _v) in zip(
-                self._names, self._arena.columns()
-            )
-        }
+        # the result read-back rides the spill retry ladder (ISSUE 14):
+        # a tier-2 EIO retries, then fails TYPED with the arena closed —
+        # never a raw OSError with leaked arena bytes
+        try:
+            cols = _spill._retry_io("ooc result read", self._arena.columns)
+        except _spill.SpillIOError:
+            self.close()
+            raise
+        return {nm: col for nm, (col, _v) in zip(self._names, cols)}
+
+    def close(self) -> None:
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
 
 
 class OutOfCoreJoin:
@@ -459,6 +469,18 @@ class OutOfCoreJoin:
             bump("shuffle.spill.ooc_joins")
             with span("shuffle.spill.ooc_join"):
                 self._join_buckets()
+        except BaseException as e:
+            # the failure-model invariant (cylon_tpu/fault): a failed
+            # out-of-core join releases its RESULT arena too and leaves
+            # as a typed, query-scoped error — the spill.read/write
+            # seams on these caller-owned arenas have no in-line retry
+            # ladder, so a raw OSError is typed here at the boundary
+            self.sink.close()
+            if isinstance(e, OSError) and not isinstance(e, _flt.CylonError):
+                raise _spill.SpillIOError(
+                    "out-of-core join spill I/O failed", e
+                ) from e
+            raise
         finally:
             # close on failure too: leaked arenas would pin tier-2 memmap
             # files and keep _ARENA_LIVE_BYTES inflated for later shuffles

@@ -45,15 +45,19 @@ byte-identical to :func:`~cylon_tpu.parallel.shuffle.plan_rounds`.
 """
 from __future__ import annotations
 
+import errno
 import os
 import shutil
 import tempfile
 import threading
+import time as _time
 from collections import OrderedDict
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..fault import inject as _fault
+from ..fault.errors import SpillIOError
 from ..ops import gather as _g
 from ..utils import envgate as _envgate
 from ..utils.tracing import bump, gauge, span
@@ -118,6 +122,143 @@ def host_spill_budget() -> Optional[int]:
 
 def spill_dir() -> Optional[str]:
     return _envgate.SPILL_DIR.get() or None
+
+
+#: every engine spill directory is named <prefix><host>-<pid>_<random>:
+#: the host+pid stamp makes dead-owner reclamation provable (mirrors the
+#: obs store's journal-<pid>.jsonl dead-writer reaping). The HOST tag
+#: matters on shared volumes (NFS scratch): pid liveness is only
+#: decidable on the owning host, so reaping is strictly same-host.
+SPILL_DIR_PREFIX = "cylon_spill_"
+#: a dead-pid spill dir must be at least this stale before reaping — the
+#: age guard against a dir whose owner died between mkdtemp and first
+#: write racing its own cleanup, and against coarse pid recycling
+REAP_MIN_AGE_S = 60.0
+
+
+def _host_tag() -> str:
+    """This host's stamp: alnum-only (unambiguous '-pid' parsing),
+    bounded length."""
+    import platform
+
+    node = platform.node() or "host"
+    tag = "".join(c for c in node if c.isalnum()).lower()
+    return (tag or "host")[:32]
+
+
+def reap_stale_spill(
+    directory: Optional[str] = None, min_age_s: Optional[float] = None
+) -> int:
+    """Reclaim spill directories orphaned by dead SAME-HOST processes:
+    every ``<SPILL_DIR_PREFIX><host>-<pid>_*`` entry of the spill volume
+    stamped with THIS host whose pid no longer exists and whose mtime is
+    older than the age guard is removed. Called (best-effort, never
+    raising) at context init — the same lifecycle point the obs store
+    reaps dead-writer journals — so a crashed job's tier-2 leftovers
+    cannot fill the volume forever. Returns the number removed.
+
+    Live pids, other hosts' dirs (their pid namespace is not ours —
+    a shared NFS spill volume must never cross-reap), unparseable names
+    (pre-stamp legacy dirs), fresh dirs, and anything ``os.kill(pid,
+    0)`` cannot prove dead are left alone: reclamation must never eat a
+    live process's arenas."""
+    root = directory or spill_dir() or tempfile.gettempdir()
+    if min_age_s is None:
+        min_age_s = REAP_MIN_AGE_S
+    reaped = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    now = _time.time()
+    own = os.getpid()
+    host = _host_tag()
+    for name in names:
+        if not name.startswith(SPILL_DIR_PREFIX):
+            continue
+        owner = name[len(SPILL_DIR_PREFIX):].split("_", 1)[0]
+        if "-" not in owner:
+            continue  # pre-stamp legacy dir: owner unknowable
+        dir_host, pid_s = owner.rsplit("-", 1)
+        if dir_host != host or not pid_s.isdigit() or int(pid_s) == own:
+            continue
+        try:
+            os.kill(int(pid_s), 0)
+            continue  # alive (or recycled): never touch it
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue  # cannot prove dead: assume alive
+        path = os.path.join(root, name)
+        try:
+            if not os.path.isdir(path):
+                continue
+            if now - os.path.getmtime(path) < min_age_s:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        reaped += 1
+    if reaped:
+        bump("shuffle.spill.reaped_dirs", rows=reaped)
+    return reaped
+
+
+def spill_retries() -> int:
+    """Bounded-backoff retries for a failed spill write/read before the
+    degradation ladder engages (CYLON_TPU_SPILL_RETRIES, default 2)."""
+    v = _envgate.SPILL_RETRIES.get()
+    try:
+        return max(int(v), 0) if v else 2
+    except ValueError:
+        return 2
+
+
+#: first-retry backoff; doubles per attempt (bounded by the retry count)
+RETRY_BACKOFF_S = 0.01
+
+
+def _retry_io(what: str, fn, sink=None):
+    """The spill I/O degradation ladder (the ISSUE's 'retry -> tier
+    fallback -> typed query-scoped failure'):
+
+    1. retry ``fn`` up to ``spill_retries()`` times with doubling
+       backoff (``shuffle.spill.io_retries``) — transient ENOSPC/EIO
+       heal here;
+    2. exhausted: if ``sink`` can re-plan its disk arenas onto the
+       host-RAM tier within the host budget
+       (:meth:`ShardArenaSink.degrade_to_host`,
+       ``shuffle.spill.tier_degraded``), do so and try once more;
+    3. still failing: raise :class:`SpillIOError` — the typed,
+       query-scoped failure (``shuffle.spill.io_failures``). The caller
+       (``table._shuffle_many``) closes the sink arenas so the ledger
+       returns to baseline; the process and every other query proceed.
+
+    Only ``OSError`` rides the ladder — real spill-volume failures and
+    the injected seam faults look identical here by design."""
+    retries = spill_retries()
+    delay = RETRY_BACKOFF_S
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except SpillIOError:
+            raise  # already typed (a nested ladder gave up): pass through
+        except OSError as e:
+            attempt += 1
+            if attempt <= retries:
+                bump("shuffle.spill.io_retries")
+                _time.sleep(delay)
+                delay *= 2
+                continue
+            if sink is not None and sink.degrade_to_host():
+                bump("shuffle.spill.tier_degraded")
+                try:
+                    return fn()
+                except OSError as e2:
+                    e = e2
+            bump("shuffle.spill.io_failures")
+            raise SpillIOError(what, e) from e
 
 
 def gate_state() -> tuple:
@@ -326,6 +467,9 @@ class HostArena:
         self._nfiles = 0
         self._bytes = 0
         self._disk = 0
+        # set by to_host(): this arena degraded off a failing spill
+        # volume — never allocate (or budget-promote) back onto disk
+        self._no_disk = False
         # per column: [data buffer, valid buffer | None]
         self._bufs: List[List[Optional[np.ndarray]]] = [
             [None, None] for _ in self.schema
@@ -334,19 +478,45 @@ class HostArena:
     # -- allocation ----------------------------------------------------
     def _ensure_dir(self) -> str:
         if self._dir is None:
+            # host+pid-stamped (SPILL_DIR_PREFIX): context init reaps
+            # same-host dead-pid leftovers (reap_stale_spill) the way
+            # the obs store reaps dead-writer journals — a crashed
+            # process's spill files must not accumulate on the volume
+            # forever, and a shared volume must never cross-reap
             self._dir = tempfile.mkdtemp(
-                prefix="cylon_spill_", dir=spill_dir()
+                prefix=f"{SPILL_DIR_PREFIX}{_host_tag()}-{os.getpid()}_",
+                dir=spill_dir(),
             )
             self._owns_dir = True
         return self._dir
 
     def _alloc(self, dtype: np.dtype, n: int) -> np.ndarray:
-        want_disk = self.backing == TIER_DISK
-        if not want_disk:
+        _fault.check("arena.alloc")
+        if self._no_disk:
+            want_disk = False  # degraded arena: disk is pinned off
             hb = host_spill_budget()
             if hb is not None and _ARENA_LIVE_BYTES >= hb:
-                want_disk = True
-                bump("shuffle.spill.tier2_promotions")
+                # the degradation escape is closed (this arena already
+                # fled a failing volume) AND the host budget is spent:
+                # growing regardless would trade a typed query failure
+                # for the host OOM the failure model forbids. The raise
+                # rides the same `except OSError` ladder as a real
+                # ENOSPC — retries exhaust, degrade_to_host() finds
+                # nothing left to move, SpillIOError fails ONLY this
+                # query with its arenas closed.
+                raise OSError(
+                    errno.ENOSPC,
+                    "host spill budget exhausted on a disk-degraded "
+                    f"arena (CYLON_TPU_SPILL_HOST_BUDGET={hb}, live "
+                    f"{_ARENA_LIVE_BYTES})",
+                )
+        else:
+            want_disk = self.backing == TIER_DISK
+            if not want_disk:
+                hb = host_spill_budget()
+                if hb is not None and _ARENA_LIVE_BYTES >= hb:
+                    want_disk = True
+                    bump("shuffle.spill.tier2_promotions")
         if want_disk and dtype != np.dtype(object):
             self._nfiles += 1
             path = os.path.join(
@@ -428,12 +598,42 @@ class HostArena:
             self._bufs[ci][0] = nb
             self._recount_bytes()
 
+    def touches_disk(self) -> bool:
+        """Does this arena hold — or would its next allocation target —
+        disk-backed buffers? The spill.write/read seams fire only here:
+        a RAM write cannot ENOSPC, and the tier-degradation escape must
+        GENUINELY escape a persistently failing volume."""
+        return self._disk > 0 or (
+            self.backing == TIER_DISK and not self._no_disk
+        )
+
+    def to_host(self) -> bool:
+        """Migrate every disk-backed buffer into RAM and pin this arena
+        off disk (the tier 2 -> tier 1 DEGRADATION, inverse of the
+        budget promotion). Returns False — arena unchanged beyond any
+        already-copied columns — when the migration itself fails."""
+        try:
+            for pair in self._bufs:
+                for j in (0, 1):
+                    buf = pair[j]
+                    if isinstance(buf, np.memmap):
+                        pair[j] = np.array(buf)
+                        self._release_buf(buf)
+        except OSError:
+            return False
+        self.backing = TIER_HOST
+        self._no_disk = True
+        self._recount_bytes()
+        return True
+
     # -- data path -----------------------------------------------------
     def append_batch(self, cols: Sequence[Tuple[np.ndarray, Optional[np.ndarray]]]) -> None:
         """Append one batch of physical columns (order = schema order)."""
         n = len(cols[0][0]) if cols else 0
         if n == 0:
             return
+        if self.touches_disk():
+            _fault.check("spill.write")
         self.reserve(n)
         lo, hi = self.rows, self.rows + n
         for ci, (data, valid) in enumerate(cols):
@@ -445,6 +645,8 @@ class HostArena:
 
     def columns(self) -> List[Tuple[np.ndarray, Optional[np.ndarray]]]:
         """Zero-copy live views, schema order."""
+        if self._disk > 0:
+            _fault.check("spill.read")
         out = []
         for ci, (_n, _d, has_valid) in enumerate(self.schema):
             d, v = self._bufs[ci]
@@ -511,7 +713,30 @@ class ShardArenaSink:
         rows (host arrays); ``table`` carries metadata only. For
         quantized columns the data is either uint8 codes with
         ``scales[s][ci]`` supplied (the staged-round path), or float
-        values to re-encode here (the relay path)."""
+        values to re-encode here (the relay path).
+
+        Runs under the spill I/O degradation ladder (:func:`_retry_io`):
+        a disk-full/EIO mid-append rolls the arenas back to the batch
+        boundary and retries — then degrades the arenas to host RAM —
+        then fails the one owning query typed (:class:`SpillIOError`).
+        The rollback is a row-pointer + scale-segment reset; retried
+        writes simply overwrite the partial batch."""
+        rows0 = [a.rows for a in self.arenas]
+        qsegs0 = [
+            {ci: len(segs) for ci, segs in per.items()}
+            for per in self.qsegs
+        ]
+
+        def attempt():
+            for s, a in enumerate(self.arenas):
+                a.rows = rows0[s]
+                for ci, nseg in qsegs0[s].items():
+                    del self.qsegs[s][ci][nseg:]
+            self._accept_once(table, shard_cols, counts, scales)
+
+        _retry_io("spill arena write", attempt, sink=self)
+
+    def _accept_once(self, table, shard_cols, counts, scales=None) -> None:
         from ..ops import quant as _q
 
         for s, cols in enumerate(shard_cols):
@@ -556,6 +781,26 @@ class ShardArenaSink:
 
     def counts(self) -> np.ndarray:
         return np.asarray([a.rows for a in self.arenas], np.int64)
+
+    def degrade_to_host(self) -> bool:
+        """Re-plan every disk-backed arena onto the host-RAM tier (the
+        ladder's middle rung): allowed only when the host spill budget
+        can absorb the migrated bytes — degrading past the budget would
+        trade a typed query failure for a host OOM, the one outcome the
+        failure model forbids. Returns True when at least one arena
+        actually moved (i.e. a retry is worth making)."""
+        hb = host_spill_budget()
+        if hb is not None:
+            live, _pk, _d, _dp = arena_bytes()
+            if live > hb:
+                return False
+        moved = False
+        for a in self.arenas:
+            if a.touches_disk():
+                if not a.to_host():
+                    return False
+                moved = True
+        return moved
 
     def close(self) -> None:
         for a in self.arenas:
@@ -859,11 +1104,22 @@ def arena_result(sink: ShardArenaSink, template):
     """A spilled shuffle's final device table, rebuilt from the sink's
     per-shard arenas (tier-1/2 counterpart of the in-HBM round concat).
     Quantized-tier columns decode from their staged uint8 codes here —
-    the arenas never held the full-width floats."""
-    per_shard = [
-        sink.dequantized_columns(s) if a.rows else None
-        for s, a in enumerate(sink.arenas)
-    ]
-    res = shards_to_table(template, per_shard, sink.counts())
-    sink.close()
-    return res
+    the arenas never held the full-width floats.
+
+    The read-back rides the same degradation ladder as the writes
+    (:func:`_retry_io`): a tier-2 EIO retries, then migrates the arenas
+    to host RAM and re-reads, then fails the one query typed. The sink
+    is closed on EVERY exit — success, typed failure, or anything else —
+    so arena bytes always return to the ledger baseline."""
+
+    def read():
+        per_shard = [
+            sink.dequantized_columns(s) if a.rows else None
+            for s, a in enumerate(sink.arenas)
+        ]
+        return shards_to_table(template, per_shard, sink.counts())
+
+    try:
+        return _retry_io("spill arena read", read, sink=sink)
+    finally:
+        sink.close()

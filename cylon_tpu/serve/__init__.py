@@ -7,6 +7,12 @@ and admission control that bounds in-flight bytes so concurrency
 degrades into queueing instead of OOM. See docs/ARCHITECTURE.md
 "Query serving".
 """
+from ..fault.errors import (
+    QueryExecError,
+    QueryTimeoutError,
+    SchedulerClosedError,
+    WorkerDiedError,
+)
 from .batch import QID, BatchTemplate, Unbatchable, is_batchable, stack_tables
 from .future import QueryFuture, ServeOverloadError
 from .scheduler import (
@@ -19,10 +25,14 @@ from .scheduler import (
 __all__ = [
     "QID",
     "BatchTemplate",
+    "QueryExecError",
     "QueryFuture",
+    "QueryTimeoutError",
+    "SchedulerClosedError",
     "ServeOverloadError",
     "ServeScheduler",
     "Unbatchable",
+    "WorkerDiedError",
     "estimate_query_bytes",
     "is_batchable",
     "scheduler",

@@ -41,6 +41,7 @@ import numpy as np
 from ..column import Column
 from ..dtypes import DataType, Type
 from ..engine import get_kernel, round_cap
+from ..fault.errors import CylonError
 from ..plan.nodes import (
     Filter,
     GroupBy,
@@ -58,8 +59,13 @@ from ..utils.tracing import span
 QID = "__cylon_qid"
 
 
-class Unbatchable(Exception):
-    """This plan shape cannot ride the stacked batch program."""
+class Unbatchable(CylonError):
+    """This plan shape cannot ride the stacked batch program.
+
+    Re-parented onto the typed taxonomy (cylon_tpu/fault): scope =
+    "query" — the shape simply executes per-binding instead; nothing is
+    poisoned. Internal control flow (``is_batchable`` catches it), never
+    surfaced to a future."""
 
 
 # ----------------------------------------------------------------------

@@ -17,14 +17,30 @@ sync of each query is paid by whoever asks for the answer. graft-lint
 pins ``QueryFuture.result`` = SYNC (a 1-site budget: the audited wait
 below plus the table's amortized count fetch) and everything else on
 this class DISPATCH_SAFE.
+
+FAILURE DOMAIN (cylon_tpu/fault): a future resolves exactly once — to a
+result or a typed :class:`~cylon_tpu.fault.CylonError` — and its
+admission lease is released exactly once, whichever of consumption,
+scheduler-side failure, the ``CYLON_TPU_SERVE_DEADLINE_MS`` deadline, or
+the dropped-future GC finalizer comes first. The deadline is enforced on
+the CALLER side too: ``result()``/``exception()`` cap their wait at the
+query's remaining deadline and fail the future with
+:class:`QueryTimeoutError` instead of hanging on a scheduler that will
+never fulfill it (the transition races the worker's fulfillment under a
+per-future lock; first writer wins).
 """
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
+from ..fault.errors import CylonError, QueryTimeoutError
+from ..utils import envgate as _eg
+from ..utils.tracing import bump
 
-class ServeOverloadError(RuntimeError):
+
+class ServeOverloadError(CylonError, RuntimeError):
     """Admission control shed this query instead of queueing it.
 
     Raised AT SUBMIT (never from ``result()``) when the query cannot be
@@ -34,15 +50,36 @@ class ServeOverloadError(RuntimeError):
     ``serve.shed.*`` (admission_budget / queue_depth / unconsumed_cap)
     and sheds nothing already admitted — a loaded server degrades by
     rejecting new work, not by OOMing the work it accepted.
+
+    Typed on the :class:`~cylon_tpu.fault.CylonError` taxonomy:
+    ``retryable`` (back off and resubmit — the overload is load, not the
+    query), ``scope="query"``; still a ``RuntimeError`` for callers that
+    historically caught that.
     """
+
+    retryable = True
+
+
+def deadline_s() -> Optional[float]:
+    """The per-query serving deadline (seconds), or None when
+    ``CYLON_TPU_SERVE_DEADLINE_MS`` is unset/invalid. Read per call —
+    flips apply to the next wait / batch formation."""
+    raw = _eg.SERVE_DEADLINE_MS.get()
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    return ms / 1e3 if ms > 0 else None
 
 
 class QueryFuture:
     """Future for a query submitted through the serving scheduler."""
 
     __slots__ = (
-        "_event", "_table", "_error", "_wrap", "_release_cb", "t_submit",
-        "est_bytes", "hist_key", "__weakref__",
+        "_event", "_table", "_error", "_wrap", "_release_cb", "_flock",
+        "t_submit", "est_bytes", "hist_key", "__weakref__",
     )
 
     def __init__(
@@ -55,6 +92,10 @@ class QueryFuture:
         self._table = None
         self._error: Optional[BaseException] = None
         self._wrap = wrap
+        # serializes the resolve transition: the worker's fulfill/fail
+        # races the caller-side deadline fail — first writer wins, the
+        # loser's outcome is dropped (the lease release stays idempotent)
+        self._flock = threading.Lock()
         # set by the scheduler: returns this query's bytes to the
         # admission budget (idempotent; also fired by a GC finalizer if
         # the caller drops the future without consuming it)
@@ -65,12 +106,66 @@ class QueryFuture:
 
     # -- scheduler side (sync-free) ------------------------------------
     def _fulfill(self, table) -> None:
-        self._table = table
-        self._event.set()
+        with self._flock:
+            if self._event.is_set():
+                return  # lost to a deadline/worker-death fail
+            self._table = table
+            self._event.set()
 
-    def _fail(self, error: BaseException) -> None:
-        self._error = error
-        self._event.set()
+    def _fail(self, error: BaseException) -> bool:
+        """Resolve to ``error`` if nothing resolved first; returns
+        whether this call WON the transition — losers must not count,
+        release, or otherwise act on an outcome that didn't happen."""
+        with self._flock:
+            if self._event.is_set():
+                return False
+            self._error = error
+            self._event.set()
+            return True
+
+    # -- caller-side deadline enforcement ------------------------------
+    def _deadline_left(self) -> Optional[float]:
+        """Seconds of deadline remaining (None = no deadline armed)."""
+        d = deadline_s()
+        if d is None:
+            return None
+        return d - (time.perf_counter() - self.t_submit)
+
+    def _wait(self, timeout: Optional[float]) -> None:
+        """Wait for fulfillment, bounded by BOTH the caller's timeout and
+        the query deadline. A deadline expiry FAILS the future (typed,
+        lease released) so nothing downstream can hang on it; a plain
+        timeout raises without failing (the query is still in flight)."""
+        left = self._deadline_left()
+        if left is None:
+            if not self._event.wait(timeout):
+                raise TimeoutError("query not fulfilled within timeout")
+            return
+        eff = left if timeout is None else min(timeout, left)
+        if self._event.wait(max(eff, 0.0)):
+            return
+        if timeout is not None and timeout < left:
+            raise TimeoutError("query not fulfilled within timeout")
+        # the deadline, not the caller's timeout, expired: fail typed
+        # and release the lease — the scheduler skips already-done
+        # records, so the admitted work cannot be double-resolved
+        err = QueryTimeoutError(
+            f"query exceeded CYLON_TPU_SERVE_DEADLINE_MS "
+            f"({_eg.SERVE_DEADLINE_MS.get()} ms from submit)"
+        )
+        if not self._fail(err):
+            # lost the transition race: the scheduler resolved this
+            # future (fulfilled OR failed) in the wait->fail window —
+            # its outcome stands, nothing to count or release here
+            return
+        # caller-side typed failures count like scheduler-side ones:
+        # the SLO errors rule (/healthz) must see a deadline storm no
+        # matter which side of the future detected it first
+        bump("serve.errors")
+        bump(f"serve.errors.{err.scope}")
+        cb, self._release_cb = self._release_cb, None
+        if cb is not None:
+            cb()
 
     # -- caller side ----------------------------------------------------
     def done(self) -> bool:
@@ -79,9 +174,9 @@ class QueryFuture:
         return self._event.is_set()
 
     def exception(self, timeout: Optional[float] = None):
-        """The execution error, or None. Waits for fulfillment."""
-        if not self._event.wait(timeout):
-            raise TimeoutError("query not fulfilled within timeout")
+        """The execution error, or None. Waits for fulfillment (bounded
+        by the serving deadline, which fails the future typed)."""
+        self._wait(timeout)
         return self._error
 
     def result(self, timeout: Optional[float] = None):
@@ -92,8 +187,7 @@ class QueryFuture:
         # blocks on the worker's fulfillment event and then forces the
         # table's deferred count fetch (amortized; the detector cannot
         # see the blocking wait)
-        if not self._event.wait(timeout):
-            raise TimeoutError("query not fulfilled within timeout")
+        self._wait(timeout)
         if self._error is not None:
             raise self._error
         t = self._table

@@ -50,6 +50,37 @@ EXECUTION (worker thread, sync-free)
     deferred-count handles. The worker performs no host sync anywhere on
     this path — every query's single sync happens in
     ``QueryFuture.result()`` in the caller's thread.
+
+FAILURE DOMAINS (cylon_tpu/fault; exercised by tools/chaos_smoke.py).
+Every failure on this surface ends in a typed
+:class:`~cylon_tpu.fault.CylonError` on exactly the affected futures,
+with their admission leases released — never a stranded future, never a
+dead process:
+
+- POISONED-BINDING ISOLATION: a stacked-batch failure no longer poisons
+  all B futures. ``_run_group`` falls back to per-binding single
+  execution (counted ``serve.batch_fallback``), so only the binding
+  whose own execution fails gets a :class:`QueryExecError` — the other
+  B-1 return correct results — and the fingerprint enters a batching
+  QUARANTINE cooldown (``BATCH_QUARANTINE_S``) during which its groups
+  form as singles (counted ``serve.batch_quarantined``), so a
+  persistently poisonous shape cannot thrash the batch path.
+- WORKER SUPERVISION: a dying worker thread fails its in-flight group
+  with :class:`WorkerDiedError` (leases released) on the way down;
+  ``submit``/``drain`` detect the dead thread and respawn it (counted
+  ``serve.worker_respawn``) — queued work keeps draining.
+- DEADLINES: ``CYLON_TPU_SERVE_DEADLINE_MS`` bounds submit-to-
+  fulfillment. Expired queries fail with :class:`QueryTimeoutError` at
+  batch formation (before wasting a dispatch) and in the caller-side
+  future waits — a query can be lost to load, but never hang.
+- CLOSE: ``close()`` drains the worker, then FAILS anything still
+  pending with :class:`SchedulerClosedError` and releases its lease — a
+  closed scheduler strands nothing (the close()/drain() leak fix).
+
+Every typed failure bumps ``serve.errors`` (by scope under
+``serve.errors.<scope>``), the SLO monitor's error-rate rule reads it
+into ``/healthz``, and ``stats()['leases']`` exposes the live lease
+count so the chaos harness can assert watermarks return to baseline.
 """
 from __future__ import annotations
 
@@ -59,6 +90,8 @@ import weakref
 from typing import Callable, List, Optional
 
 from .. import engine as _engine
+from ..fault import errors as _flt
+from ..fault import inject as _fault
 from ..obs import metrics as _obsmetrics
 from ..obs import store as _obsstore
 from ..obs import trace as _obstrace
@@ -69,10 +102,23 @@ from ..plan import rules as _plan_rules
 from ..utils import envgate as _eg
 from ..utils.tracing import bump, gauge, span
 from . import batch as _batch
-from .future import QueryFuture, ServeOverloadError
+from .future import QueryFuture, ServeOverloadError, deadline_s
 
 _DEFAULT_INFLIGHT_BYTES = 1 << 30  # 1 GiB
 _EST_FLOOR = 1024  # bytes; keeps zero-size queries countable in the budget
+#: a fingerprint whose stacked batch failed forms single-query groups for
+#: this long (module attr so tests pin the cooldown without a knob)
+BATCH_QUARANTINE_S = 30.0
+#: how long close() waits for the worker to drain before failing whatever
+#: is still pending (module attr so the wedged-worker regression test
+#: does not wait 10 wall seconds)
+CLOSE_JOIN_TIMEOUT_S = 10.0
+
+#: consecutive worker deaths WITHOUT taking a group (so no queue
+#: progress, typed or otherwise) before supervision stops respawning
+#: and fails the queue instead — a deterministic pre-take failure
+#: (e.g. MemoryError building the group) must not respawn-loop forever
+RESPAWN_NOPROGRESS_MAX = 8
 
 
 def _knob_int(knob, default: int) -> int:
@@ -116,6 +162,7 @@ class _Record:
 
     __slots__ = (
         "fut", "lf", "tables", "fingerprint", "lease", "label", "batchable",
+        "seq",
     )
 
     def __init__(self, fut, lf, tables, fingerprint, lease, label, batchable):
@@ -126,6 +173,20 @@ class _Record:
         self.lease = lease
         self.label = label
         self.batchable = batchable
+        #: admission sequence number (assigned under the scheduler lock
+        #: at enqueue, in admission order) — what makes seam keys
+        #: PER-BINDING: every binding of a group shares ``label`` (the
+        #: plan root class name), so a ``match=`` fault spec keying on
+        #: the label alone would fire on all B bindings or none
+        self.seq = -1
+
+    @property
+    def seam_key(self) -> str:
+        """The fault-seam / error-attribution key for this binding:
+        ``<PlanRoot>#q<admission-seq>``. ``match=#q3`` selects exactly
+        the fourth query this scheduler admitted — the 'poison ONE
+        binding of a batch' campaign the fault grammar documents."""
+        return f"{self.label}#q{self.seq}"
 
 
 class _BatchEntry:
@@ -147,21 +208,67 @@ class ServeScheduler:
 
     def __init__(self, ctx, auto_start: bool = True):
         self._ctx = ctx
-        self._lock = threading.Lock()
+        # RLock, NOT Lock: the dropped-future GC finalizer
+        # (weakref.finalize(fut, self._release, lease)) can fire at any
+        # allocation point in any thread — including a thread currently
+        # INSIDE one of this scheduler's critical sections (observed:
+        # Thread.__init__ inside _spawn_worker_locked triggering GC) —
+        # and a non-reentrant lock self-deadlocks there, hanging every
+        # submitter forever. Re-entrant _release_locked is safe: the
+        # release flag is idempotent, the mutations are self-contained
+        # counter decrements, and an in-flight record's lease can never
+        # be the one collected (its _Record strongly holds the future).
+        self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)
         self._queue: List[_Record] = []
         self._inflight_bytes = 0
+        self._leases_live = 0  # admitted, not-yet-released leases
         self._executing = 0  # groups currently being dispatched
+        #: close() returned a wedged worker's _executing slot early (the
+        #: owner may never come back); if it DOES unwedge, its own
+        #: decrement consumes a token instead of going negative
+        self._orphan_rebalance = 0
+        #: consecutive worker deaths with no group taken (reset on any
+        #: successful take — see RESPAWN_NOPROGRESS_MAX)
+        self._respawn_noprogress = 0
+        #: admission counter feeding _Record.seq (per-binding seam keys)
+        self._subseq = 0
         self._batchable: dict = {}  # structural fingerprint -> bool
+        #: structural fingerprint -> monotonic expiry of its batching
+        #: quarantine (set by a stacked-batch failure; groups form as
+        #: singles until the cooldown lapses)
+        self._quarantine: dict = {}
         self._paused = False
         self._closed = False
+        self._had_worker = bool(auto_start)
+        #: the group the worker thread currently holds (popped from the
+        #: queue, not yet finished) — what close() must fail typed when
+        #: the join times out on a WEDGED worker; None when idle
+        self._worker_group: Optional[List[_Record]] = None
         self._thread: Optional[threading.Thread] = None
         if auto_start:
-            self._thread = threading.Thread(
-                target=self._worker, daemon=True, name="cylon-tpu-serve"
-            )
-            self._thread.start()
+            self._spawn_worker_locked()
+
+    def _spawn_worker_locked(self) -> None:
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="cylon-tpu-serve"
+        )
+        self._thread.start()
+
+    def _ensure_worker_locked(self) -> None:
+        """Worker supervision: a scheduler that HAD a worker and finds it
+        dead (a fault or bug killed the thread) respawns it, so queued
+        and future work keeps draining. Worker-less schedulers
+        (``auto_start=False``) stay worker-less — run_pending() is their
+        drain. Caller holds the lock."""
+        if (
+            self._had_worker
+            and not self._closed
+            and (self._thread is None or not self._thread.is_alive())
+        ):
+            bump("serve.worker_respawn")
+            self._spawn_worker_locked()
 
     # ------------------------------------------------------------------
     # submit path (DISPATCH_SAFE: enqueue only, zero host syncs)
@@ -201,6 +308,7 @@ class ServeScheduler:
         cap = _knob_int(_eg.SERVE_INFLIGHT_BYTES, _DEFAULT_INFLIGHT_BYTES)
         depth = max(_knob_int(_eg.SERVE_QUEUE_DEPTH, 256), 1)
         with self._lock:
+            self._ensure_worker_locked()
             if len(self._batchable) >= 256:
                 self._batchable.pop(next(iter(self._batchable)))
             self._batchable[fingerprint[0]] = batchable
@@ -234,9 +342,14 @@ class ServeScheduler:
                         )
                     bump("serve.budget_overflow")
                     break
-                if not block or self._thread is None:
-                    # a worker-less scheduler must never block: only
-                    # run_pending() in THIS thread could make progress
+                if not block or not self._had_worker:
+                    # a worker-less scheduler (auto_start=False) must
+                    # never block: only run_pending() in THIS thread
+                    # could make progress. (NOT `self._thread is None`:
+                    # a dying auto-start worker publishes None for the
+                    # liveness handshake above, and a blocking submit
+                    # must park-and-respawn through the wait loop, not
+                    # shed.)
                     bump("serve.shed.queue_depth")
                     raise ServeOverloadError(
                         f"serving at capacity (queue {len(self._queue)}, "
@@ -246,11 +359,22 @@ class ServeScheduler:
                            "drain with run_pending instead of blocking)")
                     )
                 bump("serve.backpressure.wait")
-                self._space.wait()
+                # bounded wait, not bare: a missed notify (whatever its
+                # cause) must degrade to one second of extra latency,
+                # never an unbounded park — the loop re-checks capacity
+                # and worker liveness every wake either way
+                self._space.wait(1.0)
+                # a worker death notifies this wait: the blocked
+                # submitter must resurrect the drain itself or it would
+                # re-park forever over a queue nobody pops
+                self._ensure_worker_locked()
             if self._closed:
-                raise RuntimeError("ServeScheduler is closed")
+                raise _flt.SchedulerClosedError("ServeScheduler is closed")
+            rec.seq = self._subseq
+            self._subseq += 1
             self._queue.append(rec)
             self._inflight_bytes += est
+            self._leases_live += 1
             bump("serve.submitted")
             if tuned_fp:
                 # counted only once the lease actually holds the tuned
@@ -258,6 +382,7 @@ class ServeScheduler:
                 bump("autotune.footprint_admit")
             gauge("serve.queue_depth", len(self._queue))
             gauge("serve.inflight_bytes", self._inflight_bytes)
+            gauge("serve.leases", self._leases_live)
             self._work.notify()
         # the lease outlives dispatch: consumption (result()) releases
         # it; a future dropped unconsumed releases via GC (the finalizer
@@ -276,12 +401,37 @@ class ServeScheduler:
             return
         lease.released = True
         self._inflight_bytes -= lease.est
+        self._leases_live -= 1
         gauge("serve.inflight_bytes", self._inflight_bytes)
+        gauge("serve.leases", self._leases_live)
         self._space.notify_all()
 
+    def _fail_rec_locked(self, rec: _Record, error: BaseException) -> None:
+        """Fail one admitted query TYPED: the future resolves to a
+        CylonError (non-Cylon causes wrap into QueryExecError carrying
+        the fingerprint + binding key), its lease is released, and the
+        error-rate SLO substrate counts it by scope. Caller holds the
+        lock. The ONE implementation of the fail contract — close()'s
+        orphan sweep, the respawn-exhausted strand, and every worker-path
+        failure route here so counting/attribution cannot drift."""
+        if not isinstance(error, _flt.CylonError):
+            typed = _flt.QueryExecError(
+                f"query execution failed: {type(error).__name__}: {error}",
+                fingerprint=rec.fingerprint[0], binding=rec.seam_key,
+            )
+            typed.__cause__ = error
+            error = typed
+        if rec.fut._fail(error):
+            # count only a transition this call actually made: a lost
+            # race (caller-side deadline fail, or a fulfilled future)
+            # already counted/consumed its own outcome
+            bump("serve.errors")
+            bump(f"serve.errors.{getattr(error, 'scope', 'query')}")
+        self._release_locked(rec.lease)
+
     def _fail_rec(self, rec: _Record, error: BaseException) -> None:
-        rec.fut._fail(error)
-        self._release(rec.lease)
+        with self._lock:
+            self._fail_rec_locked(rec, error)
 
     # ------------------------------------------------------------------
     # drain / lifecycle
@@ -309,6 +459,7 @@ class ServeScheduler:
         success, False on timeout."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
+            self._ensure_worker_locked()
             while self._queue or self._executing > 0:
                 left = None
                 if deadline is not None:
@@ -317,28 +468,61 @@ class ServeScheduler:
                         return False
                 if not self._space.wait(left):
                     return False
+                # same liveness rule as the submit wait: a dead worker
+                # wakes this loop, and the drainer respawns it
+                self._ensure_worker_locked()
         return True
 
     def close(self) -> None:
         """Stop the worker after it finishes the queued work; subsequent
-        submits raise. A worker-less scheduler (``auto_start=False``)
-        fails anything still queued — a future must never hang on a
-        scheduler nobody will drain."""
+        submits raise :class:`SchedulerClosedError`.
+
+        The close()/drain() leak fix: ``t.join(timeout=10)`` can return
+        with the worker still alive (wedged on a device) or already dead
+        (a fault killed it) and queued futures never fulfilled — so
+        AFTER the join (or immediately, on a worker-less scheduler)
+        anything still pending is failed with a typed
+        :class:`SchedulerClosedError` and its lease released. A closed
+        scheduler strands nothing and leaks nothing."""
         with self._lock:
             self._closed = True
-            orphans = [] if self._thread is not None else self._queue
-            if self._thread is None:
-                self._queue = []
-            for rec in orphans:
-                rec.fut._fail(RuntimeError(
-                    "ServeScheduler closed with the query still queued"
-                ))
-                self._release_locked(rec.lease)
             self._work.notify_all()
             self._space.notify_all()
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=10)
+            t.join(timeout=CLOSE_JOIN_TIMEOUT_S)
+        with self._lock:
+            orphans, self._queue = self._queue, []
+            if t is not None and t.is_alive() and self._worker_group:
+                # the join TIMED OUT with the worker wedged mid-group
+                # (records live in its frame, not the queue): those
+                # futures are orphans too. If the worker ever unwedges,
+                # its fulfill/fail loses the transition race (first
+                # writer wins) and the releases stay idempotent.
+                orphans = list(self._worker_group) + orphans
+                # the wedged worker still owns an _executing slot it may
+                # never return: rebalance NOW so drain()/stats() converge
+                # on a closed scheduler instead of parking forever
+                self._worker_group = None
+                self._executing -= 1
+                self._orphan_rebalance += 1
+            for rec in orphans:
+                self._fail_rec_locked(rec, _flt.SchedulerClosedError(
+                    "ServeScheduler closed with the query still pending"
+                ))
+            if orphans:
+                bump("serve.close_orphans", rows=len(orphans))
+            gauge("serve.queue_depth", 0)
+            self._space.notify_all()  # wake drainers: nothing is coming
+
+    def _dec_executing_locked(self) -> None:
+        """Return an ``_executing`` slot; a slot close() already
+        rebalanced away (wedged-worker orphan) consumes its token
+        instead, so the late decrement cannot go negative."""
+        if self._orphan_rebalance > 0:
+            self._orphan_rebalance -= 1
+        else:
+            self._executing -= 1
 
     def stats(self) -> dict:
         """Point-in-time admission state (host counters only).
@@ -348,7 +532,12 @@ class ServeScheduler:
             return {
                 "queue_depth": len(self._queue),
                 "inflight_bytes": self._inflight_bytes,
+                "leases": self._leases_live,
                 "executing": self._executing,
+                "quarantined": sum(
+                    1 for exp in self._quarantine.values()
+                    if exp > time.monotonic()
+                ),
                 "closed": self._closed,
             }
 
@@ -373,16 +562,104 @@ class ServeScheduler:
     # worker side
     # ------------------------------------------------------------------
     def _worker(self) -> None:
-        while True:
+        """The supervised worker shell: the loop body must not die
+        silently. An escaping exception (the ``serve.worker`` seam, or a
+        real bug outside ``_run_group``'s own handler) fails whatever
+        group was in flight with :class:`WorkerDiedError` — leases
+        released, ``_executing`` rebalanced — and lets the thread die;
+        the next ``submit``/``drain`` respawns it
+        (:meth:`_ensure_worker_locked`)."""
+        died = False
+        try:
+            self._worker_loop()
+        except BaseException:  # noqa: BLE001 - supervised death
+            bump("serve.worker_died")
+            died = True
+        finally:
+            # THE LIVENESS HANDSHAKE, as the thread's last act and in
+            # ONE locked region: publish the death (clear self._thread —
+            # a dying thread is still is_alive(), so a waiter woken
+            # while we unwind would otherwise see a "live" worker, skip
+            # its respawn, and park forever on a condition nobody will
+            # ever notify again), handle queued work, and notify LAST.
+            # The lock serializes this against every submit's admission
+            # section: a submitter either runs first and enqueues (we
+            # see the queue and respawn below) or runs after (its
+            # _ensure_worker_locked sees _thread=None and respawns).
             with self._lock:
-                while not self._closed and (not self._queue or self._paused):
-                    self._work.wait()
-                if not self._queue:
-                    if self._closed:
-                        return
-                    continue
-                group = self._take_group_locked()
-                self._executing += 1
+                if self._thread is threading.current_thread():
+                    self._thread = None
+                # respawn IMMEDIATELY when work is still queued: a
+                # caller parked in fut.result() (no submit, no drain)
+                # has no other path to a drain, and a stranded queued
+                # future is exactly what the failure model forbids.
+                # Termination: a post-take death fails its in-flight
+                # group (queue progress, typed), and pre-take deaths —
+                # which make NO progress — are bounded by
+                # RESPAWN_NOPROGRESS_MAX before supervision gives up
+                # and fails the queue itself, so a deterministically-
+                # dying worker can never respawn-loop forever.
+                if died and self._queue and not self._closed:
+                    if self._respawn_noprogress < RESPAWN_NOPROGRESS_MAX:
+                        self._respawn_noprogress += 1
+                        bump("serve.worker_respawn")
+                        self._spawn_worker_locked()
+                    else:
+                        bump("serve.worker_respawn_exhausted")
+                        stranded, self._queue = self._queue, []
+                        for rec in stranded:
+                            self._fail_rec_locked(rec, _flt.WorkerDiedError(
+                                "serve worker died repeatedly before "
+                                "taking a group; queue failed typed"
+                            ))
+                        gauge("serve.queue_depth", 0)
+                        self._respawn_noprogress = 0
+                self._work.notify_all()
+                self._space.notify_all()
+
+    def _worker_loop(self) -> None:
+        while True:
+            group: List[_Record] = []
+            try:
+                with self._lock:
+                    while not self._closed and (
+                        not self._queue or self._paused
+                    ):
+                        self._work.wait()
+                    if not self._queue:
+                        if self._closed:
+                            return
+                        continue
+                    group = self._take_group_locked()
+                    self._executing += 1
+                    self._worker_group = group
+                    # a take IS progress (the queue shrank): even a
+                    # death right after this drains typed, so the
+                    # no-progress respawn budget starts over
+                    self._respawn_noprogress = 0
+                # the worker-death seam: simulates the thread dying while
+                # it HOLDS a group (the stranded-future scenario the
+                # supervision exists for)
+                _fault.check("serve.worker")
+            except BaseException as e:  # noqa: BLE001
+                if group:
+                    err = (
+                        e if isinstance(e, _flt.CylonError)
+                        else _flt.WorkerDiedError(
+                            f"serve worker died: {type(e).__name__}: {e}"
+                        )
+                    )
+                    for rec in group:
+                        if not rec.fut.done():
+                            self._fail_rec(rec, err)
+                    with self._lock:
+                        self._worker_group = None
+                        self._dec_executing_locked()
+                        self._space.notify_all()
+                raise
+            # _run_group's finally clears _worker_group atomically with
+            # its _executing return (a separate clear here would re-open
+            # the close() double-decrement window)
             self._run_group(group)
             # drop the frame's reference BEFORE parking in _work.wait():
             # an idle worker must not pin the last group's futures, or
@@ -395,6 +672,18 @@ class ServeScheduler:
         rest. Caller holds the lock."""
         head = self._queue[0]
         limit = max(_knob_int(_eg.SERVE_BATCH_MAX, 16), 1)
+        # batching quarantine: a fingerprint whose stacked program failed
+        # recently forms single-query groups until the cooldown lapses —
+        # the fallback path is correct but pays B dispatches, so a
+        # persistently poisonous shape must not re-enter the batch path
+        # every group
+        exp = self._quarantine.get(head.fingerprint[0])
+        if exp is not None:
+            if exp > time.monotonic():
+                bump("serve.batch_quarantined")
+                limit = 1
+            else:
+                del self._quarantine[head.fingerprint[0]]
         # the feedback re-coster's p99-target batch bucket rides the
         # fingerprint the group is keyed by: a tuned shape caps its own
         # group size (smaller stacked programs -> lower tail latency)
@@ -417,23 +706,67 @@ class ServeScheduler:
         gauge("serve.queue_depth", len(self._queue))
         return group
 
+    def _expire_deadlines(self, group: List[_Record]) -> List[_Record]:
+        """Fail (typed, lease released) every record already past the
+        serving deadline BEFORE spending a dispatch on it; returns the
+        still-live remainder. A record whose caller-side wait already
+        failed it (fut.done()) is dropped the same way — its lease was
+        released by the deadline path."""
+        d = deadline_s()
+        if d is None:
+            return [rec for rec in group if not rec.fut.done()]
+        now = time.perf_counter()
+        live: List[_Record] = []
+        for rec in group:
+            if rec.fut.done():
+                continue
+            if now - rec.fut.t_submit > d:
+                self._fail_rec(rec, _flt.QueryTimeoutError(
+                    "query exceeded CYLON_TPU_SERVE_DEADLINE_MS "
+                    f"({_eg.SERVE_DEADLINE_MS.get()} ms) before dispatch"
+                ))
+            else:
+                live.append(rec)
+        return live
+
     def _run_group(self, group: List[_Record]) -> None:
         try:
-            if len(group) > 1 and group[0].batchable:
-                self._run_batch(group)
+            live = self._expire_deadlines(group)
+            if len(live) > 1 and live[0].batchable:
+                try:
+                    self._run_batch(live)
+                except BaseException as e:  # noqa: BLE001 - isolate below
+                    # POISONED-BINDING ISOLATION: the stacked program
+                    # failed — quarantine the shape's batching and fall
+                    # back to per-binding singles, so only the binding
+                    # whose OWN execution fails loses its future
+                    bump("serve.batch_fallback", rows=len(live))
+                    with self._lock:
+                        self._quarantine[live[0].fingerprint[0]] = (
+                            time.monotonic() + BATCH_QUARANTINE_S
+                        )
+                        while len(self._quarantine) > 256:
+                            self._quarantine.pop(
+                                next(iter(self._quarantine))
+                            )
+                    self._run_singles(live)
             else:
-                for rec in group:
-                    try:
-                        self._run_single(rec)
-                    except BaseException as e:  # noqa: BLE001 - must not kill the worker
-                        self._fail_rec(rec, e)
+                self._run_singles(live)
         except BaseException as e:  # noqa: BLE001
             for rec in group:
                 if not rec.fut.done():
                     self._fail_rec(rec, e)
         finally:
             with self._lock:
-                self._executing -= 1
+                # same locked region as the _executing return: clearing
+                # the worker-group marker in a SEPARATE acquisition let
+                # close() observe (slot returned, marker still set) and
+                # double-decrement via the wedge branch. Identity-guarded
+                # so a run_pending() caller racing the worker never
+                # clears the worker's own in-flight marker.
+                if self._worker_group is group:
+                    self._worker_group = None
+                self._dec_executing_locked()
                 for _ in group:
                     bump("serve.completed")
                 # fulfilled queries keep their byte lease until the
@@ -442,10 +775,26 @@ class ServeScheduler:
                 # an admission condition (the liveness carve-out)
                 self._space.notify_all()
 
+    def _run_singles(self, group: List[_Record]) -> None:
+        """Per-binding single execution (plain single-query groups AND
+        the batch-failure fallback): one binding's failure fails exactly
+        its own future, typed."""
+        for rec in group:
+            if rec.fut.done():
+                continue
+            try:
+                self._run_single(rec)
+            except BaseException as e:  # noqa: BLE001 - must not kill the worker
+                self._fail_rec(rec, e)
+
     def _run_single(self, rec: _Record) -> None:
         """One query, the ordinary cached single-plan executor — still
         fully async: dispatch without the count sync, the future holds a
         deferred handle."""
+        # the single-execution seam: key = the binding's PER-BINDING
+        # seam key (label#q<seq>), so a match= spec can poison ONE
+        # binding of a fallback group
+        _fault.check("serve.single_exec", key=rec.seam_key)
         with _obstrace.query_trace(rec.label, kind="serve"):
             tables, fingerprint, entry, hit = rec.lf._executable()
             with _feedback.applying(fingerprint[-1]), \
@@ -472,6 +821,15 @@ class ServeScheduler:
         b = len(group)
         bucket = 1 << (b - 1).bit_length()
         head = group[0]
+        # the stacked-batch seam: a failure here exercises the
+        # poisoned-binding fallback in _run_group. The key joins every
+        # binding's seam key, so `match=#q3` arms exactly the batches
+        # CONTAINING binding 3 (then the single seam, with the same
+        # match, fails only that binding in the fallback)
+        _fault.check(
+            "serve.batch_exec",
+            key=" ".join(rec.seam_key for rec in group),
+        )
         # re-assign Scan ordinals BEFORE keying: live Scans are shared
         # with the user's LazyFrame and a concurrent collect of another
         # plan sharing one could have renumbered them since submit —

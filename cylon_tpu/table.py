@@ -51,6 +51,7 @@ from .ops import quant as _quant
 from .ops import sketch as _sketch
 from .ops import sort as _sort_mod
 from .ops import stats as _st
+from .fault import errors as _fault_errors
 from .parallel import shuffle as _sh
 from .parallel import spill as _spill
 from .obs import resource as _obsres
@@ -3897,6 +3898,29 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     # single blocking fetch deferred past the last round. Skew-split
     # relay extractions dispatch FIRST so the one-per-shuffle relay
     # program overlaps every collective round behind it.
+    #
+    # FAILURE DOMAIN (cylon_tpu/fault): any exception out of this phase
+    # fails ONLY the owning query — the failure-model invariant demands
+    # every engine-owned spill arena closed (host/disk ledger bytes back
+    # to baseline) and the error typed: a raw spill-path OSError that
+    # escaped the staging retry ladder (a caller-owned ooc sink, a
+    # memmap flush) leaves as SpillIOError, scope="query".
+    try:
+        return _shuffle_many_rounds(states, rows_total)
+    except BaseException as e:
+        for st in states:
+            so = st.get("sink_obj")
+            if so is not None and st["spec"].sink is None:
+                so.close()
+        if isinstance(e, OSError) and not isinstance(e, _fault_errors.CylonError):
+            raise _spill.SpillIOError("spilled shuffle failed", e) from e
+        raise
+
+
+def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
+    """Phase 2 of ``_shuffle_many`` (split out so the failure-domain
+    wrapper above stays readable): the round loop, the one deferred
+    fetch, and result assembly."""
     results: List["Table"] = []
     with span("shuffle.exchange", rows=rows_total):
         t0 = _time.perf_counter()

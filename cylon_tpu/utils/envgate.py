@@ -345,6 +345,43 @@ SERVE_P99_TARGET_MS = EnvKnob(
     "(unset = no batch-size tuning)",
 )
 
+# -- chaos / robustness (cylon_tpu/fault + the degradation machinery) ---
+# FAULTS alters which HOST code paths raise (never a compiled program, a
+# cache key, or a result when it doesn't fire): observability kind,
+# host-only reads enforced. SPILL_RETRIES and SERVE_DEADLINE_MS are
+# host-resolved policy numbers read per call; neither reaches a kernel.
+FAULTS = EnvKnob(
+    "CYLON_TPU_FAULTS", "", kind="observability",
+    note="deterministic fault-injection spec (cylon_tpu/fault/inject.py): "
+    "comma-separated 'seam[:p=0.05][:kind=ENOSPC][:n=3][:seed=7]"
+    "[:match=substr]' clauses arming the named seams (spill.write/"
+    "spill.read/arena.alloc/serve.batch_exec/serve.single_exec/"
+    "serve.worker/obs.journal). Seeded per-seam RNG: a campaign replays "
+    "from its spec. Unset = every seam is a module-level no-op; read at "
+    "import and at explicit fault.inject.refresh()/reset() — the hook "
+    "is REBOUND, not re-gated per call, to keep the disabled cost at a "
+    "bare function call",
+)
+SPILL_RETRIES = EnvKnob(
+    "CYLON_TPU_SPILL_RETRIES", "2", kind="tuning",
+    keyed_via="host-side spill I/O retry depth only (bounded backoff in "
+    "parallel/spill._retry_io); never reaches a compiled program",
+    note="bounded-backoff retries for a failed spill arena write/read "
+    "before the degradation ladder re-plans onto the host-RAM tier (or "
+    "fails the one query with SpillIOError)",
+)
+SERVE_DEADLINE_MS = EnvKnob(
+    "CYLON_TPU_SERVE_DEADLINE_MS", "", kind="tuning",
+    keyed_via="host-side serving policy only: bounds a query's "
+    "submit-to-fulfillment wall; expired queries FAIL typed "
+    "(QueryTimeoutError) with their admission lease released instead of "
+    "hanging; never reaches a compiled program",
+    note="per-query serving deadline in milliseconds, measured from "
+    "submit: enforced at batch formation (expired queued queries fail "
+    "without executing) and in QueryFuture.result()/exception() waits "
+    "(unset = no deadline — waits are caller-bounded only)",
+)
+
 # -- observability ------------------------------------------------------
 # All three trace knobs are host-only by declared contract (the L1
 # trace-time-read rule): they gate span logging/recording/export and can

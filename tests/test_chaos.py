@@ -1,0 +1,817 @@
+"""Chaos-certified execution (ISSUE 14): the fault-injection registry,
+the typed error taxonomy, and every degradation mechanism the seams
+exercise — plus the satellite regressions (close() leak, stale spill-dir
+reclamation, admission-lease release on error paths, shed-reason
+counters) that previously had no coverage.
+
+The invariant under test everywhere: a failure ends in exactly one of
+{oracle-identical result, typed CylonError} with every admission lease
+and spill arena released — never a stranded future, never a leaked
+byte, never a dead process."""
+import gc
+import os
+import subprocess
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import col, fault
+from cylon_tpu.fault import inject as finject
+from cylon_tpu.fault.errors import (
+    CylonError,
+    QueryExecError,
+    QueryTimeoutError,
+    SchedulerClosedError,
+    SpillIOError,
+    WorkerDiedError,
+)
+from cylon_tpu.parallel import spill as spill_mod
+import importlib
+
+from cylon_tpu.serve import ServeOverloadError, ServeScheduler, Unbatchable
+
+# the submodule, not the serve.scheduler() factory that shadows it
+sched_mod = importlib.import_module("cylon_tpu.serve.scheduler")
+from cylon_tpu.utils import tracing
+
+
+@pytest.fixture(scope="module")
+def cctx(devices):
+    return ct.CylonContext.init_distributed(ct.TPUConfig(devices=devices[:4]))
+
+
+@pytest.fixture(autouse=True)
+def _disarm(monkeypatch):
+    """Every test starts and ends fault-free (the module-level no-op)."""
+    monkeypatch.delenv("CYLON_TPU_FAULTS", raising=False)
+    fault.reset()
+    yield
+    monkeypatch.delenv("CYLON_TPU_FAULTS", raising=False)
+    fault.reset()
+
+
+def _arm(monkeypatch, spec):
+    monkeypatch.setenv("CYLON_TPU_FAULTS", spec)
+    fault.reset()
+
+
+def _mk_binding(ctx, rng, n, key_lo=0, key_hi=20):
+    ta = ct.Table.from_pydict(ctx, {
+        "k": rng.integers(key_lo, key_hi, n).astype(np.int32),
+        "v": rng.integers(-50, 50, n).astype(np.float32),
+    })
+    tb = ct.Table.from_pydict(ctx, {
+        "rk": rng.integers(key_lo, key_hi, n).astype(np.int32),
+        "w": rng.integers(-50, 50, n).astype(np.float32),
+    })
+    return ta, tb
+
+
+def _q3(ta, tb, lit=0.0):
+    return (
+        ta.lazy()
+        .join(tb.lazy(), left_on="k", right_on="rk")
+        .filter(col("w") > lit)
+        .groupby("k", {"v": "sum"})
+    )
+
+
+def _canon(t):
+    d = t.to_pydict()
+    cols = sorted(d)
+    return cols, sorted(zip(*(d[c] for c in cols)))
+
+
+# ----------------------------------------------------------------------
+# the registry: grammar, determinism, no-op discipline
+# ----------------------------------------------------------------------
+def test_spec_grammar_and_errors():
+    specs = fault.parse_spec(
+        "serve.single_exec:p=0.25:kind=exec:n=3:seed=9:match=abc, "
+        "serve.worker"
+    )
+    sw = specs["serve.single_exec"]
+    assert (sw.p, sw.kind, sw.n, sw.seed, sw.match) == (
+        0.25, "exec", 3, 9, "abc")
+    assert specs["serve.worker"].kind == "die"  # per-seam default
+    assert specs["serve.worker"].p == 1.0
+    for bad in (
+        "not.a.seam",                      # unknown seam
+        "spill.write:p=2",                 # p out of range
+        "spill.write:kind=EXPLODE",        # unknown kind
+        "spill.write:zap=1",               # unknown field
+        "spill.write:n=banana",            # unparseable value
+        "obs.journal:kind=exec",           # typed kind on an I/O seam:
+        "spill.read:kind=die",             # would escape the OSError
+                                           # degradation ladders
+        "spill.write:match=abc",           # match on a keyless seam can
+                                           # never fire: armed-but-inert
+    ):
+        with pytest.raises(fault.FaultSpecError):
+            fault.parse_spec(bad)
+    fault.parse_spec("serve.batch_exec:kind=ENOSPC")  # errno on serve: ok
+
+
+def test_deterministic_replay(monkeypatch):
+    """Same (seed, seam, call sequence) => identical injection pattern —
+    the replayability the chaos campaign rests on."""
+
+    def pattern():
+        _arm(monkeypatch, "obs.journal:p=0.4:seed=11")
+        out = []
+        for _ in range(40):
+            try:
+                finject.check("obs.journal")
+                out.append(0)
+            except OSError:
+                out.append(1)
+        return out
+
+    first = pattern()
+    assert sum(first) > 0 and sum(first) < 40
+    assert pattern() == first
+    _arm(monkeypatch, "obs.journal:p=0.4:seed=12")
+    diff = []
+    for _ in range(40):
+        try:
+            finject.check("obs.journal")
+            diff.append(0)
+        except OSError:
+            diff.append(1)
+    assert diff != first  # a different seed is a different campaign
+
+
+def test_disabled_is_module_level_noop(monkeypatch):
+    assert finject.check is finject._check_noop
+    _arm(monkeypatch, "spill.write")
+    assert finject.check is finject._check_armed
+    monkeypatch.delenv("CYLON_TPU_FAULTS")
+    fault.reset()
+    assert finject.check is finject._check_noop
+    finject.check("spill.write")  # and it really does nothing
+
+
+def test_cap_match_and_kinds(monkeypatch):
+    import errno
+
+    _arm(monkeypatch, "spill.write:n=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            finject.check("spill.write")
+        except OSError as e:
+            assert e.errno == errno.ENOSPC  # seam default kind
+            fired += 1
+    assert fired == 2 and fault.fired("spill.write") == 2
+    # match= poisons only the targeted key
+    _arm(monkeypatch, "serve.single_exec:match=bad")
+    finject.check("serve.single_exec", key="good-binding")
+    with pytest.raises(QueryExecError):
+        finject.check("serve.single_exec", key="the-bad-one")
+    # digit-bounded: a match ending in digits never splits a longer
+    # admission seq — #q2 must not also poison #q20..#q29
+    _arm(monkeypatch, "serve.single_exec:match=#q2")
+    finject.check("serve.single_exec", key="Join#q20")
+    finject.check("serve.single_exec", key="Join#q21 Join#q23 Join#q29")
+    with pytest.raises(QueryExecError):
+        finject.check("serve.single_exec", key="Join#q2")
+    with pytest.raises(QueryExecError):
+        finject.check("serve.single_exec", key="Join#q1 Join#q2 Join#q3")
+    # kind families map to the typed taxonomy
+    _arm(monkeypatch, "serve.worker:kind=die")
+    with pytest.raises(WorkerDiedError):
+        finject.check("serve.worker")
+    _arm(monkeypatch, "serve.single_exec:kind=timeout")
+    with pytest.raises(QueryTimeoutError):
+        finject.check("serve.single_exec")
+
+
+def test_typoed_seam_site_fails_loudly(monkeypatch):
+    """A check() site naming an unknown seam is silently dead while
+    disarmed (free), but any armed campaign flags it immediately."""
+    finject.check("spil.write")  # disarmed: the no-op swallows anything
+    _arm(monkeypatch, "obs.journal:p=0")
+    with pytest.raises(fault.FaultSpecError):
+        finject.check("spil.write")
+
+
+def test_seam_hook_sync_budgets_are_live():
+    """The contracts pin on the seam hooks must resolve to REAL
+    functions — a zero-owner budget is silently skipped by the lint
+    pass, which would make the 'seams can never sync' guarantee dead."""
+    from cylon_tpu.analysis import contracts
+
+    for suffix in ("inject._check_armed", "inject._check_noop"):
+        assert suffix in contracts.SYNC_SITE_BUDGETS
+        assert contracts.SYNC_SITE_BUDGETS[suffix].sites == 0
+    assert callable(finject._check_armed)
+    assert callable(finject._check_noop)
+
+
+def test_error_taxonomy():
+    """The scope/retryable axes + the compatibility re-parenting."""
+    assert issubclass(ServeOverloadError, CylonError)
+    assert issubclass(ServeOverloadError, RuntimeError)  # legacy catch
+    assert issubclass(Unbatchable, CylonError)
+    assert issubclass(SpillIOError, OSError)
+    assert issubclass(QueryTimeoutError, TimeoutError)
+    assert issubclass(SchedulerClosedError, RuntimeError)
+    assert ct.CylonError is CylonError  # exported at the package root
+    e = QueryExecError("boom", fingerprint="fp", binding="b3")
+    assert e.scope == "query" and not e.retryable and e.binding == "b3"
+    assert SpillIOError().retryable and WorkerDiedError().retryable
+    assert SchedulerClosedError().scope == "context"
+
+
+# ----------------------------------------------------------------------
+# batched serving: poisoned-binding isolation + quarantine (the
+# acceptance pin)
+# ----------------------------------------------------------------------
+def test_poisoned_binding_isolation_b8(cctx, rng, monkeypatch):
+    """ONE poisoned binding in a B=8 stacked group fails exactly one
+    future (typed QueryExecError), the other 7 return the serial
+    oracle's exact rows via the single fallback, and serve.batch_fallback
+    counts the event."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_BATCH_MAX", "8")
+    plans = [
+        _q3(*_mk_binding(cctx, rng, 120 + 11 * i), lit=0.061)
+        for i in range(8)
+    ]
+    oracle = [_canon(p.collect()) for p in plans]
+    fb0 = tracing.get_count("serve.batch_fallback")
+    _arm(monkeypatch,
+         "serve.batch_exec:p=1:n=1,serve.single_exec:p=1:n=1")
+    s = ServeScheduler(cctx, auto_start=False)
+    futs = [s.submit(p) for p in plans]
+    s.run_pending()
+    errs, good = [], []
+    for i, f in enumerate(futs):
+        e = f.exception(timeout=60)
+        if e is not None:
+            errs.append((i, e))
+        else:
+            good.append((i, _canon(f.result(timeout=60))))
+    assert len(errs) == 1, f"want exactly 1 poisoned future, got {errs}"
+    assert isinstance(errs[0][1], QueryExecError)
+    assert len(good) == 7
+    for i, c in good:
+        assert c == oracle[i], f"binding {i} diverged in the fallback"
+    assert tracing.get_count("serve.batch_fallback") == fb0 + 1
+    assert s.stats()["leases"] == 0  # every lease released or consumed
+    assert s.stats()["inflight_bytes"] == 0
+
+
+def test_match_campaign_targets_one_binding_e2e(cctx, rng, monkeypatch):
+    """The documented `match=` campaign is expressible END TO END: the
+    serve seam keys are per-binding (`<PlanRoot>#q<admission-seq>`), so
+    arming both serve seams with `match=#q3` — no n= cap — fails exactly
+    the fourth admitted binding through batch formation AND the single
+    fallback, and every other binding returns the serial oracle."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_BATCH_MAX", "8")
+    plans = [
+        _q3(*_mk_binding(cctx, rng, 100 + 9 * i), lit=0.0413)
+        for i in range(8)
+    ]
+    oracle = [_canon(p.collect()) for p in plans]
+    _arm(monkeypatch,
+         "serve.batch_exec:match=#q3,serve.single_exec:match=#q3")
+    s = ServeScheduler(cctx, auto_start=False)
+    futs = [s.submit(p) for p in plans]
+    s.run_pending()
+    for i, f in enumerate(futs):
+        e = f.exception(timeout=60)
+        if i == 3:
+            assert isinstance(e, QueryExecError), e
+            assert "#q3" in (e.binding or ""), e.binding
+        else:
+            assert e is None, f"binding {i} unexpectedly failed: {e}"
+            assert _canon(f.result(timeout=60)) == oracle[i]
+    assert s.stats()["leases"] == 0
+    assert s.stats()["inflight_bytes"] == 0
+
+
+def test_batch_quarantine_cooldown(cctx, rng, monkeypatch):
+    """After a stacked-batch failure the fingerprint's groups form as
+    singles (no new batch) until the cooldown lapses, then batching
+    resumes."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_BATCH_MAX", "8")
+    plans = lambda lit: [  # noqa: E731
+        _q3(*_mk_binding(cctx, rng, 90 + 7 * i), lit=lit) for i in range(3)
+    ]
+    wave = plans(0.0721)
+    _arm(monkeypatch, "serve.batch_exec:p=1:n=1")
+    s = ServeScheduler(cctx, auto_start=False)
+    futs = [s.submit(p) for p in wave]
+    s.run_pending()
+    assert all(f.exception(timeout=30) is None for f in futs)
+    monkeypatch.delenv("CYLON_TPU_FAULTS")
+    fault.reset()
+    # quarantined: the next wave of the SAME fingerprint runs as singles
+    q0 = tracing.get_count("serve.batch_quarantined")
+    b0 = tracing.get_count("serve.batches")
+    futs = [s.submit(p) for p in plans(0.0721)]
+    s.run_pending()
+    [f.result(timeout=30) for f in futs]
+    assert tracing.get_count("serve.batch_quarantined") > q0
+    assert tracing.get_count("serve.batches") == b0
+    # cooldown lapses (forced, so the test never races real compile
+    # walls against a second-scale sleep): batching resumes
+    with s._lock:
+        for k in list(s._quarantine):
+            s._quarantine[k] = time.monotonic() - 1.0
+    futs = [s.submit(p) for p in plans(0.0721)]
+    s.run_pending()
+    [f.result(timeout=30) for f in futs]
+    assert tracing.get_count("serve.batches") == b0 + 1
+
+
+# ----------------------------------------------------------------------
+# worker supervision + deadlines
+# ----------------------------------------------------------------------
+def test_worker_death_supervision_and_respawn(cctx, rng, monkeypatch):
+    plans = [
+        _q3(*_mk_binding(cctx, rng, 80 + 9 * i), lit=0.083)
+        for i in range(3)
+    ]
+    oracle = [_canon(p.collect()) for p in plans]
+    _arm(monkeypatch, "serve.worker:n=1")
+    r0 = tracing.get_count("serve.worker_respawn")
+    s = ServeScheduler(cctx, auto_start=True)
+    s.pause()
+    futs = [s.submit(p) for p in plans]
+    # a record of a DIFFERENT fingerprint rides behind the doomed group:
+    # the dying worker must respawn the drain itself — this caller only
+    # waits on the future (no further submit / drain to trigger one)
+    other = _q3(*_mk_binding(cctx, rng, 75, key_hi=11), lit=0.089)
+    other_oracle = _canon(other.collect())
+    tail = s.submit(other)
+    s.resume()
+    for f in futs:
+        assert isinstance(f.exception(timeout=30), WorkerDiedError)
+    assert _canon(tail.result(timeout=60)) == other_oracle
+    assert s.stats()["leases"] == 0  # the dying worker released them
+    # the next wave respawns the worker and serves correctly
+    futs = [s.submit(p) for p in plans]
+    assert s.drain(timeout=60)
+    for i, f in enumerate(futs):
+        assert _canon(f.result(timeout=60)) == oracle[i]
+    assert tracing.get_count("serve.worker_respawn") > r0
+    s.close()
+
+
+def test_worker_respawn_noprogress_bounded(cctx, rng, monkeypatch):
+    """A deterministic PRE-TAKE worker failure (no group taken, so no
+    queue progress even typed) must not respawn-loop forever:
+    supervision gives up after RESPAWN_NOPROGRESS_MAX consecutive
+    no-progress deaths and fails the queue typed instead."""
+
+    def boom(self):
+        raise MemoryError("pre-take failure")
+
+    monkeypatch.setattr(ServeScheduler, "_take_group_locked", boom)
+    r0 = tracing.get_count("serve.worker_respawn")
+    s = ServeScheduler(cctx, auto_start=True)
+    fut = s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.021))
+    assert isinstance(fut.exception(timeout=30), WorkerDiedError)
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    burst = tracing.get_count("serve.worker_respawn") - r0
+    assert burst <= sched_mod.RESPAWN_NOPROGRESS_MAX
+    s.close()
+
+
+@pytest.mark.slow  # e2e thread hammer; CI chaos-smoke drives this path
+def test_blocked_submitters_survive_worker_death(cctx, rng, monkeypatch):
+    """Liveness: submitters parked on backpressure when the worker dies
+    must resurrect the drain themselves — every query resolves (typed or
+    identical), nothing hangs, every lease comes home."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    bindings = [_mk_binding(cctx, rng, 300, key_hi=23) for _ in range(8)]
+    plans = [_q3(ta, tb, lit=0.041) for ta, tb in bindings]
+    est = ct.serve.estimate_query_bytes(list(bindings[0]))
+    monkeypatch.setenv("CYLON_TPU_SERVE_INFLIGHT_BYTES", str(3 * est))
+    _arm(monkeypatch, "serve.worker:n=1")
+    s = ServeScheduler(cctx, auto_start=True)
+
+    def one(p):
+        while True:
+            try:
+                fut = s.submit(p)
+                break
+            except ServeOverloadError:
+                time.sleep(0.005)
+        try:
+            fut.result(timeout=60)
+            return "ok"
+        except CylonError:
+            return "typed"
+
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        outcomes = list(ex.map(one, plans))
+    assert all(o in ("ok", "typed") for o in outcomes)
+    assert any(o == "ok" for o in outcomes)  # the respawned worker served
+    assert s.drain(timeout=30)
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    s.close()
+
+
+def test_deadline_fails_typed_instead_of_hanging(cctx, rng, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_DEADLINE_MS", "60")
+    s = ServeScheduler(cctx, auto_start=False)  # nobody will ever drain
+    fut = s.submit(_q3(*_mk_binding(cctx, rng, 70), lit=0.0917))
+    e0 = tracing.get_count("serve.errors")
+    t0 = time.monotonic()
+    with pytest.raises(QueryTimeoutError):
+        fut.result(timeout=30)
+    assert time.monotonic() - t0 < 5  # failed at the deadline, no hang
+    assert isinstance(fut.exception(timeout=1), QueryTimeoutError)
+    # caller-side deadline failures feed the SLO errors rule too
+    assert tracing.get_count("serve.errors") == e0 + 1
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    # scheduler-side: an expired query is failed at group formation
+    # without wasting a dispatch
+    fut2 = s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.0917))
+    time.sleep(0.1)
+    singles0 = tracing.get_count("serve.singles")
+    s.run_pending()
+    assert isinstance(fut2.exception(timeout=1), QueryTimeoutError)
+    assert tracing.get_count("serve.singles") == singles0
+    assert s.stats()["leases"] == 0
+
+
+# ----------------------------------------------------------------------
+# close() leak fix (satellite 1) + error-path lease coverage (satellite 3)
+# ----------------------------------------------------------------------
+def test_close_fails_pending_typed_workerless(cctx, rng):
+    s = ServeScheduler(cctx, auto_start=False)
+    futs = [s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.013))
+            for _ in range(3)]
+    assert s.stats()["leases"] == 3
+    s.close()
+    for f in futs:
+        assert isinstance(f.exception(timeout=1), SchedulerClosedError)
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    with pytest.raises(SchedulerClosedError):
+        s.submit(_q3(*_mk_binding(cctx, rng, 60)))
+
+
+def test_close_fails_pending_typed_wedged_worker(cctx, rng, monkeypatch):
+    """THE close()/drain() leak regression: the worker wedges mid-group,
+    t.join(timeout) returns with it still alive, and the queued record
+    must be failed typed + released — not silently stranded forever."""
+    monkeypatch.setattr(sched_mod, "CLOSE_JOIN_TIMEOUT_S", 0.2)
+    release = threading.Event()
+    orig = ServeScheduler._run_group
+
+    def wedge(self, group):
+        release.wait(10)  # the worker is stuck on its first group
+        return orig(self, group)
+
+    monkeypatch.setattr(ServeScheduler, "_run_group", wedge)
+    s = ServeScheduler(cctx, auto_start=True)
+    f1 = s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.017))
+    deadline = time.monotonic() + 10
+    while s.stats()["queue_depth"] and time.monotonic() < deadline:
+        time.sleep(0.01)  # wait for the worker to take f1's group
+    f2 = s.submit(
+        _q3(*_mk_binding(cctx, rng, 60, key_hi=13), lit=0.017))
+    s.close()  # join times out: f2 still queued, f1 held by the worker
+    assert isinstance(f2.exception(timeout=1), SchedulerClosedError)
+    # the IN-FLIGHT group is an orphan too: records in the wedged
+    # worker's frame (not the queue) must not be stranded
+    assert isinstance(f1.exception(timeout=1), SchedulerClosedError)
+    assert s.stats()["queue_depth"] == 0
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    # close() rebalanced the wedged worker's _executing slot: a closed
+    # scheduler must CONVERGE — drain() returns instead of parking
+    # forever on a slot whose owner may never come back
+    assert s.stats()["executing"] == 0
+    assert s.drain(timeout=1) is True
+    release.set()  # the worker unwedges: its late fulfill loses the
+    # transition race, and nothing double-releases or goes negative
+    t = s._thread
+    if t is not None:  # the exiting worker publishes _thread=None (the
+        t.join(timeout=30)  # liveness handshake); a caught reference
+        assert not t.is_alive()  # must still drain within the timeout
+    deadline = time.monotonic() + 30
+    while s._thread is not None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert s._thread is None  # exit published through the handshake
+    assert isinstance(f1.exception(timeout=1), SchedulerClosedError)
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    # the late decrement consumed the rebalance token, not the counter
+    assert s.stats()["executing"] == 0
+
+
+def test_exec_error_releases_lease_and_gc_path(cctx, rng, monkeypatch):
+    """Satellite 3: an exception between submit() and result() releases
+    the admission lease at failure time; a dropped errored future leaks
+    nothing through the GC finalizer either."""
+    _arm(monkeypatch, "serve.single_exec:p=1")
+    s = ServeScheduler(cctx, auto_start=False)
+    fut = s.submit(_q3(*_mk_binding(cctx, rng, 70), lit=0.019))
+    assert s.stats()["leases"] == 1
+    s.run_pending()
+    assert isinstance(fut.exception(timeout=5), QueryExecError)
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+    with pytest.raises(QueryExecError):
+        fut.result(timeout=5)
+    # dropped-unconsumed errored future: the finalizer releases (again,
+    # idempotently) and nothing goes negative or leaks
+    fut2 = s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.019))
+    s.run_pending()
+    del fut2
+    gc.collect()
+    assert s.stats()["leases"] == 0 and s.stats()["inflight_bytes"] == 0
+
+
+def test_exception_timeout_contract(cctx, rng):
+    """exception(timeout=) raises TimeoutError while unfulfilled (the
+    query is still in flight — not failed), returns None on success."""
+    s = ServeScheduler(cctx, auto_start=False)
+    fut = s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.023))
+    with pytest.raises(TimeoutError):
+        fut.exception(timeout=0.05)
+    assert not fut.done()
+    s.run_pending()
+    assert fut.exception(timeout=5) is None
+    fut.result(timeout=30)
+
+
+def test_shed_reason_unconsumed_cap(cctx, rng, monkeypatch):
+    """Satellite 3: the unconsumed_cap shed reason — results held past
+    the 2x hard cap shed NEW submits, counted under their own reason."""
+    ta, tb = _mk_binding(cctx, rng, 400)
+    est = ct.serve.estimate_query_bytes([ta, tb])
+    monkeypatch.setenv("CYLON_TPU_SERVE_INFLIGHT_BYTES", str(int(est * 1.2)))
+    s = ServeScheduler(cctx, auto_start=False)
+    c0 = tracing.get_count("serve.shed.unconsumed_cap")
+    held = []
+    shed = None
+    for i in range(6):
+        try:
+            f = s.submit(
+                _q3(*_mk_binding(cctx, rng, 400, key_hi=17), lit=0.029))
+        except ServeOverloadError as e:
+            shed = e
+            break
+        s.run_pending()
+        held.append(f)  # fulfilled, never consumed: bytes stay held
+    assert shed is not None and shed.retryable
+    assert tracing.get_count("serve.shed.unconsumed_cap") == c0 + 1
+    for f in held:
+        f.result(timeout=30)
+    assert s.stats()["inflight_bytes"] == 0 and s.stats()["leases"] == 0
+
+
+def test_errors_feed_slo_rule(cctx, rng, monkeypatch):
+    """The new error-rate SLO rule: typed failures drive errors ->
+    WARN/BREACH and age out with the window (the /healthz substrate)."""
+    from cylon_tpu.obs import slo
+
+    m = slo.SLOMonitor(window=0.25)
+    assert m.evaluate().get("errors") == slo.STATE_OK
+    monkeypatch.setenv("CYLON_TPU_SERVE_BATCH_MAX", "1")  # singles path
+    _arm(monkeypatch, "serve.single_exec:p=1")
+    s = ServeScheduler(cctx, auto_start=False)
+    futs = [s.submit(_q3(*_mk_binding(cctx, rng, 60), lit=0.031))
+            for _ in range(3)]
+    s.run_pending()
+    for f in futs:
+        assert f.exception(timeout=5) is not None
+    assert m.evaluate()["errors"] == slo.STATE_BREACH
+    ok, reasons = m.healthy()
+    assert not ok and any(r.startswith("errors=") for r in reasons)
+    time.sleep(0.3)
+    assert m.evaluate()["errors"] == slo.STATE_OK  # aged out
+
+
+# ----------------------------------------------------------------------
+# spill: the I/O degradation ladder + stale-dir reclamation (satellite 2)
+# ----------------------------------------------------------------------
+def test_spill_write_retry_heals(monkeypatch, tmp_path):
+    """A transient ENOSPC heals inside CYLON_TPU_SPILL_RETRIES with the
+    arena rolled back to the batch boundary (no double-append)."""
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("CYLON_TPU_SPILL_RETRIES", "2")
+    _arm(monkeypatch, "spill.write:p=1:n=2")
+    r0 = tracing.get_count("shuffle.spill.io_retries")
+    sink = spill_mod.ShardArenaSink(
+        2, [("a", np.dtype(np.int32), False)], spill_mod.TIER_DISK)
+    data = np.arange(64, dtype=np.int32)
+    sink.accept(None, [[(data, None)], [(data * 2, None)]],
+                np.array([64, 64]))
+    assert tracing.get_count("shuffle.spill.io_retries") == r0 + 2
+    got = [sink.arenas[s].columns()[0][0] for s in (0, 1)]
+    assert np.array_equal(got[0], data) and np.array_equal(got[1], data * 2)
+    assert list(sink.counts()) == [64, 64]  # rollback: no double-append
+    sink.close()
+
+
+def test_spill_write_degrades_to_host_then_types(monkeypatch, tmp_path):
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("CYLON_TPU_SPILL_RETRIES", "1")
+    _arm(monkeypatch, "spill.write:p=1")  # the volume NEVER recovers
+    d0 = tracing.get_count("shuffle.spill.tier_degraded")
+    sink = spill_mod.ShardArenaSink(
+        1, [("a", np.dtype(np.int64), True)], spill_mod.TIER_DISK)
+    data = np.arange(32, dtype=np.int64)
+    sink.accept(None, [[(data, None)]], np.array([32]))
+    assert tracing.get_count("shuffle.spill.tier_degraded") == d0 + 1
+    assert sink.arenas[0]._no_disk  # re-planned onto the host tier
+    assert np.array_equal(sink.arenas[0].columns()[0][0], data)
+    sink.close()
+    # with the host tier ALSO failing (arena.alloc), the ladder is out
+    # of rungs: typed SpillIOError, arenas closed by the caller
+    _arm(monkeypatch, "arena.alloc:p=1")
+    sink2 = spill_mod.ShardArenaSink(
+        1, [("a", np.dtype(np.int64), False)], spill_mod.TIER_HOST)
+    with pytest.raises(SpillIOError) as ei:
+        sink2.accept(None, [[(data, None)]], np.array([32]))
+    assert ei.value.scope == "query" and ei.value.retryable
+    sink2.close()
+
+
+def test_degraded_arena_respects_host_budget(monkeypatch, tmp_path):
+    """A disk-degraded arena (_no_disk) must NOT grow host RAM past
+    CYLON_TPU_SPILL_HOST_BUDGET — its disk escape is gone, so a budget
+    breach fails typed instead of marching toward a host OOM."""
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("CYLON_TPU_SPILL_RETRIES", "0")
+    _arm(monkeypatch, "spill.write:p=1")  # the volume never recovers
+    sink = spill_mod.ShardArenaSink(
+        1, [("a", np.dtype(np.int64), False)], spill_mod.TIER_DISK)
+    data = np.arange(64, dtype=np.int64)
+    sink.accept(None, [[(data, None)]], np.array([64]))
+    assert sink.arenas[0]._no_disk  # degraded under an open budget
+    # now close the budget below what's already live: the next growth
+    # on the degraded arena must ride the ladder to a typed failure
+    live = spill_mod.arena_bytes()[0]
+    monkeypatch.setenv("CYLON_TPU_SPILL_HOST_BUDGET", str(max(live, 1)))
+    big = np.arange(4096, dtype=np.int64)
+    with pytest.raises(SpillIOError) as ei:
+        sink.accept(None, [[(big, None)]], np.array([4096]))
+    assert ei.value.scope == "query"
+    assert list(sink.counts()) == [64]  # rollback: the batch never landed
+    sink.close()
+    assert spill_mod.arena_bytes()[0] == 0
+
+
+@pytest.mark.slow  # e2e spilled joins x3; CI chaos-smoke pins the same
+def test_spilled_join_identical_under_write_faults(cctx, rng, monkeypatch,
+                                                   tmp_path):
+    """End to end: a forced-tier-2 join under a 100%-failing spill
+    volume degrades to the host tier and returns the EXACT tier-0
+    result; arena bytes return to baseline."""
+    ta = ct.Table.from_pydict(cctx, {
+        "k": rng.integers(0, 60, 3000).astype(np.int64),
+        "v": rng.integers(-9, 9, 3000).astype(np.int32)})
+    tb = ct.Table.from_pydict(cctx, {
+        "rk": rng.integers(0, 60, 3000).astype(np.int64),
+        "w": rng.integers(-9, 9, 3000).astype(np.int32)})
+    oracle = _canon(ta.distributed_join(tb, left_on=["k"], right_on=["rk"]))
+    monkeypatch.setenv("CYLON_TPU_SPILL_TIER", "2")
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    for seam in ("spill.write:p=1", "spill.read:p=1"):
+        _arm(monkeypatch, seam)
+        got = _canon(ta.distributed_join(tb, left_on=["k"], right_on=["rk"]))
+        assert got == oracle, f"diverged under {seam}"
+        assert fault.fired(seam.split(":")[0]) > 0
+    gc.collect()
+    live, _pk, disk, _dp = spill_mod.arena_bytes()
+    assert live == 0 and disk == 0
+
+
+def test_spilled_join_types_when_ladder_exhausted(cctx, rng, monkeypatch,
+                                                  tmp_path):
+    """Alloc failing on every tier: the query fails with SpillIOError —
+    query-scoped, arenas closed — and the engine survives to run the
+    same join cleanly right after."""
+    ta = ct.Table.from_pydict(cctx, {
+        "k": rng.integers(0, 50, 2000).astype(np.int64),
+        "v": rng.integers(-9, 9, 2000).astype(np.int32)})
+    tb = ct.Table.from_pydict(cctx, {
+        "rk": rng.integers(0, 50, 2000).astype(np.int64),
+        "w": rng.integers(-9, 9, 2000).astype(np.int32)})
+    oracle = _canon(ta.distributed_join(tb, left_on=["k"], right_on=["rk"]))
+    monkeypatch.setenv("CYLON_TPU_SPILL_TIER", "1")
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    monkeypatch.setenv("CYLON_TPU_SPILL_RETRIES", "0")
+    _arm(monkeypatch, "arena.alloc:p=1")
+    with pytest.raises(SpillIOError):
+        ta.distributed_join(tb, left_on=["k"], right_on=["rk"])
+    gc.collect()
+    live, _pk, disk, _dp = spill_mod.arena_bytes()
+    assert live == 0 and disk == 0  # the failure path closed the sinks
+    monkeypatch.delenv("CYLON_TPU_FAULTS")
+    fault.reset()
+    got = _canon(ta.distributed_join(tb, left_on=["k"], right_on=["rk"]))
+    assert got == oracle  # the process (and context) are untouched
+
+
+@pytest.mark.slow  # two full ooc joins; the unit ladder tests stay fast
+def test_ooc_join_types_spill_faults(cctx, rng, monkeypatch, tmp_path):
+    """The out-of-core join's caller-owned arenas have no in-line retry
+    ladder — a spill fault there must still leave as a typed
+    SpillIOError with every arena (ingest AND result) closed."""
+    import pandas as pd
+
+    from cylon_tpu.parallel.ooc import OutOfCoreJoin
+
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    ldf = pd.DataFrame({
+        "k": rng.integers(0, 500, 4000).astype(np.int32),
+        "v": rng.normal(size=4000).astype(np.float32)})
+    rdf = pd.DataFrame({
+        "k": rng.integers(0, 500, 4000).astype(np.int32),
+        "w": rng.normal(size=4000).astype(np.float32)})
+
+    def chunks(df, n):
+        for lo in range(0, len(df), n):
+            yield {c: df[c].to_numpy()[lo:lo + n] for c in df.columns}
+
+    monkeypatch.setenv("CYLON_TPU_SPILL_RETRIES", "0")
+    monkeypatch.setenv("CYLON_TPU_SPILL_TIER", "2")
+    _arm(monkeypatch, "arena.alloc:p=1")
+    job = OutOfCoreJoin(cctx, on="k", how="inner", num_buckets=4)
+    with pytest.raises(SpillIOError):
+        job.execute(chunks(ldf, 1000), chunks(rdf, 1000))
+    gc.collect()
+    live, _pk, disk, _dp = spill_mod.arena_bytes()
+    assert live == 0 and disk == 0
+    # the engine survives: the same join runs clean right after
+    monkeypatch.delenv("CYLON_TPU_FAULTS")
+    fault.reset()
+    job2 = OutOfCoreJoin(cctx, on="k", how="inner", num_buckets=4)
+    sink = job2.execute(chunks(ldf, 1000), chunks(rdf, 1000))
+    assert sink.rows == len(ldf.merge(rdf, on="k"))
+    sink.close()
+
+
+def test_reap_stale_spill_dirs(tmp_path):
+    """Satellite 2: dead-pid spill dirs are reclaimed (age-guarded);
+    live-pid, fresh, and unparseable dirs are left alone."""
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    dead_pid = proc.pid  # provably dead, freshly reaped
+    pfx = spill_mod.SPILL_DIR_PREFIX
+    host = spill_mod._host_tag()
+    orphan = tmp_path / f"{pfx}{host}-{dead_pid}_abc"
+    fresh = tmp_path / f"{pfx}{host}-{dead_pid}_fresh"
+    mine = tmp_path / f"{pfx}{host}-{os.getpid()}_live"
+    # a shared (NFS) volume: another HOST's dir, same dead pid number —
+    # its pid namespace is not ours, so it must never be reaped
+    foreign = tmp_path / f"{pfx}otherhost-{dead_pid}_x"
+    legacy = tmp_path / f"{pfx}notapid"
+    for d in (orphan, fresh, mine, foreign, legacy):
+        d.mkdir()
+        (d / "col1.bin").write_bytes(b"x" * 128)
+    old = time.time() - 3600
+    os.utime(orphan, (old, old))
+    os.utime(foreign, (old, old))
+    assert spill_mod.reap_stale_spill(str(tmp_path), min_age_s=60) == 1
+    assert not orphan.exists()
+    assert fresh.exists() and mine.exists() and legacy.exists()
+    assert foreign.exists()
+    # context-init entry point: runs against the configured dir, never
+    # raises (smoke: an unreadable dir is a no-op)
+    assert spill_mod.reap_stale_spill("/nonexistent-dir-xyz") == 0
+
+
+def test_arena_dirs_are_pid_stamped(monkeypatch, tmp_path):
+    monkeypatch.setenv("CYLON_TPU_SPILL_DIR", str(tmp_path))
+    a = spill_mod.HostArena(
+        [("a", np.dtype(np.int32), False)], spill_mod.TIER_DISK)
+    a.append_batch([(np.arange(8, dtype=np.int32), None)])
+    dirs = list(tmp_path.iterdir())
+    assert len(dirs) == 1
+    assert dirs[0].name.startswith(
+        f"{spill_mod.SPILL_DIR_PREFIX}"
+        f"{spill_mod._host_tag()}-{os.getpid()}_")
+    a.close()
+    assert not dirs[0].exists()  # close still removes its own dir
+
+
+# ----------------------------------------------------------------------
+# obs: journal degrade
+# ----------------------------------------------------------------------
+def test_obs_journal_degrades_to_memory(monkeypatch, tmp_path):
+    from cylon_tpu.obs import metrics as obsmetrics
+    from cylon_tpu.obs.store import ObsStore
+
+    _arm(monkeypatch, "obs.journal:p=1")
+    c0 = obsmetrics.get_count("obs.journal_degraded")
+    st = ObsStore(str(tmp_path), writer_id="t1")
+    for i in range(5):
+        st.record({"k": "lat", "fp": "fp1", "s": 0.01 * (i + 1)})
+    assert st.journal_degraded
+    assert obsmetrics.get_count("obs.journal_degraded") == c0 + 1  # once
+    # in-memory telemetry kept flowing: the profile absorbed everything
+    assert st.profiles["fp1"]["lat"]["n"] == 5
+    # ...but nothing was persisted (the volume is gone)
+    assert os.path.getsize(st.journal_path) == 0 if os.path.exists(
+        st.journal_path) else True
+    st.close()

@@ -1,0 +1,348 @@
+"""chaos-smoke: the CI chaos-certification gate (ISSUE 14).
+
+A seeded fault campaign over EVERY seam in the catalog
+(``cylon_tpu/fault/inject.SEAMS``), each armed in turn under a mixed
+workload — fingerprint-batched serving (B bindings of a q3 shape) plus a
+forced-tier-2 distributed join — asserting the failure-model invariant
+mechanically:
+
+- ZERO HANGS: every round completes inside a global deadline and every
+  future resolves inside its own timeout (a deadline-armed round
+  additionally proves a stalled query FAILS typed instead of hanging);
+- ZERO PROCESS DEATHS: the campaign runs in one process that must
+  survive every seam (a dead worker thread is supervised + respawned,
+  never fatal);
+- TYPED OR IDENTICAL: every query either returns the faults-disabled
+  oracle's exact result or raises a typed CylonError — nothing else;
+- WATERMARKS TO BASELINE: after each round the admission leases
+  (count AND bytes) and the spill arena bytes are back to zero — no
+  failure path leaks a lease or an arena;
+- THE SEAM FIRED: each round's armed fault must actually inject
+  (``fault.fired``), else the round proves nothing;
+- ISOLATION PIN: the serve.batch_exec+serve.single_exec round pins the
+  acceptance criterion — ONE poisoned binding in a stacked group fails
+  exactly one future (typed), the others return oracle-identical
+  results through the single fallback, counted ``serve.batch_fallback``;
+- DISABLED = FREE: with faults disabled, results are byte-identical to
+  the oracle and the per-hook cost of the seam checks (measured by
+  calibration, like tools/trace_smoke.py's tracer pin) stays under 2%
+  of the q3 serving wall even at a generous hooks-per-query budget.
+
+Usage: python tools/chaos_smoke.py [--rows 20000] [--world 4]
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+#: generous hooks-per-query budget for the overhead pin: a q3 serving
+#: dispatch crosses a handful of seams and a spilled K-round shuffle a
+#: few per (round, shard, column) — 1000 is an order past reality
+HOOK_BUDGET_PER_QUERY = 1_000
+#: per-round global deadline (a hang anywhere fails the gate, not CI's
+#: job timeout)
+ROUND_DEADLINE_S = 300.0
+
+
+def _fail(msg: str) -> None:
+    print(f"CHAOS SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--bindings", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    devices = ge._force_cpu_mesh(max(args.world, 1))
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu import col, fault
+    from cylon_tpu.fault import CylonError
+    from cylon_tpu.obs import metrics as obsmetrics
+    from cylon_tpu.parallel import spill as spill_mod
+    from cylon_tpu.serve import ServeScheduler
+    from cylon_tpu.utils import tracing
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[: args.world])
+    )
+    rng = np.random.default_rng(args.seed)
+    spill_dir = tempfile.mkdtemp(prefix="chaos_spill_")
+    obs_dir = tempfile.mkdtemp(prefix="chaos_obs_")
+
+    # ------------------------------------------------------------------
+    # the mixed workload: B q3 serving bindings + one forced-tier-2 join
+    # ------------------------------------------------------------------
+    n = max(args.rows // args.bindings, 500)
+    bindings = []
+    for i in range(args.bindings):
+        k = rng.integers(0, 40, n).astype(np.int32)
+        rk = rng.integers(0, 40, n).astype(np.int32)
+        ta = ct.Table.from_pydict(ctx, {
+            "k": k, "v": rng.integers(-50, 50, n).astype(np.float32)})
+        tb = ct.Table.from_pydict(ctx, {
+            "rk": rk, "w": rng.integers(-50, 50, n).astype(np.float32)})
+        bindings.append((ta, tb))
+
+    def q3(i, lit=0.0):
+        ta, tb = bindings[i]
+        return (
+            ta.lazy()
+            .join(tb.lazy(), left_on="k", right_on="rk")
+            .filter(col("w") > lit)
+            .groupby("k", {"v": "sum"})
+        )
+
+    sk = rng.integers(0, 200, args.rows).astype(np.int64)
+    sl = ct.Table.from_pydict(ctx, {
+        "k": sk, "v": rng.integers(-9, 9, args.rows).astype(np.int32)})
+    sr = ct.Table.from_pydict(ctx, {
+        "rk": rng.integers(0, 200, args.rows).astype(np.int64),
+        "w": rng.integers(-9, 9, args.rows).astype(np.int32)})
+
+    def canon(t):
+        d = t.to_pydict()
+        cols = sorted(d)
+        rows = sorted(zip(*(d[c] for c in cols)))
+        return cols, rows
+
+    def spill_join():
+        prev = {k: os.environ.get(k)
+                for k in ("CYLON_TPU_SPILL_TIER", "CYLON_TPU_SPILL_DIR")}
+        os.environ["CYLON_TPU_SPILL_TIER"] = "2"
+        os.environ["CYLON_TPU_SPILL_DIR"] = spill_dir
+        try:
+            return sl.distributed_join(sr, left_on=["k"], right_on=["rk"])
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # oracles, faults disabled
+    os.environ.pop("CYLON_TPU_FAULTS", None)
+    serve_oracle = [canon(q3(i).collect()) for i in range(args.bindings)]
+    spill_oracle = canon(spill_join())
+
+    def run_round(name, spec, env=None, lit=0.0, expect_fired=None,
+                  scheduler_paused_s=0.0):
+        """One campaign round: arm ``spec``, run the mixed workload,
+        enforce the invariant, return (#typed, #identical) over the
+        serving wave."""
+        t_round = time.monotonic()
+        prev_env = {}
+        env = dict(env or {})
+        env["CYLON_TPU_FAULTS"] = spec
+        for k, v in env.items():
+            prev_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        fault.reset()  # arm from the just-set env, fresh draw state
+        typed = identical = 0
+        spill_typed = spill_ident = 0
+        try:
+            # -- serving wave (fresh scheduler: quarantine state must
+            # not leak across rounds) --
+            s = ServeScheduler(ctx, auto_start=True)
+            s.pause()
+            futs = [s.submit(q3(i, lit)) for i in range(args.bindings)]
+            if scheduler_paused_s:
+                time.sleep(scheduler_paused_s)
+            s.resume()
+            got = []
+            for i, f in enumerate(futs):
+                try:
+                    got.append((i, canon(f.result(timeout=120))))
+                except CylonError as e:
+                    typed += 1
+                    got.append((i, None))
+                    print(f"  [{name}] binding {i}: typed "
+                          f"{type(e).__name__} (scope={e.scope})")
+            for i, c in got:
+                if c is not None:
+                    if c != serve_oracle[i]:
+                        _fail(f"{name}: binding {i} returned a wrong "
+                              "result (neither oracle-identical nor a "
+                              "typed failure)")
+                    identical += 1
+            # -- worker-death second wave: the supervisor must have
+            # respawned a dead worker, and a fresh wave must serve --
+            if "serve.worker" in spec:
+                futs2 = [s.submit(q3(i, lit)) for i in range(2)]
+                for i, f in enumerate(futs2):
+                    try:
+                        if canon(f.result(timeout=120)) != serve_oracle[i]:
+                            _fail(f"{name}: post-respawn binding {i} wrong")
+                    except CylonError:
+                        pass  # the seam may fire again; typed is legal
+                if tracing.get_count("serve.worker_respawn") < 1:
+                    _fail(f"{name}: dead worker was never respawned")
+            s.close()
+            st = s.stats()
+            if st["leases"] != 0 or st["inflight_bytes"] != 0:
+                _fail(f"{name}: serving leases leaked after the round: "
+                      f"{st}")
+            del s, futs, got
+            gc.collect()
+            # -- forced-tier-2 join --
+            try:
+                res = spill_join()
+                if canon(res) != spill_oracle:
+                    _fail(f"{name}: spilled join returned a wrong result")
+                spill_ident += 1
+                del res
+            except CylonError as e:
+                spill_typed += 1
+                print(f"  [{name}] spilled join: typed "
+                      f"{type(e).__name__} (scope={e.scope})")
+            gc.collect()
+            live, _pk, disk, _dp = spill_mod.arena_bytes()
+            if live != 0 or disk != 0:
+                _fail(f"{name}: spill arena bytes leaked: live={live} "
+                      f"disk={disk}")
+            for seam in (expect_fired or []):
+                if fault.fired(seam) < 1:
+                    _fail(f"{name}: seam {seam} never fired — the round "
+                          "proves nothing")
+        finally:
+            for k, p in prev_env.items():
+                if p is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = p
+            fault.reset()
+        wall = time.monotonic() - t_round
+        if wall > ROUND_DEADLINE_S:
+            _fail(f"{name}: round exceeded the {ROUND_DEADLINE_S:.0f}s "
+                  f"global deadline ({wall:.1f}s) — hang")
+        print(f"[chaos] {name}: serve typed={typed} identical={identical} "
+              f"spill typed={spill_typed} identical={spill_ident} "
+              f"({wall:.1f}s)")
+        return typed, identical
+
+    # ------------------------------------------------------------------
+    # the campaign: every seam armed in turn (distinct filter literals
+    # keep each round's serving fingerprint out of earlier quarantines)
+    # ------------------------------------------------------------------
+    seed = args.seed
+
+    # spill.write at p=1: every disk write fails -> retries exhaust ->
+    # the arenas DEGRADE to host RAM and the query must come back
+    # oracle-identical (the ladder's tier fallback, not a failure)
+    before_deg = tracing.get_count("shuffle.spill.tier_degraded")
+    run_round("spill.write", f"spill.write:p=1:seed={seed}",
+              expect_fired=["spill.write"])
+    if tracing.get_count("shuffle.spill.tier_degraded") <= before_deg:
+        _fail("spill.write round never degraded a disk arena to host RAM")
+
+    run_round("spill.read", f"spill.read:p=1:seed={seed}",
+              expect_fired=["spill.read"])
+
+    # arena.alloc at p=0.5: allocation flakes; retries may heal it or
+    # the ladder types it — both legal, nothing else is
+    run_round("arena.alloc", f"arena.alloc:p=0.5:seed={seed}",
+              expect_fired=["arena.alloc"])
+
+    # THE ISOLATION PIN, via the documented match= campaign: the round's
+    # fresh paused scheduler admits binding i as seq i, so match=#q2
+    # poisons exactly binding 2 — the stacked batch containing it fails,
+    # the fallback runs, and only that binding's single execution fails
+    # -> exactly 1 typed failure, B-1 identical
+    before_fb = tracing.get_count("serve.batch_fallback")
+    typed, identical = run_round(
+        "poisoned-binding",
+        "serve.batch_exec:match=#q2,serve.single_exec:match=#q2",
+        lit=0.125, expect_fired=["serve.batch_exec", "serve.single_exec"],
+    )
+    if typed != 1 or identical != args.bindings - 1:
+        _fail(f"isolation pin: want exactly 1 typed + "
+              f"{args.bindings - 1} identical, got {typed} typed + "
+              f"{identical} identical")
+    if tracing.get_count("serve.batch_fallback") <= before_fb:
+        _fail("isolation pin: serve.batch_fallback never counted")
+
+    run_round("serve.worker", f"serve.worker:n=1:seed={seed}",
+              lit=0.25, expect_fired=["serve.worker"])
+
+    # deadline round: queries submitted against a paused scheduler with
+    # a deadline shorter than the pause must FAIL typed, not hang
+    typed, identical = run_round(
+        "deadline", "",
+        env={"CYLON_TPU_SERVE_DEADLINE_MS": "300"},
+        lit=0.375, scheduler_paused_s=1.0,
+    )
+    if typed != args.bindings:
+        _fail(f"deadline round: want all {args.bindings} queries typed-"
+              f"failed (QueryTimeoutError), got {typed}")
+
+    # obs.journal: the store degrades to in-memory-only; queries unharmed
+    before_jd = obsmetrics.get_count("obs.journal_degraded")
+    typed, identical = run_round(
+        "obs.journal", f"obs.journal:p=1:seed={seed}",
+        env={"CYLON_TPU_OBS_DIR": obs_dir}, lit=0.5,
+        expect_fired=["obs.journal"],
+    )
+    if typed != 0 or identical != args.bindings:
+        _fail("obs.journal round: journal degradation must not fail "
+              f"queries (got {typed} typed)")
+    if obsmetrics.get_count("obs.journal_degraded") <= before_jd:
+        _fail("obs.journal round: store never flipped to in-memory mode")
+    from cylon_tpu.obs import store as obstore
+
+    obstore.reset_stores()
+
+    # ------------------------------------------------------------------
+    # faults disabled: byte-identical + the <2% hook-overhead pin
+    # ------------------------------------------------------------------
+    os.environ.pop("CYLON_TPU_FAULTS", None)
+    fault.reset()
+    for i in range(args.bindings):
+        if canon(q3(i).collect()) != serve_oracle[i]:
+            _fail(f"faults-disabled binding {i} not identical to oracle")
+    if canon(spill_join()) != spill_oracle:
+        _fail("faults-disabled spilled join not identical to oracle")
+
+    # calibrate the disabled hook: per-check cost x a generous
+    # hooks-per-query budget must stay under 2% of the serving wall
+    reps = 200_000
+    finj = fault.inject  # sites call through the module attr: include it
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        finj.check("spill.write")
+    per_check = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    q3(0).collect()
+    q3_wall = time.perf_counter() - t0
+    overhead = per_check * HOOK_BUDGET_PER_QUERY
+    ratio = overhead / max(q3_wall, 1e-9)
+    print(f"[chaos] disabled hook: {per_check * 1e9:.0f} ns/check, "
+          f"{HOOK_BUDGET_PER_QUERY} hooks = {overhead * 1e3:.3f} ms vs "
+          f"q3 wall {q3_wall * 1e3:.1f} ms ({ratio:.2%})")
+    if ratio > 0.02:
+        _fail(f"disabled fault hooks cost {ratio:.2%} of the q3 wall at "
+              f"the {HOOK_BUDGET_PER_QUERY}-hook budget (pin: < 2%)")
+
+    shutil.rmtree(spill_dir, ignore_errors=True)
+    shutil.rmtree(obs_dir, ignore_errors=True)
+    print("CHAOS SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()

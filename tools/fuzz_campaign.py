@@ -1204,6 +1204,174 @@ def autotune_round_once(seed) -> bool:
     return ok
 
 
+def chaos_round_once(seed) -> bool:
+    """Chaos rounds (ISSUE 14): one random seam armed at a random
+    probability/kind/seed over a serving wave + a forced-spill-tier
+    join, vs the faults-disabled oracle. The invariant under test is the
+    failure model itself: every query must come back oracle-identical or
+    raise a typed CylonError (nothing else — no wrong results, no
+    untyped escapes, no hangs), and the admission leases + spill arenas
+    must be back to baseline after the round."""
+    import gc
+    import shutil
+    import tempfile
+
+    from cylon_tpu import col, fault
+    from cylon_tpu.fault import CylonError
+    from cylon_tpu.parallel import spill as spill_mod
+    from cylon_tpu.serve import ServeScheduler
+
+    rng = np.random.default_rng(seed)
+    seam = str(rng.choice(list(fault.SEAMS)))
+    kind = str(rng.choice({
+        "spill.write": ["ENOSPC", "EIO"],
+        "spill.read": ["EIO", "ENOSPC"],
+        "arena.alloc": ["ENOSPC", "ENOMEM"],
+        "serve.batch_exec": ["exec", "timeout"],
+        "serve.single_exec": ["exec", "timeout"],
+        "serve.worker": ["die", "exec"],
+        "obs.journal": ["EIO", "ENOSPC"],
+    }[seam]))
+    p = float(rng.choice([0.05, 0.3, 1.0]))
+    n_cap = rng.choice([1, 3, 0])  # 0 = uncapped
+    fseed = int(rng.integers(0, 1 << 16))
+    world = int(rng.choice([1, 4, 8]))
+    nb = int(rng.integers(2, 7))
+    tier = int(rng.choice([1, 2]))
+    retries = int(rng.choice([0, 1, 2]))
+    params = dict(seed=seed, profile="chaos", seam=seam, kind=kind, p=p,
+                  n=int(n_cap), fseed=fseed, world=world, nb=nb,
+                  tier=tier, retries=retries)
+    ctx = ctx_for(world)
+
+    def mk_pair(n_l, n_r, ks):
+        ldf = rand_frame(rng, n_l, ks, "int32", 0.0)
+        rdf = rand_frame(rng, n_r, ks, "int32", 0.0, "w").rename(
+            columns={"k": "rk"})
+        ldf["v"] = rng.integers(-50, 50, n_l).astype(np.float32)
+        rdf["w"] = rng.integers(-50, 50, n_r).astype(np.float32)
+        return (ct.Table.from_pandas(ctx, ldf), ct.Table.from_pandas(ctx, rdf))
+
+    plans = []
+    for _ in range(nb):
+        lt, rt = mk_pair(int(rng.integers(50, MAX_N)),
+                         int(rng.integers(50, MAX_N)),
+                         int(rng.integers(2, 40)))
+        plans.append(
+            lt.lazy().join(rt.lazy(), left_on="k", right_on="rk")
+            .filter(col("w") > 0.0).groupby("k", {"v": "sum"})
+        )
+    sl, sr = mk_pair(MAX_N, MAX_N, 64)
+    serve_oracle = [p_.collect().to_pandas() for p_ in plans]
+    spill_dir = tempfile.mkdtemp(prefix="cylon_fuzz_chaos_")
+    obs_dir = tempfile.mkdtemp(prefix="cylon_fuzz_chaos_obs_")
+
+    spec = f"{seam}:p={p}:kind={kind}:seed={fseed}"
+    if n_cap:
+        spec += f":n={int(n_cap)}"
+    env = {
+        "CYLON_TPU_FAULTS": spec,
+        "CYLON_TPU_SPILL_RETRIES": str(retries),
+    }
+    if seam == "obs.journal":
+        env["CYLON_TPU_OBS_DIR"] = obs_dir
+    prev = {k: os.environ.get(k) for k in env}
+    prev_tier = {
+        k: os.environ.get(k)
+        for k in ("CYLON_TPU_SPILL_TIER", "CYLON_TPU_SPILL_DIR")
+    }
+
+    def spill_join():
+        os.environ["CYLON_TPU_SPILL_TIER"] = str(tier)
+        os.environ["CYLON_TPU_SPILL_DIR"] = spill_dir
+        try:
+            return sl.distributed_join(sr, left_on=["k"], right_on=["rk"])
+        finally:
+            for k, v in prev_tier.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    spill_oracle = spill_join().to_pandas()
+    ok = True
+    for k, v in env.items():
+        os.environ[k] = v
+    fault.reset()
+    if seam == "obs.journal":
+        # the oracle collects above already instantiated the store
+        # singleton against the DEFAULT obs dir; re-create it so the
+        # armed round journals (and degrades) in the throwaway obs_dir
+        from cylon_tpu.obs import store as _obstore
+
+        _obstore.reset_stores()
+    sched = None
+    try:
+        sched = ServeScheduler(ctx, auto_start=True)
+        futs = [sched.submit(p_) for p_ in plans]
+        for i, f in enumerate(futs):
+            try:
+                got = f.result(timeout=180).to_pandas()
+            except CylonError:
+                continue  # typed failure: the legal degradation outcome
+            ok &= check(got, serve_oracle[i], f"chaos/serve[{i}]", params)
+        sched.close()
+        st = sched.stats()
+        if st["leases"] != 0 or st["inflight_bytes"] != 0:
+            print(f"MISMATCH chaos/lease_leak params={params} st={st}",
+                  flush=True)
+            ok = False
+        sched = None
+        del futs
+        gc.collect()
+        try:
+            got = spill_join().to_pandas()
+            ok &= check(got, spill_oracle, "chaos/spill_join", params)
+        except CylonError:
+            pass  # typed failure: legal
+        gc.collect()
+        live, _pk, disk, _dp = spill_mod.arena_bytes()
+        if live != 0 or disk != 0:
+            print(f"MISMATCH chaos/arena_leak params={params} "
+                  f"live={live} disk={disk}", flush=True)
+            ok = False
+    except CylonError:
+        pass  # a typed submit-time failure (scheduler closed etc.): legal
+    except Exception:
+        print(f"UNTYPED ESCAPE params={params}", flush=True)
+        traceback.print_exc()
+        ok = False
+    finally:
+        if sched is not None:
+            # an escape above jumped over close(): close NOW so the
+            # round can't leak a live worker thread (or quarantine
+            # state) into later rounds, and the lease watermark still
+            # gets enforced on the escape path
+            try:
+                sched.close()
+                st = sched.stats()
+                if st["leases"] != 0 or st["inflight_bytes"] != 0:
+                    print(f"MISMATCH chaos/lease_leak params={params} "
+                          f"st={st}", flush=True)
+                    ok = False
+            except Exception:
+                traceback.print_exc()
+                ok = False
+            sched = None
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        fault.reset()
+        from cylon_tpu.obs import store as _obstore
+
+        _obstore.reset_stores()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+        shutil.rmtree(obs_dir, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -1214,7 +1382,7 @@ def main():
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
                              "ordering", "semi", "packing", "serve",
-                             "spill", "autotune", "quant"],
+                             "spill", "autotune", "quant", "chaos"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -1240,7 +1408,13 @@ def main():
                          "tolerance/dtype-mix/world/selectivity/spill "
                          "tier) vs the CYLON_TPU_NO_QUANT=1 exact oracle "
                          "— exact key/group identity, per-column error "
-                         "bounds on float payloads")
+                         "bounds on float payloads; 'chaos': one random "
+                         "fault seam armed (random probability/kind/"
+                         "seed/retry depth, ISSUE 14) over a serving "
+                         "wave + forced-spill join vs the faults-"
+                         "disabled oracle — every query must be oracle-"
+                         "identical or typed-failed, leases/arenas back "
+                         "to baseline")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -1252,7 +1426,8 @@ def main():
           "serve": serve_round_once,
           "spill": spill_round_once,
           "autotune": autotune_round_once,
-          "quant": quant_round_once}.get(args.profile, round_once)
+          "quant": quant_round_once,
+          "chaos": chaos_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
