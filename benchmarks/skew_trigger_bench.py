@@ -1,0 +1,177 @@
+"""Skew-trigger autotune benchmark (ISSUE 15 / ROADMAP-4).
+
+Demonstrates the straggler-driven ``skew_trigger`` decision on a
+MILDLY-skewed shape (~2.2x hot/mean) — the band the static 4x-mean
+trigger ignores: under ``CYLON_TPU_PROF`` the stage clocks journal a
+per-shard straggler ratio into the observation store, the feedback
+re-coster flips ``Decisions.skew_trigger`` to 2x-mean (one recompile),
+and the relay then sheds the hot bucket's padded collective slots.
+
+Reported per regime (static trigger vs tuned):
+
+- shipped bytes per query: collective payload + the host-relay tail
+  (the adaptive plan is charged for BOTH, same accounting as
+  ``benchmarks/spill_bench.py``'s skew gate);
+- the measured straggler ratio (``prof.straggler_ratio``);
+- result equality against the ``CYLON_TPU_NO_AUTOTUNE=1`` oracle.
+
+Under ``--smoke``, exits 1 unless the tuned regime ships STRICTLY fewer
+bytes than the static trigger on this shape with oracle-identical rows
+and exactly one recompile per decision flip.
+
+Usage:
+  python benchmarks/skew_trigger_bench.py --rows 24000 --smoke
+  python benchmarks/skew_trigger_bench.py --rows 200000   # report only
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+DEVICES = ge._force_cpu_mesh(8)
+
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu.utils.tracing import get_count, get_trace_report
+
+
+def _shipped_bytes() -> int:
+    rep = get_trace_report()
+    return int(
+        rep.get("shuffle.exchanged_bytes", {}).get("rows", 0)
+        + rep.get("shuffle.spill.relay_bytes", {}).get("rows", 0)
+    )
+
+
+def _canon(t):
+    df = t.to_pandas()
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=24_000)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--warmup", type=int, default=10,
+                    help="collects to run while the evidence accumulates "
+                    "(hysteresis depth 2 -> the flip lands well inside)")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+
+    obs_dir = tempfile.mkdtemp(prefix="skew_trigger_obs_")
+    os.environ["CYLON_TPU_OBS_DIR"] = obs_dir
+    os.environ["CYLON_TPU_PROF"] = "1"
+    os.environ["CYLON_TPU_AUTOTUNE_MIN_OBS"] = "2"
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=DEVICES[: args.world])
+    )
+    rng = np.random.default_rng(7)
+    n = args.rows
+    # ~3.1x hot/mean at world=8 (hot/mean = 7x+1 for a shared fraction
+    # x): 30% of rows share one key, permuted so every source shard
+    # holds the same mix (block placement would read the full 8x)
+    nh = int(n * 0.3)
+    keys = rng.permutation(np.concatenate([
+        np.zeros(nh, np.int32),
+        rng.integers(1, n // 3, n - nh).astype(np.int32),
+    ]))
+    lt = ct.Table.from_pydict(
+        ctx, {"k": keys, "v": rng.random(n).astype(np.float32)}
+    )
+    rt = ct.Table.from_pydict(
+        ctx, {"rk": keys.copy(), "w": rng.random(n).astype(np.float32)}
+    )
+    lf = (
+        lt.lazy()
+        .join(rt.lazy(), left_on="k", right_on="rk", how="inner")
+        .groupby("k", {"v": "sum"})
+    )
+
+    m0 = get_count("plan.cache.miss")
+    per_run = []
+    for _ in range(args.warmup):
+        b0 = _shipped_bytes()
+        res = lf.collect()
+        per_run.append(_shipped_bytes() - b0)
+    misses = get_count("plan.cache.miss") - m0
+
+    from cylon_tpu.obs import store as obstore
+    from cylon_tpu.plan import feedback as fb
+    from cylon_tpu.utils.tracing import report
+
+    s = obstore.store()
+    prof = next(
+        (p for p in s.profiles.values()
+         if p.get("dec", {}).get("skew_trigger") is not None),
+        None,
+    )
+    flips = sum(p.get("flips", 0) for p in s.profiles.values())
+    strag = report("prof.").get("prof.straggler_ratio", {}).get("last")
+
+    b0 = _shipped_bytes()
+    tuned_res = _canon(lf.collect())
+    tuned_bytes = _shipped_bytes() - b0
+    with fb.autotune_disabled():
+        b0 = _shipped_bytes()
+        static_res = _canon(lf.collect())
+        static_bytes = _shipped_bytes() - b0
+
+    hot = prof["hot"] if prof else 0
+    mean = max(prof["mean_bucket"], 1) if prof else 1
+    print(f"# shape: {n} rows, world={args.world}, "
+          f"hot/mean {hot / mean:.2f}x, measured straggler "
+          f"{strag if strag is not None else float('nan'):.2f}")
+    print(f"# decision: skew_trigger="
+          f"{prof['dec']['skew_trigger'] if prof else None} "
+          f"(static {4}x-mean), flips={flips}, "
+          f"plan-cache misses={misses} (pin: 1 + flips)")
+    print(f"# bytes/query over warm-up: {per_run}")
+    print(f"# static trigger: {static_bytes} B/query   "
+          f"tuned trigger: {tuned_bytes} B/query   "
+          f"({1 - tuned_bytes / max(static_bytes, 1):.0%} fewer)")
+    identical = (
+        static_res.shape == tuned_res.shape
+        and np.array_equal(
+            static_res["k"].to_numpy(), tuned_res["k"].to_numpy()
+        )
+        and np.allclose(
+            static_res[static_res.columns[-1]].to_numpy(),
+            tuned_res[tuned_res.columns[-1]].to_numpy(),
+        )
+    )
+    print(f"# oracle-identical: {identical}")
+    _ = res
+
+    if args.smoke:
+        if prof is None or prof["dec"].get("skew_trigger") is None:
+            print("SKEW TRIGGER SMOKE FAIL: decision never flipped",
+                  file=sys.stderr)
+            return 1
+        if misses != 1 + flips:
+            print(f"SKEW TRIGGER SMOKE FAIL: {misses} plan-cache misses "
+                  f"!= 1 + {flips} flips", file=sys.stderr)
+            return 1
+        if not tuned_bytes < static_bytes:
+            print(f"SKEW TRIGGER SMOKE FAIL: tuned {tuned_bytes} B >= "
+                  f"static {static_bytes} B", file=sys.stderr)
+            return 1
+        if not identical:
+            print("SKEW TRIGGER SMOKE FAIL: tuned result differs from "
+                  "the CYLON_TPU_NO_AUTOTUNE oracle", file=sys.stderr)
+            return 1
+        print("# skew trigger smoke ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,6 +277,26 @@ SYNC_SITE_BUDGETS: Dict[str, SyncBudget] = {
     "obs.metrics.observe_latency": SyncBudget(
         0, note="lock + dict bump, pure host"
     ),
+    # the critical-path profiler (ISSUE 15): stage clocks are derived
+    # from counts the engine ALREADY fetched plus perf_counter stamps —
+    # a profiled dispatch keeps the exact same sync census as an
+    # unprofiled one (runtime twin: tools/trace_smoke.py re-runs the q3
+    # census under CYLON_TPU_PROF=1)
+    "obs.prof.record_stages": SyncBudget(
+        0, note="window + counts already host-known; numpy arithmetic "
+        "and rollup gauges only",
+    ),
+    "obs.prof.record_fused": SyncBudget(
+        0, note="dispatch-time shape-derived work units; the window "
+        "resolves later at the existing deferred count fetch",
+    ),
+    "obs.prof.finalize": SyncBudget(
+        0, note="derives pending stage seconds AFTER resolve_table "
+        "stamped the device-resolved end; adds none",
+    ),
+    "obs.prof.critical_path": SyncBudget(
+        0, note="host tree walk over an already-built span forest"
+    ),
     # the ops surface (ISSUE 12): the ledger hook every Table
     # construction pays, the query-finish stamp, the SLO evaluation and
     # the Prometheus render are all pure host dict math — a metrics

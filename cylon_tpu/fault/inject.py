@@ -23,6 +23,10 @@ SEAMS (the catalog; ``check(seam)`` sites in the engine):
 ``serve.single_exec``     one binding's single execution
 ``serve.worker``          the scheduler worker loop (thread death)
 ``obs.journal``           the observation-store journal append
+``obs.prof``              the critical-path profiler's record path
+                          (obs/prof.py): an injected failure degrades to
+                          profiling-OFF (counted ``prof.degraded``),
+                          never fails the query
 ========================  ==============================================
 
 SPEC GRAMMAR — comma-separated seam clauses, ``:``-separated fields::
@@ -95,6 +99,7 @@ SEAMS = (
     "serve.single_exec",
     "serve.worker",
     "obs.journal",
+    "obs.prof",
 )
 
 #: seams whose check() sites pass a key (a binding label) — the only
@@ -116,6 +121,7 @@ _DEFAULT_KIND = {
     "serve.single_exec": "exec",
     "serve.worker": "die",
     "obs.journal": "EIO",
+    "obs.prof": "EIO",
 }
 
 

@@ -15,7 +15,13 @@ Four modules, layered bottom-up:
   L3 budgets pin that mechanically).
 - :mod:`.export` — the bounded flight-recorder ring of the last N query
   traces and the Chrome trace-event (Perfetto-loadable) exporter, one
-  track per query.
+  track per query (plus per-shard stage tracks for profiled queries).
+- :mod:`.prof` — the critical-path profiler (ISSUE 15,
+  ``CYLON_TPU_PROF``): per-stage per-shard device stage clocks derived
+  sync-free from already-fetched counts + the deferred-fetch window,
+  the straggler ledger (``prof.straggler_ratio*``), the measured
+  overlap ledger, and longest-path attribution over span trees
+  (EXPLAIN ANALYZE "crit %", ``tools/traceview --critical``).
 - :mod:`.store` — the PERSISTENT observation journal (ISSUE 11):
   per-fingerprint profiles surviving across runs under
   ``CYLON_TPU_OBS_DIR`` (one journal per writer process — opsd, workers
@@ -38,7 +44,7 @@ pre-existing call site (``span``/``bump``/``gauge``/``report``/...)
 keeps working, and the process-global rollup keeps feeding the
 graft-lint plan registry (``analysis/plans.py``) unchanged.
 """
-from . import export, metrics, resource, slo, store, trace  # noqa: F401
+from . import export, metrics, prof, resource, slo, store, trace  # noqa: F401
 from .metrics import (  # noqa: F401
     fingerprint_key,
     latency_quantiles,
@@ -79,6 +85,7 @@ __all__ = [
     "metrics",
     "monitor",
     "observe_latency",
+    "prof",
     "prometheus_text",
     "query_trace",
     "resource",

@@ -146,6 +146,8 @@ def chrome_events(trace_list: Optional[List] = None) -> List[Dict]:
         if dev is not None:
             qargs["device_resolved_ms"] = round(dev * 1e3, 3)
         for k, v in q.attrs.items():
+            if k.startswith("__"):
+                continue  # structured carriers (e.g. prof profiles)
             qargs[k] = _json_safe(v)
         for name, (count, rows) in q.counters.items():
             qargs[f"ctr:{name}"] = count if not rows else [count, rows]
@@ -162,6 +164,57 @@ def chrome_events(trace_list: Optional[List] = None) -> List[Dict]:
                     "dur": max(sp.dur_s() * 1e6, 0.0),
                     "args": _span_args(sp),
                 })
+        events.extend(_prof_events(q, pid))
+    return events
+
+
+def _prof_events(q, pid: int) -> List[Dict]:
+    """Per-shard stage tracks of a profiled query (ISSUE 15): each
+    attached StageProfile (obs/prof.py) renders one track per shard —
+    tid ``"<qid>/s<shard>"`` — with one complete event per stage, laid
+    out in pipeline order inside the profile's measured device window.
+    Stage boundaries within the window are apportioned (the engine never
+    synced per stage — that is the point); the per-shard DURATIONS are
+    the stage clocks, so a straggler shard reads directly off the
+    timeline in Perfetto."""
+    from . import prof as _prof_mod
+
+    profiles = q.attrs.get(_prof_mod.PROF_ATTR) or []
+    events: List[Dict] = []
+    named = set()
+    for pi, p in enumerate(profiles):
+        shard_secs = p.shard_seconds()
+        if not shard_secs:
+            continue  # window never resolved (dispatched, never fetched)
+        secs = p.seconds()
+        cursor = p.t0
+        for stage in _prof_mod.STAGE_ORDER:
+            if stage not in shard_secs:
+                continue
+            per_shard = shard_secs[stage]
+            for s, dur in enumerate(per_shard):
+                tid = f"{q.qid}/s{s}"
+                if tid not in named:
+                    named.add(tid)
+                    events.append({
+                        "ph": "M", "name": "thread_name", "cat": "prof",
+                        "pid": pid, "tid": tid,
+                        "args": {
+                            "name": f"shard {s} stage clocks #{q.qid}"
+                        },
+                    })
+                events.append({
+                    "ph": "X", "name": f"prof.{stage}", "cat": "prof",
+                    "pid": pid, "tid": tid, "ts": cursor * 1e6,
+                    "dur": max(float(dur) * 1e6, 0.0),
+                    "args": {
+                        "shard": s, "kind": p.kind, "profile": pi,
+                        "straggler_ratio": round(
+                            p.stragglers().get(stage, 1.0), 3
+                        ),
+                    },
+                })
+            cursor += secs.get(stage, 0.0)
     return events
 
 
@@ -215,6 +268,8 @@ def summarize(doc: Dict) -> Dict[int, Dict]:
     ``tools/traceview.py`` and of the round-trip assertions."""
     tracks: Dict[int, Dict] = {}
     for e in doc.get("traceEvents", []):
+        if e.get("cat") == "prof":
+            continue  # per-shard stage tracks summarize separately
         tid = e.get("tid")
         t = tracks.setdefault(
             tid, {"name": "", "query_ms": 0.0, "spans": 0, "by_name": {}}
@@ -424,7 +479,10 @@ def queries_json(trace_list: Optional[List] = None) -> List[Dict]:
                 None if dev is None else round(dev * 1e3, 3)
             ),
             "thread": q.thread,
-            "attrs": {k: _json_safe(v) for k, v in q.attrs.items()},
+            "attrs": {
+                k: _json_safe(v) for k, v in q.attrs.items()
+                if not k.startswith("__")
+            },
             "counters": {
                 k: (c if not r else [c, r])
                 for k, (c, r) in q.counters.items()

@@ -304,7 +304,19 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
     "shuffle.round.": ("span", "per-round pack/collective/compact dispatch"),
     "shuffle.rounds": ("counter", "round count K per shuffle (rows=K)"),
     "shuffle.overlap_efficiency": (
-        "gauge", "fraction of exchange wall spent issuing overlapped work"),
+        "gauge", "fraction of the measured exchange device window "
+        "(dispatch-open to the deferred round-count fetch return) spent "
+        "issuing overlapped work — host assembly after the fetch is "
+        "excluded (ISSUE 15's measured overlap ledger)"),
+    "prof.": (
+        "mixed", "critical-path profiler (obs/prof.py, CYLON_TPU_PROF): "
+        "stage_ms.<stage> gauges (per-stage device stage clocks: the "
+        "measured window apportioned over per-shard work units fetched "
+        "by the existing count phase — zero added syncs) + "
+        "straggler_ratio[.<stage>] gauges (max/mean per-shard stage "
+        "time; the skew_trigger re-coster's evidence) + the degraded "
+        "counter (a profiler failure flips profiling off, never a "
+        "query)"),
     "shuffle.exchanged_bytes": (
         "counter", "global collective payload bytes per shuffle (rows="
         "K x world^2 x cap x effective row bytes)"),

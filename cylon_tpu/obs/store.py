@@ -133,6 +133,12 @@ def new_profile() -> Dict[str, Any]:
         "relay_n": 0,        # skew-split relays
         "sel_sum": 0.0,      # semi-filter selectivity accumulator
         "sel_n": 0,
+        # straggler ledger (obs/prof.py stage clocks): the max per-stage
+        # max/mean shard-time ratio per profiled execution — the
+        # skew_trigger re-coster's evidence (plan/feedback.py)
+        "strag_sum": 0.0,
+        "strag_n": 0,
+        "stages": {},        # stage -> [count, ms_sum, straggler_max]
         "sketch_built": 0,
         "payoff_skip": 0,    # static size gate declined the sketch
         "static_budget": 0,  # the ctx's untuned budget (proposal baseline)
@@ -304,6 +310,17 @@ def _absorb_record(profiles: Dict, hists: Dict, rec: Dict, seq: int) -> int:
                 p["sel_n"] += 1
         p["sketch_built"] += int(rec.get("sketch_built", 0))
         p["payoff_skip"] += int(rec.get("payoff_skip", 0))
+        # stage-clock evidence (obs/prof.py): per-stage ms + straggler
+        # ratios; the record-level max ratio drives the skew-trigger
+        # hysteresis streak (one sample per profiled exec)
+        if rec.get("strag") is not None:
+            p["strag_sum"] = p.get("strag_sum", 0.0) + float(rec["strag"])
+            p["strag_n"] = p.get("strag_n", 0) + 1
+        for stage, (ms, ratio) in (rec.get("stg") or {}).items():
+            agg = p.setdefault("stages", {}).setdefault(stage, [0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] = round(agg[1] + float(ms), 3)
+            agg[2] = max(agg[2], float(ratio))
         # footprint: device bytes the resource ledger attributed to this
         # execution (a batched exec divides by its query count, so the
         # distribution stays per-query)
@@ -691,6 +708,23 @@ class ObsStore:
                     "hot": p["hot"],
                     "staged_max": p["staged_max"],
                     "tier_max": p["tier_max"],
+                    "strag_mean": (
+                        round(
+                            p.get("strag_sum", 0.0) / p["strag_n"], 2
+                        )
+                        if p.get("strag_n") else None
+                    ),
+                    "stages": {
+                        stage: {
+                            "count": a[0],
+                            "ms": round(a[1], 3),
+                            "straggler": round(a[2], 2),
+                        }
+                        for stage, a in sorted(
+                            p.get("stages", {}).items(),
+                            key=lambda kv: -kv[1][1],
+                        )
+                    },
                     "foot_n": p.get("foot", {}).get("n", 0),
                     "foot_p95": int(
                         lat_quantile(p.get("foot") or _new_lat(), 0.95)
@@ -794,6 +828,25 @@ def note_semi(
         rec["sketch_built"] = rec.get("sketch_built", 0) + 1
     if payoff_skip:
         rec["payoff_skip"] = rec.get("payoff_skip", 0) + 1
+
+
+def note_stages(stages: Dict[str, tuple]) -> None:
+    """Fold one profiled execution's stage clocks into the active exec
+    record (obs/prof.py — seconds and ratios the profiler already
+    derived on the host): per-stage ``[ms_sum, straggler_max]`` plus the
+    record-level ``strag`` (the max per-stage max/mean shard-time ratio)
+    the ``skew_trigger`` re-coster reads. Contextvar + dict math only."""
+    rec = _EXEC.get()
+    if rec is None or not stages:
+        return
+    d = rec.setdefault("stg", {})
+    worst = rec.get("strag", 0.0)
+    for stage, (sec, ratio) in stages.items():
+        e = d.setdefault(stage, [0.0, 0.0])
+        e[0] = round(e[0] + float(sec) * 1e3, 3)
+        e[1] = max(e[1], round(float(ratio), 3))
+        worst = max(worst, float(ratio))
+    rec["strag"] = round(worst, 3)
 
 
 def note_dev_bytes(n: int) -> None:

@@ -205,6 +205,14 @@ def _maybe_finish(q: QueryTrace) -> None:
         if q.pending and q.resolved is None:
             return  # a dispatched result will resolve us at its count fetch
         q.finished = True
+    # resolve any window-pending stage-clock profiles (fused-pipeline
+    # dispatches) BEFORE the ring/export see the trace: the device-
+    # resolved end is stamped, so this is host arithmetic only — prof
+    # owns a 0-site sync budget exactly like resolve_table. Lazy import:
+    # prof imports this module for the active-trace contextvar.
+    from . import prof as _prof
+
+    _prof.finalize(q)
     _metrics.rollup_count("query.traces")
     _export.record(q)
     # persist the trace's per-node wall/rows/coll bytes when the

@@ -343,16 +343,26 @@ def plan_schedule(
     world: int,
     byte_budget: int,
     max_rounds: int = _sh.DEFAULT_MAX_ROUNDS,
+    trigger: Optional[int] = None,
 ) -> RoundSchedule:
     """The budget-driven round schedule for a measured [src, dst] count
     matrix. Non-skewed distributions return exactly ``plan_rounds``'
     (cap, K) with no relay — byte-identical plans, same compiled kernels.
 
-    Heavy buckets (above ``SKEW_MIN_RATIO`` x the mean bucket) re-plan
-    the collective rounds against the COLD histogram and relay their
-    tails through the host, but only when that cuts the cost model
-    (collective slots + ``RELAY_COST_FACTOR`` x relayed rows) by at
-    least ``SKEW_MIN_SAVINGS`` — marginal skew keeps the padded plan.
+    Heavy buckets (above ``trigger`` x the mean bucket; default the
+    static ``SKEW_MIN_RATIO`` = 4) re-plan the collective rounds against
+    the COLD histogram and relay their tails through the host, but only
+    when that cuts the cost model (collective slots +
+    ``RELAY_COST_FACTOR`` x relayed rows) by at least
+    ``SKEW_MIN_SAVINGS`` — marginal skew keeps the padded plan.
+
+    ``trigger`` is the feedback re-coster's tuned engagement ratio
+    (``Decisions.skew_trigger``, plan/feedback.py): observed straggler
+    evidence lowers it so MILD skew the 4x default ignores still sheds
+    its padded slots through the relay. Policy only — relayed rows reach
+    the same destinations, results are bit-identical either way — and
+    the tuned value rides the plan fingerprint (the Decisions component)
+    so a flip recompiles, never aliases.
     """
     cap0, k0 = _sh.plan_rounds(
         send_counts, row_bytes, world, byte_budget, max_rounds
@@ -370,7 +380,10 @@ def plan_schedule(
     if m.size == 0 or m.max() == 0:
         return base
     mean_bucket = -(-int(m.sum()) // m.size)
-    heavy_thresh = max(SKEW_MIN_RATIO * mean_bucket, 8)
+    heavy_thresh = max(
+        max(int(trigger), 1) if trigger else SKEW_MIN_RATIO, 1
+    ) * mean_bucket
+    heavy_thresh = max(heavy_thresh, 8)
     heavy_cols = m.max(axis=0) > heavy_thresh
     if not heavy_cols.any() or heavy_cols.all():
         # all-heavy == uniformly large: nothing to rebalance against

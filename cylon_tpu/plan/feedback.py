@@ -84,6 +84,15 @@ SPILL_LOW_WATER = 0.6
 #: tuned admission footprints never lease below this (mirrors the
 #: scheduler's _EST_FLOOR: zero-size queries stay countable)
 FOOTPRINT_FLOOR = 1024
+#: skew-trigger tuning (ROADMAP-4, ISSUE 15): the engagement ratio the
+#: relay drops to when the stage clocks show a straggler the static
+#: 4x-mean trigger ignores, the observed hot/mean band that counts as
+#: "mild skew the static trigger misses", and the straggler-ratio
+#: evidence floor (max/mean per-stage shard time, obs/prof.py) below
+#: which the padded plan is fine and nothing flips
+SKEW_TRIGGER_TUNED = 2
+SKEW_MILD_MIN = 1.5
+STRAGGLER_ENGAGE = 2.0
 
 
 class Decisions(NamedTuple):
@@ -101,6 +110,12 @@ class Decisions(NamedTuple):
     #: shapes admit more concurrency, over-estimated shapes stop
     #: thrashing backpressure (ROADMAP item 4's admission follow-up)
     footprint: Optional[int] = None
+    #: skew-split engagement ratio (x mean bucket) replacing the static
+    #: SKEW_MIN_RATIO=4 when the straggler ledger (obs/prof.py stage
+    #: clocks) shows a shard-time straggler on a mildly-skewed shape the
+    #: static trigger ignores; ``table._shuffle_many`` threads it into
+    #: ``spill.plan_schedule(trigger=)``
+    skew_trigger: Optional[int] = None
 
 
 DECISIONS_OFF = Decisions()
@@ -235,6 +250,11 @@ def tuned_spill_tier() -> Optional[int]:
     return d.spill_tier if d is not None else None
 
 
+def tuned_skew_trigger() -> Optional[int]:
+    d = _APPLIED.get()
+    return d.skew_trigger if d is not None else None
+
+
 # ----------------------------------------------------------------------
 # proposers + hysteresis (called by the store as observations absorb)
 # ----------------------------------------------------------------------
@@ -258,6 +278,7 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         dec.get("serve_bucket"),
         dec.get("spill_tier"),
         dec.get("footprint"),
+        dec.get("skew_trigger"),
     )
 
 
@@ -341,6 +362,16 @@ def _proposals(
             elif p.get("staged_max", 0) < SPILL_LOW_WATER * budget:
                 out["spill_tier"] = (None, True)
 
+        # -- skew trigger: engage the relay on mild skew the static
+        # 4x-mean ratio ignores, from the stage clocks' straggler
+        # evidence (ROADMAP-4's open skew-trigger item) ------------------
+        if (
+            p.get("strag_n", 0) >= m and p.get("hot", 0) > 0
+            and p.get("mean_bucket", 0) > 0 and p.get("world", 0) > 1
+        ):
+            cand, ok = _skew_trigger_proposal(p, mg)
+            out["skew_trigger"] = (cand, ok)
+
         # -- admission footprint: lease observed bytes, not the static
         # input-size estimate. The p95 of the ledger-attributed per-query
         # device bytes, pow2-rounded so the candidate is STABLE under
@@ -414,6 +445,59 @@ def _budget_proposal(p: Dict[str, Any], mg: float) -> Tuple[Any, bool]:
     return (cand, cost_cand <= cost_inc)
 
 
+def _skew_trigger_proposal(p: Dict[str, Any], mg: float) -> Tuple[Any, bool]:
+    """Candidate skew-split engagement ratio from the straggler ledger.
+
+    The static trigger relays only buckets past 4x the mean — a 2-3x
+    "mild" hot bucket still pads every collective round to its pow2 cap.
+    When the profiles show (a) the shape sits in that mild band, (b) the
+    stage clocks measured a real shard-time straggler
+    (``STRAGGLER_ENGAGE``), and (c) re-planning the observed histogram
+    under the tuned trigger actually cuts the modeled shipped cost
+    (collective slots + relay-factor x relayed rows) past the margin,
+    propose ``SKEW_TRIGGER_TUNED``. Anything else settles back to the
+    static trigger — results are identical either way (the relay is
+    routing policy), only bytes and stragglers move."""
+    from ..parallel import spill as _spill
+
+    ratio = p["hot"] / max(p["mean_bucket"], 1)
+    strag = p.get("strag_sum", 0.0) / max(p.get("strag_n", 1), 1)
+    if (
+        ratio >= _spill.SKEW_MIN_RATIO or ratio < SKEW_MILD_MIN
+        or strag < STRAGGLER_ENGAGE
+    ):
+        return (None, True)
+    from ..config import shuffle_byte_budget
+
+    world = max(int(p["world"]), 1)
+    counts = np.full(
+        (world, world), max(int(p["mean_bucket"]), 0), np.int64
+    )
+    counts[0, 0] = int(p["hot"])
+    budget = int(
+        p.get("dec", {}).get("shuffle_budget")
+        or p.get("static_budget") or shuffle_byte_budget()
+    )
+    rb = max(int(p["row_bytes"]), 1)
+    s_static = _spill.plan_schedule(counts, rb, world, budget)
+    s_tuned = _spill.plan_schedule(
+        counts, rb, world, budget, trigger=SKEW_TRIGGER_TUNED
+    )
+    if not s_tuned.adaptive:
+        return (None, True)  # the tuned trigger would not engage either
+
+    def cost(s):
+        return (
+            s.coll_row_slots(world)
+            + _spill.RELAY_COST_FACTOR * s.relay_rows()
+        )
+
+    return (
+        SKEW_TRIGGER_TUNED,
+        cost(s_tuned) <= cost(s_static) * (1.0 - mg),
+    )
+
+
 def _serve_bucket_proposal(
     p: Dict[str, Any], target: float, mg: float
 ) -> Tuple[Any, bool]:
@@ -480,5 +564,13 @@ def describe(base: tuple) -> list:
             f"admission footprint tuned: {d.footprint} B "
             f"(was input-bytes estimate, "
             f"n={p.get('foot', {}).get('n', 0)})"
+        )
+    if d.skew_trigger is not None:
+        from ..parallel.spill import SKEW_MIN_RATIO
+
+        lines.append(
+            f"skew_trigger tuned: {d.skew_trigger}x-mean "
+            f"(was {SKEW_MIN_RATIO}x-mean, "
+            f"n={p.get('strag_n', 0)})"
         )
     return lines

@@ -432,13 +432,19 @@ def _node_exclusive(sp) -> Dict:
 
 def _render_analyzed(root, q) -> str:
     """The optimized tree, each line annotated from its measured
-    ``plan.node`` span: wall/self ms, rows in->out, coll MB, gates."""
+    ``plan.node`` span: wall/self ms, rows in->out, coll MB, the
+    critical-path share (obs/prof.py longest self-time root-to-leaf
+    attribution — "crit 0%" marks a node OFF the critical path), and
+    gates."""
+    from ..obs import prof as _prof
+
     order = _lower.plan_order(root)
     by_id: Dict[int, object] = {}
     for sp in q.all_spans():
         nid = sp.attrs.get("node_id")
         if nid is not None and sp.name.startswith("plan.node."):
             by_id[nid] = sp
+    crit = _prof.node_crit_shares(q)
     lines: List[str] = []
 
     def walk(n, indent: int) -> None:
@@ -468,6 +474,8 @@ def _render_analyzed(root, q) -> str:
                     parts.append(f"rows={rows_out}")
             if agg["coll"]:
                 parts.append(f"coll={agg['coll'] / 1e6:.2f} MB")
+            if id(sp) in crit:
+                parts.append(f"crit {crit[id(sp)] * 100:.0f}%")
             if agg["gates"]:
                 parts.append(
                     "gates["

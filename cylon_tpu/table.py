@@ -54,6 +54,7 @@ from .ops import stats as _st
 from .fault import errors as _fault_errors
 from .parallel import shuffle as _sh
 from .parallel import spill as _spill
+from .obs import prof as _prof
 from .obs import resource as _obsres
 from .obs import store as _obsstore
 from .obs import trace as _obstrace
@@ -1954,6 +1955,7 @@ class Table:
                     quant_l=quant_l, quant_r=quant_r,
                 )
                 cache[key] = step
+            t0_prof = _time.perf_counter()
             with span("join.fused", rows=self._rows_hint()):
                 from .engine import record_dispatch
 
@@ -1970,6 +1972,19 @@ class Table:
                 )
                 bump("host_sync")
                 stats = _fetch(stats)  # THE host sync
+                # fused-pipeline stage clocks (obs/prof.py): the stats
+                # fetch above IS this attempt's device-resolved end, and
+                # every work unit is shape-derived — host math only
+                _prof.record_stages(
+                    "fused",
+                    _prof.fused_units(
+                        world, bucket_cap, num_slices * (1 + respill),
+                        self._rows_hint() or cap_l * world,
+                        other._rows_hint() or cap_r * world,
+                        join_cap,
+                    ),
+                    world, t0_prof, _time.perf_counter(),
+                )
             P = world
             nout_h = stats[:P].astype(np.int64)
             ov = stats[P:].reshape(-1, 2)
@@ -2074,10 +2089,25 @@ class Table:
 
             return kern
 
+        t0_prof = _time.perf_counter()
         with span("join.sum_pushdown", rows=self._rows_hint()):
             out, nout = get_kernel(self.ctx, key, build)(
                 (lflat, left.counts_dev, rflat, right.counts_dev), ()
             )
+        # stage clocks for the sync-free fused q3 kernel: dispatch-time
+        # work units attach PENDING to the active query trace; the window
+        # resolves when the deferred count fetch stamps the query's
+        # device-resolved end (obs.prof.finalize) — no sync added, the
+        # q3 dispatch census stays at exactly one fetch
+        _prof.record_fused(
+            _prof.fused_units(
+                self.ctx.world_size, 0, 1,
+                self._rows_hint() or left.shard_cap,
+                other._rows_hint() or right.shard_cap,
+                group_cap,
+            ),
+            self.ctx.world_size, t0_prof,
+        )
         cols_od: "OrderedDict[str, Column]" = OrderedDict()
         for name, srcn, (d, v) in zip(
             out_key_names, left_on, out[: len(left_on)]
@@ -3578,8 +3608,12 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
     queue, so table B's pack hides behind table A's collective even at
     K = 1. ``tracing.report()`` shows the per-phase spans
     (``shuffle.round.{pack,collective,compact}``) and the
-    ``shuffle.overlap_efficiency`` gauge = fraction of the exchange wall
-    spent issuing overlapped work rather than blocked on the device.
+    ``shuffle.overlap_efficiency`` gauge = fraction of the measured
+    device window (dispatch-open to the deferred round-count fetch
+    return) spent issuing overlapped work rather than blocked. Under
+    ``CYLON_TPU_PROF`` the profiler (obs/prof.py) additionally derives
+    per-stage per-shard stage clocks and the straggler ledger from the
+    same already-fetched counts — zero added host syncs.
     """
     # a deferred-count input materializes UP FRONT: the shuffle is host-
     # planned regardless (the count fetch below), and materialization
@@ -3684,9 +3718,15 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         # counts — non-skewed histograms return plan_rounds' own (cap, K)
         # with no relay, keeping those plans byte-identical; heavy buckets
         # shrink the collective rounds to the cold histogram and ship
-        # their over-quota tails through the host relay instead
+        # their over-quota tails through the host relay instead. The
+        # engagement ratio is the feedback re-coster's tuned trigger when
+        # the straggler ledger earned one (rides the plan fingerprint via
+        # the Decisions component), else the static 4x-mean
         w = st["world"]
-        sched = _spill.plan_schedule(st["send_counts"], row_bytes, w, budget)
+        skew_trigger = _feedback.tuned_skew_trigger()
+        sched = _spill.plan_schedule(
+            st["send_counts"], row_bytes, w, budget, trigger=skew_trigger
+        )
         st["bucket_cap"], st["n_rounds"] = sched.bucket_cap, sched.n_rounds
         st["sched"] = sched
         # bit-width-adaptive wire narrowing, gated plan-aware like the
@@ -3708,7 +3748,8 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             if wplan is not None:
                 rb_w = _g_pack.wire_row_bytes(wplan)
                 sched_w = _spill.plan_schedule(
-                    st["send_counts"], rb_w, w, budget
+                    st["send_counts"], rb_w, w, budget,
+                    trigger=skew_trigger,
                 )
                 relay_rb = _spill.RELAY_COST_FACTOR * row_bytes
                 total_wire = (
@@ -4032,6 +4073,9 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
             got_all = _fetch(
                 nouts[0] if len(nouts) == 1 else jnp.stack(nouts)
             ).reshape(len(nouts), -1).astype(np.int64)
+            # stage-clock stamp: this fetch's return IS the device-
+            # resolved end of this table's exchange (all rounds complete)
+            st["t_dev"] = _time.perf_counter()
             round_tables: List["Table"] = []
             for r, (out, _nout) in enumerate(st["rounds_out"]):
                 got = got_all[r]
@@ -4094,8 +4138,32 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                     {names[ci]: v for ci, v in st["col_stats"].items()}
                 )
             results.append(res)
-        total_s = max(_time.perf_counter() - t0, 1e-9)
-        gauge("shuffle.overlap_efficiency", (t_disp - t0) / total_s)
+        # the measured overlap ledger (ISSUE 15): the device window ends
+        # when the ONE deferred round-count fetch returned — the
+        # exchange's device-resolved end — NOT when the host finished
+        # assembling results. The old host-wall denominator counted
+        # relay fetches and table rebuilds as exchange time, so the
+        # gauge under/over-reported on async chains; the stable name and
+        # 0..1 range are unchanged (tests/test_obs.py
+        # test_overlap_gauge_excludes_host_assembly pins that host-side
+        # assembly work cannot move this gauge).
+        t_dev = max(st.get("t_dev", t_disp) for st in states)
+        window_s = max(t_dev - t0, 1e-9)
+        gauge(
+            "shuffle.overlap_efficiency",
+            min(max(t_disp - t0, 0.0) / window_s, 1.0),
+        )
+        # per-stage per-shard stage clocks (obs/prof.py): pure host
+        # arithmetic over the count matrices phase 0 already fetched and
+        # the [t0, t_dev] window stamped above — zero added syncs
+        _prof.record_shuffle(
+            [
+                (st["send_counts"], st["n_rounds"], st["bucket_cap"],
+                 st["sched"].relay)
+                for st in states
+            ],
+            states[0]["world"], t0, t_dev,
+        )
     return results
 
 

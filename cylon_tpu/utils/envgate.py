@@ -356,7 +356,8 @@ FAULTS = EnvKnob(
     "comma-separated 'seam[:p=0.05][:kind=ENOSPC][:n=3][:seed=7]"
     "[:match=substr]' clauses arming the named seams (spill.write/"
     "spill.read/arena.alloc/serve.batch_exec/serve.single_exec/"
-    "serve.worker/obs.journal). Seeded per-seam RNG: a campaign replays "
+    "serve.worker/obs.journal/obs.prof). Seeded per-seam RNG: a "
+    "campaign replays "
     "from its spec. Unset = every seam is a module-level no-op; read at "
     "import and at explicit fault.inject.refresh()/reset() — the hook "
     "is REBOUND, not re-gated per call, to keep the disabled cost at a "
@@ -393,6 +394,15 @@ TRACE = EnvKnob(
     note="=1 logs each span as it closes AND records query span trees; "
     "any other truthy value (e.g. 'tree') records the structured traces "
     "without the per-span stderr log; alters no program",
+)
+PROF = EnvKnob(
+    "CYLON_TPU_PROF", "0", kind="observability",
+    note="truthy enables the critical-path profiler (obs/prof.py): "
+    "per-stage per-shard stage clocks for the shuffle round pipeline "
+    "and the fused pipeline, derived on the host from the counts the "
+    "engine already fetched plus the existing deferred-fetch window — "
+    "zero added host syncs (graft-lint pins prof.* at 0-site budgets); "
+    "alters no compiled program",
 )
 TRACE_RING = EnvKnob(
     "CYLON_TPU_TRACE_RING", "64", kind="observability",

@@ -401,3 +401,101 @@ def test_traceview_profiles_and_diff(tmp_path, capsys):
     capsys.readouterr()
     assert tv.main(["--diff", "--obs-dir", d]) == 1
     assert "REGRESSION" in capsys.readouterr().out
+
+
+# ----------------------------------------------------------------------
+# skew-trigger tuning from the straggler ledger (ISSUE 15 / ROADMAP-4)
+# ----------------------------------------------------------------------
+def _mild_skew_pair(ctx, rng, n, vname):
+    """~2.2x hot/mean skew: 40% of rows share one key, permuted so every
+    source shard holds the same mix — the band the static 4x-mean
+    trigger ignores while the stage clocks measure a real straggler."""
+    nh = int(n * 0.4)
+    k = np.concatenate([
+        np.zeros(nh, np.int32),
+        rng.integers(1, n // 3, n - nh).astype(np.int32),
+    ])
+    k = rng.permutation(k)
+    lt = ct.Table.from_pydict(
+        ctx, {"k": k, vname: rng.random(n).astype(np.float32)}
+    )
+    rt = ct.Table.from_pydict(
+        ctx, {"rk": k.copy(), "w": rng.random(n).astype(np.float32)}
+    )
+    return lt, rt
+
+
+def test_skew_trigger_flips_once_and_matches_oracle(
+    ctx4, rng, obs_env, monkeypatch
+):
+    """The tuned skew_trigger decision: observed straggler evidence (the
+    stage clocks' max/mean shard-time ratio) flips the relay engagement
+    ratio from the static 4x-mean to 2x on a mildly-skewed shape, with
+    exactly one recompile per flip, strictly fewer shipped bytes after
+    the flip, and bit-identical results to the CYLON_TPU_NO_AUTOTUNE
+    oracle."""
+    from cylon_tpu.obs import prof as obs_prof
+
+    monkeypatch.setenv("CYLON_TPU_PROF", "1")
+    obs_prof.reset()
+    lt, rt = _mild_skew_pair(ctx4, rng, 12_000, "sk")
+    lf = _plan(lt, rt, "sk")
+    m0 = tracing.get_count("plan.cache.miss")
+    bytes_per_run = []
+    for _ in range(10):
+        b0 = tracing.get_trace_report().get(
+            "shuffle.exchanged_bytes", {}
+        ).get("rows", 0)
+        lf.collect()
+        b1 = tracing.get_trace_report()["shuffle.exchanged_bytes"]["rows"]
+        bytes_per_run.append(b1 - b0)
+    s = obs_store.store()
+    profs = [
+        p for p in s.profiles.values()
+        if p.get("dec", {}).get("skew_trigger") is not None
+    ]
+    assert profs, "the straggler evidence never tuned a skew_trigger"
+    p = profs[0]
+    assert p["dec"]["skew_trigger"] == fb.SKEW_TRIGGER_TUNED
+    # straggler evidence was measured, and the shape sits in the mild
+    # band the static trigger ignores
+    assert p["strag_n"] >= 2
+    assert p["strag_sum"] / p["strag_n"] >= fb.STRAGGLER_ENGAGE
+    ratio = p["hot"] / max(p["mean_bucket"], 1)
+    assert fb.SKEW_MILD_MIN <= ratio < 4.0, ratio
+    # exactly one recompile per recorded flip (the fingerprint pin)
+    flips = sum(q.get("flips", 0) for q in s.profiles.values())
+    assert tracing.get_count("plan.cache.miss") - m0 == 1 + flips
+    # the tuned trigger ships strictly fewer bytes than the static one
+    assert bytes_per_run[-1] < bytes_per_run[0], bytes_per_run
+    # the decision rides the fingerprint component
+    dec = gated_fingerprint(lf.plan)[-1][1]
+    assert dec.skew_trigger == fb.SKEW_TRIGGER_TUNED
+    # differential oracle: results identical to the static-trigger run
+    with fb.autotune_disabled():
+        want = lf.collect().to_pandas().sort_values("k").reset_index(
+            drop=True
+        )
+    got = lf.collect().to_pandas().sort_values("k").reset_index(drop=True)
+    assert np.array_equal(got["k"].to_numpy(), want["k"].to_numpy())
+    assert np.allclose(
+        got[got.columns[-1]].to_numpy(), want[want.columns[-1]].to_numpy()
+    )
+
+
+def test_skew_trigger_stays_static_without_straggler_evidence(
+    ctx4, rng, obs_env, monkeypatch
+):
+    """No profiler = no straggler evidence = no skew_trigger flip (the
+    proposer demands measured shard-time ratios, not just a histogram),
+    and a >=4x shape keeps the static trigger (it already fires)."""
+    monkeypatch.delenv("CYLON_TPU_PROF", raising=False)
+    lt, rt = _mild_skew_pair(ctx4, rng, 8_000, "sk2")
+    lf = _plan(lt, rt, "sk2")
+    for _ in range(5):
+        lf.collect()
+    s = obs_store.store()
+    assert all(
+        p.get("dec", {}).get("skew_trigger") is None
+        for p in s.profiles.values()
+    )

@@ -21,6 +21,7 @@ Covers the tentpole surface end to end:
 import gc
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -391,3 +392,243 @@ def test_profile_smoke(local_ctx, tmp_path):
         os.path.join(r, f) for r, _dirs, fs in os.walk(d) for f in fs
     ]
     assert produced, "jax.profiler must have written a trace"
+
+# ----------------------------------------------------------------------
+# critical-path profiler (ISSUE 15): stage clocks, straggler ledger,
+# critical-path reports, the measured overlap ledger, fault degradation
+# ----------------------------------------------------------------------
+@pytest.fixture
+def profiled(monkeypatch, traced):
+    """Profiler + structured tracing on, re-armed, fresh rollup."""
+    from cylon_tpu.obs import prof as obs_prof
+
+    monkeypatch.setenv("CYLON_TPU_PROF", "1")
+    obs_prof.reset()
+    tracing.reset_trace()
+    yield
+    obs_prof.reset()
+
+
+def test_stage_clocks_uniform_vs_one_hot(ctx8, rng, profiled):
+    """The straggler ledger separates a one-hot 8-way shuffle (compact /
+    relay ratio = world) from a uniform one (ratio ~1); stage-clock
+    annotations land on the exchange span."""
+    n = 8000
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 2000, n).astype(np.int32)}
+    )
+    t.shuffle(["k"])
+    rep = tracing.report("prof.")
+    assert rep["prof.straggler_ratio"]["last"] < 1.5
+    assert "prof.stage_ms.pack" in rep
+    tracing.reset_trace()
+    obs_export.reset_ring()
+    hot = ct.Table.from_pydict(ctx8, {"k": np.zeros(n, np.int32)})
+    hot.shuffle(["k"])
+    rep = tracing.report("prof.")
+    assert rep["prof.straggler_ratio"]["last"] > 3.0
+    # the measured clocks annotate the owning exchange span
+    q = [q for q in obs_export.traces() if q.kind == "op"][-1]
+    ex = next(sp for sp in q.all_spans() if sp.name == "shuffle.exchange")
+    assert any(k.startswith("prof_") and k.endswith("_ms") for k in ex.attrs)
+    assert ex.attrs["prof_straggler"] > 3.0
+
+
+def test_disabled_profiler_records_nothing(ctx8, rng, traced, monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_PROF", raising=False)
+    tracing.reset_trace()
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 50, 512).astype(np.int32)}
+    )
+    t.shuffle(["k"])
+    assert not tracing.report("prof.")
+    q = [q for q in obs_export.traces() if q.kind == "op"][-1]
+    from cylon_tpu.obs import prof as obs_prof
+
+    assert obs_prof.PROF_ATTR not in q.attrs
+
+
+def test_overlap_gauge_excludes_host_assembly(ctx8, rng, monkeypatch):
+    """The measured overlap ledger: the gauge's denominator ends at the
+    deferred round-count fetch return, so host-side assembly AFTER the
+    fetch (here: an injected delay in the post-fetch ordering stamp)
+    cannot drag the efficiency toward zero — the exact bug of the old
+    host-wall proxy, which divided by the full assembly wall."""
+    import time as _t
+
+    from cylon_tpu.parallel import shuffle as psh
+
+    t = ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 40, 1024).astype(np.int32)}
+    )
+    t.shuffle(["k"])  # warm the kernels: dispatch wall ~ device wall
+    real = psh.ordering_after_shuffle
+    delay = 0.6
+
+    def slow(kind):
+        _t.sleep(delay)  # post-fetch host assembly work
+        return real(kind)
+
+    monkeypatch.setattr(psh, "ordering_after_shuffle", slow)
+    import cylon_tpu.table as table_mod
+
+    monkeypatch.setattr(table_mod._sh, "ordering_after_shuffle", slow)
+    tracing.reset_trace()
+    t0 = time.perf_counter()
+    t.shuffle(["k"])
+    wall = time.perf_counter() - t0
+    assert wall >= delay  # the delay really ran inside the shuffle
+    eff = tracing.report("shuffle.")["shuffle.overlap_efficiency"]["last"]
+    # warm tiny shuffle: issuing overlaps nearly the whole device window.
+    # Under the old proxy the injected second lands in the denominator
+    # and eff collapses under wall_disp / (wall_disp + 1 s) ~= 0.05.
+    assert eff > 0.25, eff
+    assert 0.0 <= eff <= 1.0
+
+
+def test_fused_stage_clocks_resolve_deferred(ctx8, rng, profiled):
+    """A fused q3 dispatch attaches window-PENDING stage clocks that
+    resolve when the deferred count fetch stamps the query end — and the
+    Chrome export then carries per-shard prof.* stage tracks."""
+    lf = _q3(ctx8, rng)
+    lf.collect()  # compile
+    obs_export.reset_ring()
+    lf.collect()
+    qs = [q for q in obs_export.traces() if q.kind == "plan"]
+    assert len(qs) == 1
+    from cylon_tpu.obs import prof as obs_prof
+
+    profs = qs[0].attrs.get(obs_prof.PROF_ATTR)
+    assert profs, "fused dispatch must attach a stage profile"
+    assert all(p.window_s is not None for p in profs), "finalize must run"
+    doc = obs_export.chrome_doc()
+    assert not obs_export.validate_chrome(doc)
+    stage_events = [
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "X" and str(e["name"]).startswith("prof.")
+    ]
+    assert len(stage_events) >= ctx8.world_size
+    # prof shard tracks must NOT leak into the per-query summary
+    tracks = obs_export.summarize(doc)
+    assert all(isinstance(tid, int) for tid in tracks)
+    # rollup gauges landed at finalize time
+    assert "prof.stage_ms.pack" in tracing.report("prof.")
+
+
+def test_prof_fault_seam_degrades_not_fails(ctx8, rng, monkeypatch):
+    """An armed obs.prof seam degrades the profiler to OFF (counted
+    prof.degraded) and the query is unaffected."""
+    from cylon_tpu import fault
+    from cylon_tpu.obs import prof as obs_prof
+
+    monkeypatch.setenv("CYLON_TPU_PROF", "1")
+    monkeypatch.setenv("CYLON_TPU_FAULTS", "obs.prof:p=1")
+    fault.reset()
+    obs_prof.reset()
+    c0 = obs_metrics.get_count("prof.degraded")
+    try:
+        t = ct.Table.from_pydict(
+            ctx8, {"k": rng.integers(0, 30, 1024).astype(np.int32)}
+        )
+        res = t.shuffle(["k"])
+        assert res.row_count == 1024  # the query survived
+        assert fault.inject.fired("obs.prof") >= 1
+        assert obs_metrics.get_count("prof.degraded") == c0 + 1
+        assert obs_prof.degraded()
+        assert not obs_prof.profiling_active()
+    finally:
+        monkeypatch.delenv("CYLON_TPU_FAULTS")
+        fault.reset()
+        obs_prof.reset()
+
+
+def test_explain_analyze_crit_column(ctx8, rng):
+    """explain(analyze=True) prints a critical-path share per node, and
+    the shares on the critical path sum to ~100%."""
+    import re
+
+    text = _q3(ctx8, rng, salt=0.222).explain(analyze=True)
+    shares = [int(m) for m in re.findall(r"crit (\d+)%", text)]
+    assert shares, text
+    assert 90 <= sum(shares) <= 110  # off-path nodes print crit 0%
+
+
+def test_traceview_critical_report(ctx8, rng, profiled, tmp_path, capsys):
+    """traceview --critical names the bottleneck stage: a skew-side
+    stage (relay/collective) on the one-hot shape, a local stage
+    (pack/compact) on the uniform shape."""
+    import tools.traceview as tv
+
+    n = 8000
+    out = {}
+    for name, keys in (
+        ("uniform", rng.integers(0, 2000, n).astype(np.int32)),
+        ("one-hot", np.zeros(n, np.int32)),
+    ):
+        obs_export.reset_ring()
+        ct.Table.from_pydict(ctx8, {"k": keys}).shuffle(["k"])
+        path = str(tmp_path / f"{name}.json")
+        obs_export.write_chrome(path)
+        assert tv.main([path, "--critical"]) == 0
+        out[name] = capsys.readouterr().out
+        assert "bottleneck stage:" in out[name]
+        assert "measured stage clocks" in out[name]
+    assert re_bottleneck(out["one-hot"]) in ("relay", "collective")
+    assert re_bottleneck(out["uniform"]) in ("pack", "compact")
+
+
+def re_bottleneck(text):
+    """The bottleneck stage of the MEASURED (stage-clock) track — an
+    eager shuffle also records a count-phase op trace whose span-wall
+    fold reports 'count'."""
+    import re
+
+    m = re.search(r"bottleneck stage: (\w+) \([^)]*measured", text)
+    return m.group(1) if m else None
+
+
+def test_traceview_critical_unprofiled_fallback(ctx8, rng, traced,
+                                                tmp_path, capsys):
+    """--critical on an UNPROFILED trace falls back to the span-wall
+    fold and still reports a path + stage ranking."""
+    import tools.traceview as tv
+
+    obs_export.reset_ring()
+    ct.Table.from_pydict(
+        ctx8, {"k": rng.integers(0, 40, 2048).astype(np.int32)}
+    ).shuffle(["k"])
+    path = str(tmp_path / "plain.json")
+    obs_export.write_chrome(path)
+    assert tv.main([path, "--critical"]) == 0
+    text = capsys.readouterr().out
+    assert "bottleneck stage:" in text
+    assert "span-wall fold" in text
+
+
+def test_traceview_live_renders_ops_endpoint(ctx8, rng, capsys):
+    """--live renders a running ops endpoint (healthz + /metrics +
+    flight ring) and exits 0; an unreachable endpoint exits 1."""
+    import tools.traceview as tv
+
+    srv = obs_export.OpsServer(0)
+    port = srv.start()
+    try:
+        assert tv.main(["--live", f"http://127.0.0.1:{port}"]) == 0
+        text = capsys.readouterr().out
+        assert "healthz:" in text
+    finally:
+        srv.stop()
+    assert tv.main(["--live", "http://127.0.0.1:9"]) == 1
+    assert "unreachable" in capsys.readouterr().err
+
+
+def test_prof_metrics_all_declared(ctx8, rng, profiled):
+    """Everything a profiled one-hot shuffle emits stays covered by the
+    stable-name table."""
+    tracing.reset_trace()
+    ct.Table.from_pydict(ctx8, {"k": np.zeros(4096, np.int32)}).shuffle(["k"])
+    undeclared = [
+        name for name in tracing.get_trace_report()
+        if not obs_metrics.is_declared(name)
+    ]
+    assert not undeclared, undeclared

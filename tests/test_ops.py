@@ -101,6 +101,10 @@ def _pair(ctx, rng, n, vname="v"):
 # ----------------------------------------------------------------------
 def test_ledger_tracks_device_bytes_and_peak(ctx8, rng, ledger_on):
     led = obs_resource.ledger(ctx8)
+    # flush cycle garbage earlier tests left (plans/traces whose tables
+    # die only at a gc pass): the baseline below must measure a settled
+    # ledger, not whenever the collector last happened to run
+    gc.collect()
     base = led.snapshot()["device_bytes"]
     t = _mk(ctx8, rng, 4096)
     snap = led.snapshot()

@@ -308,6 +308,27 @@ def main() -> None:
 
     obstore.reset_stores()
 
+    # obs.prof: a profiler failure degrades to profiling-OFF (counted
+    # prof.degraded) — queries unharmed, results oracle-identical
+    from cylon_tpu.obs import prof as obsprof
+
+    obsprof.reset()
+    before_pd = obsmetrics.get_count("prof.degraded")
+    typed, identical = run_round(
+        "obs.prof", f"obs.prof:p=1:seed={seed}",
+        env={"CYLON_TPU_PROF": "1"}, lit=0.625,
+        expect_fired=["obs.prof"],
+    )
+    if typed != 0 or identical != args.bindings:
+        _fail("obs.prof round: profiler degradation must not fail "
+              f"queries (got {typed} typed)")
+    if obsmetrics.get_count("prof.degraded") <= before_pd:
+        _fail("obs.prof round: profiler never counted prof.degraded")
+    if not obsprof.degraded():
+        _fail("obs.prof round: a failed profiler must degrade to "
+              "profiling-off for the process")
+    obsprof.reset()
+
     # ------------------------------------------------------------------
     # faults disabled: byte-identical + the <2% hook-overhead pin
     # ------------------------------------------------------------------

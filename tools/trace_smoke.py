@@ -1,4 +1,4 @@
-"""trace-smoke: the CI observability gate (ISSUE 8).
+"""trace-smoke: the CI observability gate (ISSUEs 8 + 15).
 
 Runs the plan-bench q3 shape (filter -> join -> groupby-SUM) on the
 8-virtual-device CPU mesh and asserts, in one process:
@@ -11,15 +11,25 @@ Runs the plan-bench q3 shape (filter -> join -> groupby-SUM) on the
    performs exactly the contract's host syncs (1, at result fetch,
    attributed to ``_materialize_counts``): the runtime twin of the
    graft-lint L3 budgets, re-using ``analysis/plans.run_q3_dispatch``
-   under ``CYLON_TPU_TRACE``.
+   under ``CYLON_TPU_TRACE``. Re-run under ``CYLON_TPU_PROF=1`` too:
+   the stage-clock profiler must leave the census bit-identical
+   (profiling adds ZERO host syncs — ISSUE 15's acceptance pin).
 3. OVERHEAD — the DISABLED tracer costs < 2% of the q3 collect wall:
    measured as (per-disabled-span cost x instrumentation events per
    query), where the event count comes from a traced run of the same
    query and the per-span cost from a calibration loop. This form is
    deterministic where a direct A/B wall-clock diff on a CI box is
-   noise-bound. The pin EXTENDS to the resource ledger (ISSUE 12): the
-   disabled ``obs.resource.note_table`` check every Table construction
-   pays is calibrated the same way and folded into the same budget.
+   noise-bound. The pin EXTENDS to the resource ledger (ISSUE 12) and
+   the profiler (ISSUE 15): the disabled ``obs.resource.note_table``
+   check every Table construction pays and the disabled
+   ``obs.prof.profiling_active`` guard every shuffle/fused dispatch
+   pays are calibrated the same way and folded into the same budget.
+4. STRAGGLER — under ``CYLON_TPU_PROF=1``, a one-hot 8-way shuffle must
+   report a per-stage shard-time straggler ratio > 3x while the uniform
+   shape reports < 1.5x, the Chrome export must carry the per-shard
+   ``prof.*`` stage tracks (schema-validated), and the critical report
+   must name a skew-side bottleneck stage (collective/relay) on the
+   one-hot shape vs a local stage (pack/compact) on the uniform one.
 
 Usage: python tools/trace_smoke.py [--rows 50000] [--out trace_q3.json]
 Exit status: 0 ok, 1 gate failure.
@@ -127,8 +137,23 @@ def main() -> None:
                       "['_materialize_counts']")
         print("# census ok: q3 dispatch = exactly 1 host sync at "
               "_materialize_counts with the tracer enabled")
+
+        # ---- 2b. the same census under the ENABLED profiler -----------
+        # (ISSUE 15 pin: stage clocks ride already-made fetches; a
+        # profiled dispatch must not add a single sync site)
+        os.environ["CYLON_TPU_PROF"] = "1"
+        for res in plans.run_q3_dispatch(ctx, np.random.default_rng(7)):
+            if res.violations:
+                _fail("q3 dispatch census under profiler: "
+                      + "; ".join(res.violations))
+            if res.sync_sites != ["_materialize_counts"]:
+                _fail(f"q3 dispatch sync sites under CYLON_TPU_PROF "
+                      f"{res.sync_sites} != ['_materialize_counts']")
+        print("# census ok: q3 dispatch census unchanged under "
+              "CYLON_TPU_PROF=1 (profiling adds zero host syncs)")
     finally:
         os.environ.pop("CYLON_TPU_TRACE", None)
+        os.environ.pop("CYLON_TPU_PROF", None)
 
     # ---- 3. disabled-tracer + disabled-ledger overhead gate -----------
     calib = 20_000
@@ -150,17 +175,107 @@ def main() -> None:
     for _ in range(calib):
         obs_resource.note_table(dummy)
     per_note = (time.perf_counter() - t0) / calib
-    overhead = per_span * n_events + per_note * n_events
+    # the profiler's disabled path: one profiling_active() guard per
+    # shuffle / fused dispatch (a handful per query) — calibrated like
+    # the others and bounded by the same generous event count
+    from cylon_tpu.obs import prof as obs_prof
+
+    assert not obs_prof.profiling_active(), "probe needs the profiler off"
+    t0 = time.perf_counter()
+    for _ in range(calib):
+        obs_prof.profiling_active()
+    per_guard = (time.perf_counter() - t0) / calib
+    overhead = (per_span + per_note + per_guard) * n_events
     ratio = overhead / max(t_query, 1e-9)
     print(f"# overhead: {n_events} instrumentation events/query x "
           f"({per_span * 1e6:.2f} us disabled-span + "
-          f"{per_note * 1e6:.2f} us disabled-ledger-note cost) = "
+          f"{per_note * 1e6:.2f} us disabled-ledger-note + "
+          f"{per_guard * 1e6:.2f} us disabled-profiler-guard cost) = "
           f"{overhead * 1e3:.3f} ms = {100 * ratio:.3f}% of the "
           f"{t_query * 1e3:.1f} ms q3 collect")
     if ratio >= args.overhead_gate:
         _fail(f"disabled-tracer overhead {100 * ratio:.2f}% >= "
               f"{100 * args.overhead_gate:.0f}% gate")
+
+    # ---- 4. straggler ledger gate (ISSUE 15) --------------------------
+    _straggler_gate(ctx, args)
     print("# trace smoke ok")
+
+
+def _straggler_gate(ctx, args) -> None:
+    """One-hot 8-way vs uniform shuffle under the ENABLED profiler: the
+    straggler ledger must separate them (>3x vs <1.5x), the Chrome
+    export must carry the per-shard prof.* stage tracks, and the
+    critical report must name a skew-side bottleneck stage on the
+    one-hot shape vs a local one on the uniform shape."""
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.obs import export as obs_export
+    from cylon_tpu.obs import prof as obs_prof
+    from cylon_tpu.utils import tracing
+
+    os.environ["CYLON_TPU_TRACE"] = "tree"
+    os.environ["CYLON_TPU_PROF"] = "1"
+    obs_prof.reset()
+    rng = np.random.default_rng(3)
+    n = max(args.rows // 2, 4_000)
+    shapes = {
+        "uniform": rng.integers(0, n // 4 or 1, n).astype(np.int32),
+        "one-hot": np.zeros(n, np.int32),
+    }
+    reports = {}
+    try:
+        for name, keys in shapes.items():
+            obs_export.reset_ring()
+            t = ct.Table.from_pydict(
+                ctx, {"k": keys, "v": rng.normal(size=n).astype(np.float32)}
+            )
+            t.shuffle(["k"])
+            rep = tracing.report("prof.")
+            if "prof.straggler_ratio" not in rep:
+                _fail(f"{name}: profiled shuffle emitted no "
+                      "prof.straggler_ratio gauge")
+            ratio = rep["prof.straggler_ratio"]["last"]
+            out = args.out.replace(".json", f"_prof_{name}.json")
+            n_ev = obs_export.write_chrome(out)
+            doc = obs_export.load_chrome(out)
+            problems = obs_export.validate_chrome(doc)
+            if problems:
+                _fail(f"{name}: prof export schema: "
+                      + "; ".join(problems[:5]))
+            stage_tracks = [
+                e for e in doc["traceEvents"]
+                if e.get("ph") == "X"
+                and str(e.get("name", "")).startswith("prof.")
+            ]
+            if len(stage_tracks) < args.world:
+                _fail(f"{name}: expected per-shard prof.* stage tracks "
+                      f"in the export, found {len(stage_tracks)}")
+            qs = [q for q in obs_export.traces() if q.kind == "op"]
+            crit = obs_prof.critical_report(
+                doc["traceEvents"], qs[-1].qid
+            ) if qs else None
+            bottleneck = (crit or {}).get("bottleneck")
+            reports[name] = (ratio, bottleneck, n_ev)
+        uni_ratio, uni_stage, _ = reports["uniform"]
+        hot_ratio, hot_stage, _ = reports["one-hot"]
+        print(f"# straggler: one-hot ratio {hot_ratio:.2f} "
+              f"(bottleneck {hot_stage}) vs uniform {uni_ratio:.2f} "
+              f"(bottleneck {uni_stage})")
+        if not hot_ratio > 3.0:
+            _fail(f"one-hot straggler ratio {hot_ratio:.2f} <= 3x")
+        if not uni_ratio < 1.5:
+            _fail(f"uniform straggler ratio {uni_ratio:.2f} >= 1.5x")
+        if hot_stage not in ("relay", "collective"):
+            _fail(f"one-hot bottleneck stage {hot_stage!r} is not a "
+                  "skew-side stage (relay/collective)")
+        if uni_stage not in ("pack", "compact"):
+            _fail(f"uniform bottleneck stage {uni_stage!r} is not a "
+                  "local stage (pack/compact)")
+    finally:
+        os.environ.pop("CYLON_TPU_TRACE", None)
+        os.environ.pop("CYLON_TPU_PROF", None)
 
 
 if __name__ == "__main__":

@@ -8,6 +8,12 @@ visual timeline; this tool is the terminal summary for the same file::
     python -m tools.traceview trace.json            # per-query summary
     python -m tools.traceview trace.json --tree     # span trees
     python -m tools.traceview trace.json --top 10   # widen the hot list
+    python -m tools.traceview trace.json --critical # bottleneck report:
+        # longest self-time root->leaf path over the plan.node spans
+        # (the EXPLAIN ANALYZE "crit %" offline twin) plus the
+        # bottleneck STAGE — from the measured prof_* stage clocks when
+        # the run was profiled (CYLON_TPU_PROF), else folded from the
+        # per-round span families' host walls
     python -m tools.traceview trace.json --serving  # per-fingerprint
         # serving rollup: a flight ring dumped from a LOADED server holds
         # hundreds of near-identical query tracks — this groups them by
@@ -130,6 +136,34 @@ def _print_serving(tracks) -> None:
             )
         for k, v in sorted(g["ctrs"].items()):
             print(f"    {k}: {v}")
+
+
+def _print_critical(doc, tracks) -> None:
+    """Per-track critical-path + bottleneck-stage report
+    (obs.prof.critical_report over the exported events)."""
+    from cylon_tpu.obs import prof as obs_prof
+
+    events = doc.get("traceEvents", [])
+    for tid in sorted(tracks):
+        t = tracks[tid]
+        rep = obs_prof.critical_report(events, tid)
+        if rep is None:
+            continue
+        print(f"\n[{tid}] {t['name']}: {t['query_ms']:.2f} ms")
+        if rep.get("path"):
+            print(f"  critical path ({rep['total_ms']:.2f} ms):")
+            for name, self_ms, share in rep["path"]:
+                print(f"    {name}: {self_ms:.2f} ms  "
+                      f"crit {share * 100:.0f}%")
+        stages = rep.get("stages_ms") or {}
+        if stages:
+            src = ("measured stage clocks" if rep["measured"]
+                   else "span-wall fold (unprofiled run)")
+            ranked = sorted(stages.items(), key=lambda kv: -kv[1])
+            print(f"  bottleneck stage: {rep['bottleneck']} "
+                  f"({ranked[0][1]:.2f} ms; {src})")
+            for stage, ms in ranked[1:]:
+                print(f"    {stage}: {ms:.2f} ms")
 
 
 def _open_store(obs_dir):
@@ -328,6 +362,10 @@ def main(argv=None) -> int:
                     help="Chrome trace JSON (obs.write_chrome); omitted "
                     "for the store modes (--profiles / --diff)")
     ap.add_argument("--tree", action="store_true", help="print span trees")
+    ap.add_argument("--critical", action="store_true",
+                    help="critical-path + bottleneck-stage report per "
+                    "query track (measured prof_* stage clocks when the "
+                    "run was profiled, span-wall fold otherwise)")
     ap.add_argument("--top", type=int, default=5,
                     help="hottest span names per query (default 5)")
     ap.add_argument("--serving", action="store_true",
@@ -399,6 +437,10 @@ def main(argv=None) -> int:
         return 0
     if args.serving:
         _print_serving(tracks)
+        return 0
+    if args.critical:
+        print(f"{len(tracks)} query trace(s) in {args.trace}")
+        _print_critical(doc, tracks)
         return 0
     print(f"{len(tracks)} query trace(s) in {args.trace}")
     for tid in sorted(tracks):
