@@ -81,10 +81,13 @@ from .fault import (  # noqa: E402
     QueryTimeoutError,
     SchedulerClosedError,
     SpillIOError,
+    StreamIngestError,
     WorkerDiedError,
 )
 from . import serve  # noqa: E402
 from .serve import QueryFuture, ServeOverloadError  # noqa: E402
+from . import stream  # noqa: E402
+from .stream import AppendableTable, IncrementalView, Subscription  # noqa: E402
 from .indexing.index import (  # noqa: E402
     CategoricalIndex,
     HashIndex,
@@ -137,9 +140,14 @@ __all__ = [
     "SchedulerClosedError",
     "ServeOverloadError",
     "SpillIOError",
+    "StreamIngestError",
     "WorkerDiedError",
     "fault",
     "serve",
+    "stream",
+    "AppendableTable",
+    "IncrementalView",
+    "Subscription",
     "Table",
     "concat",
     "dtypes",

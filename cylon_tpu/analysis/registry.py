@@ -23,6 +23,20 @@ EXEMPT: Dict[Tuple[str, str], str] = {
         "logging and query-trace recording in obs/trace.py; no traced "
         "program or key decision reads it"
     ),
+    ("stream.ingest._chunk_rows", "CYLON_TPU_STREAM_CHUNK_ROWS"): (
+        "host-side staging only: bounds the per-copy working set of "
+        "AppendableTable ingest (numpy slices into the HostArena) and "
+        "never reaches a kernel shape or key; the only kernel-body "
+        "'reachability' is the analyzer's unique-method fallback "
+        "resolving ubiquitous list.append() calls to "
+        "AppendableTable.append — a false edge, audited here"
+    ),
+    ("stream.ingest._state_budget", "CYLON_TPU_STREAM_STATE_BUDGET"): (
+        "host-side admission only: caps AppendableTable state bytes "
+        "before any arena write (typed StreamIngestError past it) and "
+        "never reaches a kernel; kernel-body 'reachability' is the same "
+        "list.append() unique-method false edge as the chunk knob"
+    ),
 }
 
 

@@ -10,6 +10,7 @@ from .errors import (  # noqa: F401
     QueryTimeoutError,
     SchedulerClosedError,
     SpillIOError,
+    StreamIngestError,
     WorkerDiedError,
 )
 from . import inject  # noqa: F401

@@ -107,6 +107,27 @@ class WorkerDiedError(CylonError):
     scope = SCOPE_QUERY
 
 
+class StreamIngestError(CylonError, RuntimeError):
+    """A streaming append failed past the state-store's degradation
+    paths: the host-arena write raised through its ladder, the
+    ``CYLON_TPU_STREAM_STATE_BUDGET`` byte budget would be exceeded, or
+    the batch failed schema validation. The append is ROLLED BACK — the
+    table's prior generation (watermark, arena rows, snapshots) is
+    untouched and still queryable; only the offered batch is lost.
+    ``scope="table"``: the failure names one appendable table, not the
+    context. ``retryable``: transient causes (ENOSPC on the spill
+    volume, a momentarily full budget) may clear; a schema mismatch will
+    not, but re-offering after fixing the batch is the same call."""
+
+    retryable = True
+    scope = SCOPE_TABLE
+
+    def __init__(self, what: str = "stream ingest failed",
+                 cause: Optional[BaseException] = None):
+        super().__init__(what if cause is None else f"{what}: {cause}")
+        self.what = what
+
+
 class SchedulerClosedError(CylonError, RuntimeError):
     """The serving scheduler was closed with this query still pending
     (or a submit raced ``close()``). ``scope="context"``: this scheduler

@@ -27,6 +27,16 @@ SEAMS (the catalog; ``check(seam)`` sites in the engine):
                           (obs/prof.py): an injected failure degrades to
                           profiling-OFF (counted ``prof.degraded``),
                           never fails the query
+``stream.append``         the streaming ingest path (stream/ingest.py):
+                          fires between schema validation and the
+                          state-arena write, inside the ingest module's
+                          ``except OSError`` ladder — an injection rolls
+                          the append back (typed ``StreamIngestError``,
+                          prior generation still queryable)
+``stream.refresh``        one incremental-view refresh (stream/delta.py)
+                          before the delta plan dispatches: an injection
+                          surfaces typed with the view's retained state
+                          (prev snapshots, prev result) untouched
 ========================  ==============================================
 
 SPEC GRAMMAR — comma-separated seam clauses, ``:``-separated fields::
@@ -38,8 +48,9 @@ SPEC GRAMMAR — comma-separated seam clauses, ``:``-separated fields::
                   only kinds valid on the I/O seams — their sites sit
                   inside `except OSError` degradation ladders), or
                   exec | timeout | die (typed CylonError family;
-                  serve.* seams only); default per seam (spill/arena/
-                  obs -> the natural errno, serve.* -> exec,
+                  serve.* and stream.refresh only); default per seam
+                  (spill/arena/obs/stream.append -> the natural errno,
+                  serve.* and stream.refresh -> exec,
                   serve.worker -> die)
     n=<int>       total injection cap (default unlimited)
     seed=<int>    RNG seed for this seam's draw sequence (default 0)
@@ -100,6 +111,8 @@ SEAMS = (
     "serve.worker",
     "obs.journal",
     "obs.prof",
+    "stream.append",
+    "stream.refresh",
 )
 
 #: seams whose check() sites pass a key (a binding label) — the only
@@ -122,7 +135,17 @@ _DEFAULT_KIND = {
     "serve.worker": "die",
     "obs.journal": "EIO",
     "obs.prof": "EIO",
+    "stream.append": "ENOSPC",
+    "stream.refresh": "exec",
 }
+
+#: seams whose sites surface typed CylonError kinds directly (serve.*
+#: fail through _fail_rec_locked; stream.refresh through the view's
+#: typed-refresh wrapper) — everywhere else sits inside an
+#: ``except OSError`` degradation ladder, so only errno kinds are valid
+_TYPED_KIND_SEAMS = frozenset(
+    {s for s in SEAMS if s.startswith("serve.")} | {"stream.refresh"}
+)
 
 
 class FaultSpec:
@@ -216,12 +239,13 @@ def parse_spec(raw: str) -> Dict[str, FaultSpec]:
             raise FaultSpecError(
                 f"unknown fault kind {kind!r} in {clause!r}"
             )
-        if kind not in _ERRNO_KINDS and not seam.startswith("serve."):
-            # the I/O seams sit inside `except OSError` degradation
-            # ladders (spill retry, journal degrade): a typed
-            # CylonError kind there would ESCAPE the ladder and fail
-            # queries the contract says must survive — reject the spec
-            # instead of silently breaking the invariant
+        if kind not in _ERRNO_KINDS and seam not in _TYPED_KIND_SEAMS:
+            # the I/O seams (spill/arena/obs, and stream.append's
+            # ingest ladder) sit inside `except OSError` degradation
+            # ladders (spill retry, journal degrade, append rollback):
+            # a typed CylonError kind there would ESCAPE the ladder and
+            # fail queries the contract says must survive — reject the
+            # spec instead of silently breaking the invariant
             raise FaultSpecError(
                 f"kind {kind!r} is not valid for seam {seam!r}: "
                 "I/O seams take errno kinds (ENOSPC/EIO/ENOMEM) only"

@@ -402,6 +402,16 @@ STABLE_METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "fault injections delivered per seam "
         "(cylon_tpu/fault/inject.py; armed via CYLON_TPU_FAULTS — zero "
         "in production)"),
+    "stream.": (
+        "mixed", "streaming ingest + incremental views (cylon_tpu/"
+        "stream): append counter (rows=batch rows) with append.chunks / "
+        "append.rejected / append.rollback; state_bytes gauge (per-"
+        "append high-water of the host state arenas); refresh counter + "
+        "refresh.{noop,full,fallback,inc} mode split and refresh."
+        "delta_rows (rows=delta size) — the refresh-vs-recompute "
+        "crossover evidence beside the journaled latencies; subs / "
+        "subs.stale / subs.refresh.* subscription counters; "
+        "stream.refresh latency histogram via observe_latency"),
     "overhead.": ("span", "trace_smoke calibration probes (tools only)"),
 }
 

@@ -75,6 +75,7 @@ def detach_scans(root: Node) -> Node:
             stub.schema = n.schema
             stub.table_ordering = n.ordering()  # frozen compile-time claim
             stub.table_stats = dict(n.col_stats())  # frozen likewise
+            stub.table_stream_gen = n.stream_gen()  # frozen likewise
             out: Node = stub
         elif n.children:
             out = n.with_children([walk(c) for c in n.children])

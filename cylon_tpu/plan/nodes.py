@@ -130,6 +130,7 @@ class Scan(Node):
         # live-read / frozen-stub discipline.
         self.table_ordering: Optional[Ordering] = None
         self.table_stats: Dict[str, object] = {}
+        self.table_stream_gen = None
         self.schema = tuple(
             (n, int(table._columns[n].dtype.type), str(table._columns[n].data.dtype))
             for n in table.column_names
@@ -149,15 +150,28 @@ class Scan(Node):
             return dict(self.table_stats)
         return dict(self.table._stats)
 
+    def stream_gen(self):
+        """The bound table's streaming identity ``(source_token,
+        generation)``, or None for ordinary (non-appendable) tables.
+        Stamped by ``stream/ingest.py`` on every snapshot it hands out;
+        live-read here (frozen on detached stubs) so the generation
+        rides :func:`~cylon_tpu.plan.lazy.gated_fingerprint` — a cached
+        executable (and its observation identity) can never alias across
+        refreshes of a growing table."""
+        if self.table is None:  # detached stub
+            return self.table_stream_gen
+        return getattr(self.table, "_stream_gen", None)
+
     def _params(self) -> tuple:
         # the ordering descriptor is part of the plan identity: a cached
         # executor whose rewrites consumed (or ignored) input sortedness
         # must not be reused for an input with a different order property.
         # Read LIVE at fingerprint time (collect), same snapshot optimize
-        # sees in the same collect call.
+        # sees in the same collect call. The stream generation follows
+        # the same discipline: same snapshot, same live read.
         return (
             self.ordinal, self.schema, self.table.world_size,
-            self.ordering(),
+            self.ordering(), self.stream_gen(),
         )
 
     def label(self) -> str:

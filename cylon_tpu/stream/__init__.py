@@ -1,0 +1,25 @@
+"""Streaming ingestion + incremental view maintenance (the fifth pillar
+beside shuffle/serve/obs/fault; docs/ARCHITECTURE.md "Streaming &
+incremental views").
+
+- ``ingest.py``  — :class:`AppendableTable`: chunked, schema-validated
+  appends staged through the HostArena spill tier; monotone generations,
+  per-append row watermarks, descriptor invalidation.
+- ``delta.py``   — :class:`IncrementalView`: delta-aware recompute for
+  cached plans; ``CYLON_TPU_NO_IVM=1`` is the full-recompute oracle.
+- ``subscribe.py`` — :class:`Subscription`: re-resolving futures riding
+  the serving scheduler's admission/lease/batching machinery.
+"""
+from .ingest import AppendableTable  # noqa: F401
+from .delta import IncrementalView, ivm_disabled, ivm_enabled, view  # noqa: F401
+from .subscribe import Subscription, subscribe  # noqa: F401
+
+__all__ = [
+    "AppendableTable",
+    "IncrementalView",
+    "Subscription",
+    "ivm_disabled",
+    "ivm_enabled",
+    "subscribe",
+    "view",
+]

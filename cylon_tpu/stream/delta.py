@@ -1,0 +1,467 @@
+"""Delta-aware recompute for cached plans over appendable tables.
+
+An :class:`IncrementalView` maintains the result of one lazy plan as its
+input :class:`~cylon_tpu.stream.ingest.AppendableTable` sources grow,
+re-executing on ONLY the new rows wherever the plan's algebra permits.
+The deltas are ordinary lazy plans over ordinary snapshot tables, so
+they ride ``_shuffle_many`` and every adaptive gate (header fusion, lane
+packing, semi filter, quantized wire) unchanged — Exoshuffle's
+shuffle-as-a-service argument (PAPERS.md 2203.05072) applied to
+incremental view maintenance.
+
+DELTA ALGEBRA (the supported fragment; anything else falls back to full
+recompute, counted ``stream.refresh.fallback``):
+
+Filter / Project
+    Distribute over row-appends: ``chain(T + dT) = chain(T) + chain(dT)``
+    — the delta just rides the chain.
+
+Inner Join (one streaming Scan per side at most)
+    ``(L+dL) join (R+dR) = L join R  +  dL join (R+dR)  +  L join dR``
+    — term 1 is the retained previous result; term 2 binds the delta
+    against the CURRENT right snapshot; term 3 binds the RETAINED
+    previous left snapshot (the build-side state, its rows resident in
+    the source's host arena) against the right delta. A self-join (one
+    source on both sides) is covered by the same two delta terms. Outer
+    joins do not decompose this way (null-extension rows flip) — full
+    recompute.
+
+GroupBy (root; ops in sum / count / min / max)
+    States are kept as mergeable partials: the retained result IS the
+    partial (sum/min/max merge idempotently by re-aggregating, count
+    merges by sum — the same algebra the fused pipeline's
+    overflow-reduction psum relies on). The GroupBy rides INSIDE each
+    delta term's device program (the fused join->agg pipeline over
+    constant delta shapes, so the kernel caches hit round after round)
+    and the per-group partials — O(distinct keys) rows whose counts
+    VARY per refresh — merge host-side (``_merge_partials``): a
+    device-side merge would see a new input shape every round and pay
+    an XLA compile per refresh, which is exactly the recompute cost
+    IVM exists to avoid. ``mean`` is not mergeable from its own output
+    — full recompute.
+
+Sort / Limit / Union / nested joins
+    Full recompute.
+
+GENERATION / FINGERPRINT DISCIPLINE: every table a delta plan binds is
+stamped by ingest.py (``(token, gen)`` snapshots, ``(token, since,
+cur)`` deltas) and ``Scan._params`` live-reads the stamp, so
+``gated_fingerprint`` separates every refresh — cached executables,
+observation profiles, and serve-batch groups never alias across
+generations. The per-refresh plan-cache miss costs Python-side
+optimize/lower only: the expensive XLA programs live in the structural
+kernel caches (``engine.get_kernel``) and are shared across generations
+whose shapes bucket identically.
+
+``CYLON_TPU_NO_IVM=1`` (declared below via ``env_gate``) disables the
+delta path entirely — every refresh is a full recompute over the current
+snapshots. That is the differential oracle: tests and the fuzz campaign
+run each refresh both ways and require exact (canonicalized) equality.
+
+FAILURE DOMAIN: the ``stream.refresh`` fault seam fires before any state
+is touched; any refresh failure (injected or real) surfaces as a typed
+:class:`~cylon_tpu.fault.CylonError` with the view's retained state
+(previous snapshots, previous result, generation cursor) unchanged —
+the prior result stays queryable, the next refresh retries the same
+delta.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fault import inject as _fault
+from ..fault.errors import CylonError, QueryExecError
+from ..obs import metrics as _obsmetrics
+from ..obs import store as _obsstore
+from ..plan import feedback as _feedback
+from ..plan import lazy as _lazy
+from ..plan import nodes as _nodes
+from ..table import concat as _concat_tables
+from ..utils.envgate import env_gate
+from ..utils.tracing import bump
+
+#: CYLON_TPU_NO_IVM=1 -> every refresh is a full recompute (the
+#: differential oracle). Keyed mechanically: the oracle path binds full
+#: snapshots whose (token, gen) stamps ride Scan._params into
+#: gated_fingerprint, so oracle and delta programs can never alias.
+ivm_enabled, ivm_disabled = env_gate(
+    "CYLON_TPU_NO_IVM",
+    keyed_via="full and delta refreshes bind differently-stamped tables "
+    "(snapshot vs delta _stream_gen), so their fingerprints — and every "
+    "cache keyed by them — already separate; the gate itself never "
+    "reaches a kernel key",
+    note="=1 disables incremental view maintenance: every stream refresh "
+    "recomputes from the full current snapshots (the differential "
+    "oracle for tests/fuzz/bench)",
+)
+
+#: per-op merge operator over retained partials (count merges by sum);
+#: ops outside this table (mean, ...) force full recompute
+MERGE_OPS = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+class _Fragment:
+    """One classified plan: the supported shape's dissected pieces."""
+
+    __slots__ = ("agg", "inner", "scans", "join", "left_scan", "right_scan")
+
+    def __init__(self, agg, inner, scans, join, left_scan, right_scan):
+        self.agg = agg          # GroupBy node or None
+        self.inner = inner      # plan below the GroupBy (or the root)
+        self.scans = scans      # [(scan_node, source_index_or_None)]
+        self.join = join        # Join node or None
+        self.left_scan = left_scan    # (scan, src_idx|None) under join L
+        self.right_scan = right_scan  # likewise R
+
+
+def _chain_to_scan(node):
+    """Descend a Filter/Project chain; (scan, ok)."""
+    while isinstance(node, (_nodes.Filter, _nodes.Project)):
+        node = node.children[0]
+    return (node, True) if isinstance(node, _nodes.Scan) else (node, False)
+
+
+def _source_index(table, sources) -> Optional[int]:
+    for i, s in enumerate(sources):
+        src = getattr(table, "_stream_src", None)
+        if src is not None and src() is s:
+            return i
+    return None
+
+
+def classify(plan, sources) -> Optional[_Fragment]:
+    """Dissect ``plan`` into the supported incremental fragment, or None
+    (-> full recompute). ``sources`` maps streaming Scans positionally."""
+    agg = None
+    node = plan
+    if isinstance(node, _nodes.GroupBy):
+        if not all(op in MERGE_OPS for _c, op in node.aggs):
+            return None
+        agg = node
+        node = node.children[0]
+    # chain above the core
+    probe = node
+    while isinstance(probe, (_nodes.Filter, _nodes.Project)):
+        probe = probe.children[0]
+    if isinstance(probe, _nodes.Scan):
+        idx = _source_index(probe.table, sources)
+        return _Fragment(agg, node, [(probe, idx)], None, None, None)
+    if isinstance(probe, _nodes.Join):
+        if probe.how != "inner":
+            return None
+        lscan, lok = _chain_to_scan(probe.children[0])
+        rscan, rok = _chain_to_scan(probe.children[1])
+        if not (lok and rok):
+            return None
+        l_idx = _source_index(lscan.table, sources)
+        r_idx = _source_index(rscan.table, sources)
+        return _Fragment(
+            agg, node, [(lscan, l_idx), (rscan, r_idx)], probe,
+            (lscan, l_idx), (rscan, r_idx),
+        )
+    return None
+
+
+def _rebind(node, tmap):
+    """Copy ``node``'s subtree with fresh Scans, substituting tables from
+    ``tmap`` (id(original scan) -> Table); unmapped Scans rebind their
+    own table (fresh node, so ordinal churn never leaks into the live
+    plan a user still holds)."""
+    if isinstance(node, _nodes.Scan):
+        t = tmap.get(id(node))
+        return _nodes.Scan(t if t is not None else node.table)
+    return node.with_children([_rebind(c, tmap) for c in node.children])
+
+
+def _isnull(v) -> bool:
+    return v is None or (isinstance(v, float) and v != v)
+
+
+#: null-key sentinel for the host merge: NaN != NaN would split the null
+#: group into one dict entry per partial row (the device groupby keeps
+#: exactly one null group)
+_NULL_KEY = object()
+
+
+def _combiner(op) -> Callable:
+    """Null-aware binary merge for one aggregate's partials (count
+    merges by sum)."""
+    mop = MERGE_OPS[op]
+    if mop == "sum":
+        base = lambda a, b: a + b  # noqa: E731
+    elif mop == "min":
+        base = min
+    else:
+        base = max
+
+    def merge(a, b):
+        if _isnull(a):
+            return b
+        if _isnull(b):
+            return a
+        return base(a, b)
+
+    return merge
+
+
+def _merge_partials(ctx, keys, aggs, parts):
+    """Merge per-group aggregate partials host-side into one Table.
+
+    The partials are tiny (O(distinct keys) rows) but their row counts
+    vary per refresh, so a device-side merge would recompile an XLA
+    program every round — the steady-state cost IVM exists to avoid.
+    Every input here is an already-materialized result table, so the
+    ``to_pydict`` reads are not new dispatch-path syncs."""
+    agg_cols = [f"{c}_{op}" for c, op in aggs]
+    combine = [_combiner(op) for _c, op in aggs]
+    acc: Dict[tuple, list] = {}
+    ref_dtypes: Dict[str, object] = {}
+    for t in parts:
+        d = t.to_pydict()
+        for c in list(keys) + agg_cols:
+            dt = getattr(d[c], "dtype", None)
+            if c not in ref_dtypes and dt is not None and dt != object:
+                ref_dtypes[c] = dt
+        key_cols = [d[k] for k in keys]
+        val_cols = [d[c] for c in agg_cols]
+        for i in range(len(key_cols[0])):
+            kt = tuple(
+                _NULL_KEY if _isnull(col[i]) else col[i]
+                for col in key_cols
+            )
+            vals = [col[i] for col in val_cols]
+            cur = acc.get(kt)
+            if cur is None:
+                acc[kt] = vals
+            else:
+                for j, fn in enumerate(combine):
+                    cur[j] = fn(cur[j], vals[j])
+    data: Dict[str, object] = {}
+    for j, k in enumerate(keys):
+        data[k] = np.array(
+            [None if kt[j] is _NULL_KEY else kt[j] for kt in acc],
+            dtype=object,
+        )
+    for j, c in enumerate(agg_cols):
+        data[c] = np.array([vals[j] for vals in acc.values()], dtype=object)
+    # Rebuild through object arrays (nulls need it), but hand columns to
+    # from_pydict in the dtype the device partials produced — the
+    # incremental result must carry the same schema as a full recompute.
+    for c, dt in ref_dtypes.items():
+        col = data[c]
+        if not any(v is None or v != v for v in col):
+            data[c] = col.astype(dt)
+    from ..table import Table as _Table
+
+    return _Table.from_pydict(ctx, data)
+
+
+class IncrementalView:
+    """The maintained result of ``build(*snapshots)`` as sources grow.
+
+    ``build`` is a callable taking one snapshot :class:`Table` per
+    source (positional) and returning a
+    :class:`~cylon_tpu.plan.lazy.LazyFrame`; static side tables may be
+    captured in its closure. ``refresh()`` brings the result up to the
+    sources' current generations (incremental where the fragment
+    supports it); ``result()`` refreshes-if-stale and returns the
+    current table."""
+
+    def __init__(self, build: Callable, sources: Sequence, ctx=None):
+        if not sources:
+            raise ValueError("IncrementalView needs at least one source")
+        self._build = build
+        self._sources = list(sources)
+        self.ctx = ctx if ctx is not None else sources[0].ctx
+        self._lock = threading.RLock()
+        self._gens: Optional[List[int]] = None
+        self._prev: Optional[List] = None   # retained snapshots at _gens
+        self._result = None                 # retained result Table
+        #: refresh-mode counters (introspection + tests)
+        self.stats = {"noop": 0, "full": 0, "fallback": 0, "inc": 0}
+
+    # -- public surface ------------------------------------------------
+    @property
+    def generations(self) -> Optional[List[int]]:
+        """Source generations the retained result reflects."""
+        return None if self._gens is None else list(self._gens)
+
+    def stale(self) -> bool:
+        """Host-only check: has any source grown past the result?"""
+        if self._gens is None:
+            return True
+        return any(
+            s.generation != g for s, g in zip(self._sources, self._gens)
+        )
+
+    def refresh(self):
+        """Bring the result up to the sources' current generations;
+        returns the result Table. Typed failure domain: raises only
+        :class:`CylonError` subclasses, with retained state unchanged."""
+        mode, lf, commit = self._plan_refresh()
+        if lf is None:
+            return commit(None)
+        return commit(lf.collect())
+
+    def result(self):
+        """The current result (refreshing first if stale)."""
+        if self.stale():
+            return self.refresh()
+        with self._lock:
+            return self._result
+
+    # -- the refresh planner (shared with subscribe.py) ----------------
+    def _plan_refresh(self):
+        """Decide this refresh's mode and primary plan WITHOUT touching
+        retained state: returns ``(mode, lf, commit)`` where ``lf`` is
+        the plan to execute (None for a no-op) and ``commit(table)``
+        finishes the refresh (merge + state swap) and returns the new
+        result. DISPATCH-SAFE: builds plans and host-side snapshots only
+        (snapshot encode enqueues device puts; counts are host-known)."""
+        try:
+            return self._plan_refresh_inner()
+        except CylonError:
+            raise
+        except Exception as e:
+            raise QueryExecError(f"stream refresh failed: {e}") from e
+
+    def _plan_refresh_inner(self):
+        with self._lock:
+            # the refresh seam: before any plan executes or any retained
+            # state is touched — an injection surfaces typed with the
+            # prior result still queryable
+            _fault.check("stream.refresh")
+            t0 = time.perf_counter()
+            cur_gens = [s.generation for s in self._sources]
+            if (
+                self._gens is not None
+                and cur_gens == self._gens
+                and self._result is not None
+            ):
+                bump("stream.refresh.noop")
+                self.stats["noop"] += 1
+                res = self._result
+                return "noop", None, (lambda _t: res)
+            cur = [s.table() for s in self._sources]
+            if self._result is None or not ivm_enabled():
+                return self._plan_full(cur_gens, cur, t0, "full")
+            frag = classify(self._build(*cur).plan, self._sources)
+            if frag is None:
+                return self._plan_full(cur_gens, cur, t0, "fallback")
+            return self._plan_incremental(frag, cur_gens, cur, t0)
+
+    def _plan_full(self, cur_gens, cur, t0, mode):
+        lf = self._build(*cur)
+
+        def commit(table):
+            with self._lock:
+                self._gens, self._prev, self._result = (
+                    cur_gens, cur, table
+                )
+            self.stats[mode] += 1
+            bump(f"stream.refresh.{mode}")
+            self._journal(lf, t0)
+            return table
+
+        return mode, lf, commit
+
+    def _plan_incremental(self, frag, cur_gens, cur, t0):
+        sources, prev_gens, prev = self._sources, self._gens, self._prev
+        deltas = [
+            s.delta_table(g) if s.rows_since(g) > 0 else None
+            for s, g in zip(sources, prev_gens)
+        ]
+        delta_rows = sum(
+            s.rows_since(g) for s, g in zip(sources, prev_gens)
+        )
+        # term plans: each binds delta/current/previous snapshots into a
+        # fresh copy of the inner plan; any GroupBy root rides INSIDE
+        # each term (fused join->agg over constant delta shapes — the
+        # kernel caches hit; only the tiny partials merge host-side)
+        terms = []
+        if frag.join is None:
+            scan, idx = frag.scans[0]
+            if idx is not None and deltas[idx] is not None:
+                terms.append(_rebind(frag.inner, {id(scan): deltas[idx]}))
+        else:
+            (lscan, l_idx), (rscan, r_idx) = frag.left_scan, frag.right_scan
+            if l_idx is not None and deltas[l_idx] is not None:
+                # dL join R_current (covers dL join dR)
+                terms.append(_rebind(frag.inner, {
+                    id(lscan): deltas[l_idx],
+                    id(rscan): cur[r_idx] if r_idx is not None
+                    else rscan.table,
+                }))
+            if r_idx is not None and deltas[r_idx] is not None:
+                # L_previous (the retained build side) join dR
+                terms.append(_rebind(frag.inner, {
+                    id(lscan): prev[l_idx] if l_idx is not None
+                    else lscan.table,
+                    id(rscan): deltas[r_idx],
+                }))
+        if not terms:
+            # generations moved but no rows did (empty appends in other
+            # sources): the retained result is already current
+            res = self._result
+
+            def commit_noop(_t):
+                with self._lock:
+                    self._gens, self._prev = cur_gens, cur
+                bump("stream.refresh.noop")
+                self.stats["noop"] += 1
+                return res
+
+            return "noop", None, commit_noop
+
+        if frag.agg is not None:
+            terms = [frag.agg.with_children([t]) for t in terms]
+        primary = _lazy.LazyFrame(terms[0], self.ctx)
+        rest = [_lazy.LazyFrame(t, self.ctx) for t in terms[1:]]
+        prev_result = self._result
+
+        def commit(table):
+            parts = [table] + [r.collect() for r in rest]
+            if frag.agg is not None:
+                new_result = _merge_partials(
+                    self.ctx, list(frag.agg.keys), frag.agg.aggs,
+                    [prev_result] + parts,
+                )
+            else:
+                delta_out = (
+                    parts[0] if len(parts) == 1 else _concat_tables(parts)
+                )
+                new_result = _concat_tables([prev_result, delta_out])
+            with self._lock:
+                self._gens, self._prev, self._result = (
+                    cur_gens, cur, new_result
+                )
+            self.stats["inc"] += 1
+            bump("stream.refresh.inc")
+            bump("stream.refresh.delta_rows", rows=delta_rows)
+            self._journal(primary, t0)
+            return new_result
+
+        return "inc", primary, commit
+
+    def _journal(self, lf, t0: float) -> None:
+        """Feed this refresh's wall latency to the observation store
+        (under the executed plan's profile identity, so the autopilot's
+        re-coster sees refresh-vs-recompute evidence side by side) and
+        the stable metrics surface."""
+        dt = time.perf_counter() - t0
+        bump("stream.refresh")
+        try:
+            fp = _lazy.gated_fingerprint(lf.plan)
+            _obsstore.observe_latency(_feedback.base_key(fp[:-1]), dt)
+        except Exception:
+            pass  # observation is best-effort, never fails a refresh
+        _obsmetrics.observe_latency("stream.refresh", dt)
+
+
+def view(build: Callable, *sources, ctx=None) -> IncrementalView:
+    """Sugar: ``stream.view(lambda l, r: ..., left_tab, right_tab)``."""
+    return IncrementalView(build, sources, ctx=ctx)

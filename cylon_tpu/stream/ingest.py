@@ -1,0 +1,427 @@
+"""AppendableTable: chunked, schema-validated streaming ingest over the
+HostArena spill tier.
+
+The reference ships an experimental streaming op-DAG (``Op::insert/
+progress`` with streaming splitter kernels, cpp/src/cylon/ops/); this
+module is its ingestion substrate for the TPU-native engine. An
+:class:`AppendableTable` is a growing logical table whose rows live in a
+host-side state store — one :class:`~cylon_tpu.parallel.spill.HostArena`
+per table, so ingested state rides the same budget/promotion/degradation
+machinery as shuffle spill (RAM by default, memmap tier-2 past
+``CYLON_TPU_SPILL_HOST_BUDGET``, counted in ``arena_bytes()``).
+
+DISCIPLINES:
+
+Generations & watermarks
+    Every successful append bumps a monotone ``generation`` and records
+    a per-append row watermark ``(generation -> cumulative row count)``.
+    ``table(at_gen)`` snapshots any retained generation;
+    ``delta_table(since_gen)`` builds a table of ONLY the rows appended
+    after a watermark — both are host-count-known (zero device syncs to
+    construct). Snapshots are stamped with ``_stream_gen = (source_token,
+    generation)``, which ``plan.nodes.Scan._params`` live-reads into
+    ``gated_fingerprint``: cached executables, observation profiles and
+    serve-batch groups can never alias across refreshes.
+
+Descriptor invalidation
+    Appends break sortedness and widen value ranges, so a snapshot NEVER
+    inherits ``Ordering``/``ColStat`` descriptors from an earlier
+    generation: every generation's snapshot is a fresh encode with both
+    descriptors empty (re-derive with ``ensure_stats``/``sort`` per
+    snapshot if wanted). The regression tests pin this.
+
+Failure domain (the PR-14 invariant extended to ingestion)
+    An append either commits atomically (generation bumped, watermark
+    recorded) or rolls back completely: validation errors, the
+    ``CYLON_TPU_STREAM_STATE_BUDGET`` byte budget, arena I/O failures
+    and the ``stream.append`` fault seam all surface as a typed
+    :class:`~cylon_tpu.fault.StreamIngestError` with the arena row
+    cursor restored — the prior generation stays queryable and no state
+    bytes leak. The seam sits INSIDE the ``except OSError`` ladder, so
+    only errno kinds are valid on it (fault/inject.py rejects others).
+
+Staging is chunked by ``CYLON_TPU_STREAM_CHUNK_ROWS`` (bounds the
+per-copy host working set; never reaches a kernel shape — the snapshot's
+shard caps derive from total arena rows).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..fault import inject as _fault
+from ..fault.errors import StreamIngestError
+from ..parallel.spill import HostArena
+from ..table import Table
+from ..utils import envgate as _eg
+from ..utils.tracing import bump, gauge
+
+#: fallback staging chunk when CYLON_TPU_STREAM_CHUNK_ROWS is unset
+DEFAULT_CHUNK_ROWS = 65536
+
+#: process-wide source tokens: two appendable tables (even over identical
+#: data) must never share a fingerprint identity
+_SRC_SEQ_LOCK = threading.Lock()
+_SRC_SEQ = 0
+
+
+def _next_token() -> int:
+    global _SRC_SEQ
+    with _SRC_SEQ_LOCK:
+        _SRC_SEQ += 1
+        return _SRC_SEQ
+
+
+def _chunk_rows() -> int:
+    raw = _eg.STREAM_CHUNK_ROWS.get()
+    try:
+        n = int(raw) if raw else DEFAULT_CHUNK_ROWS
+    except ValueError:
+        n = DEFAULT_CHUNK_ROWS
+    return max(n, 1)
+
+
+def _state_budget() -> Optional[int]:
+    raw = _eg.STREAM_STATE_BUDGET.get()
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def _is_null(v) -> bool:
+    return v is None or (isinstance(v, float) and np.isnan(v))
+
+
+class _ColSpec:
+    """One column's ingest contract: logical kind + physical arena dtype.
+
+    ``kind`` is ``"str"`` (object-dtype arena buffer, RAM-pinned like
+    every decoded-dictionary sink) or ``"num"`` (fixed-width buffer that
+    CAN spill to the disk tier). Both carry a validity lane."""
+
+    __slots__ = ("name", "kind", "dtype")
+
+    def __init__(self, name: str, kind: str, dtype: np.dtype):
+        self.name = name
+        self.kind = kind
+        self.dtype = dtype
+
+    def normalize(self, values) -> Tuple[np.ndarray, np.ndarray]:
+        """Validate + coerce one appended column to ``(data, valid)`` in
+        this column's physical layout. Raises ValueError on mismatch."""
+        arr = values if isinstance(values, np.ndarray) else np.asarray(values)
+        if self.kind == "str":
+            if arr.dtype != object:
+                if not (arr.dtype.kind in ("U", "S") or arr.size == 0):
+                    raise ValueError(
+                        f"column {self.name!r}: expected strings, got "
+                        f"dtype {arr.dtype}"
+                    )
+                arr = arr.astype(object)
+            valid = np.fromiter(
+                (not _is_null(v) for v in arr), dtype=bool, count=len(arr)
+            )
+            data = np.array(
+                [v if ok else None for v, ok in zip(arr, valid)],
+                dtype=object,
+            )
+            for v, ok in zip(data, valid):
+                if ok and not isinstance(v, str):
+                    raise ValueError(
+                        f"column {self.name!r}: expected strings, got "
+                        f"{type(v).__name__}"
+                    )
+            return data, valid
+        # numeric lane
+        if arr.dtype == object:
+            valid = np.fromiter(
+                (not _is_null(v) for v in arr), dtype=bool, count=len(arr)
+            )
+            data = np.zeros(len(arr), dtype=self.dtype)
+            if valid.any():
+                picked = np.asarray([v for v in arr[valid]])
+                if picked.dtype == object or not np.can_cast(
+                    picked.dtype, self.dtype, casting="same_kind"
+                ):
+                    raise ValueError(
+                        f"column {self.name!r}: cannot cast appended "
+                        f"values ({picked.dtype}) to {self.dtype} "
+                        "(same_kind)"
+                    )
+                data[valid] = picked.astype(self.dtype)
+            return data, valid
+        if not np.can_cast(arr.dtype, self.dtype, casting="same_kind"):
+            raise ValueError(
+                f"column {self.name!r}: cannot cast appended values "
+                f"({arr.dtype}) to {self.dtype} (same_kind)"
+            )
+        return arr.astype(self.dtype, copy=False), np.ones(len(arr), bool)
+
+    def decode(self, data: np.ndarray, valid: np.ndarray):
+        """Arena physical layout -> the host representation
+        ``Table.from_pydict`` ingests (nulls as None in object arrays)."""
+        if self.kind == "str":
+            return data
+        if valid.all():
+            return data
+        obj = data.astype(object)
+        obj[~valid] = None
+        return obj
+
+
+def _infer_spec(name: str, values) -> _ColSpec:
+    arr = values if isinstance(values, np.ndarray) else np.asarray(values)
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        nonnull = [v for v in arr if not _is_null(v)]
+        if any(isinstance(v, str) for v in nonnull):
+            return _ColSpec(name, "str", np.dtype(object))
+        if not nonnull:
+            raise ValueError(
+                f"column {name!r}: cannot infer a dtype from an all-null "
+                "initial column"
+            )
+        inferred = np.asarray(nonnull).dtype
+        if inferred == object:
+            raise ValueError(
+                f"column {name!r}: mixed non-string object values are "
+                "not ingestible"
+            )
+        return _ColSpec(name, "num", inferred)
+    if arr.dtype.kind not in ("i", "u", "f", "b"):
+        raise ValueError(f"column {name!r}: unsupported dtype {arr.dtype}")
+    return _ColSpec(name, "num", arr.dtype)
+
+
+class AppendableTable:
+    """A growing logical table: HostArena state store + generation
+    counter + per-append watermarks (see module docstring)."""
+
+    def __init__(self, ctx, data: Dict[str, Any]):
+        if not data:
+            raise ValueError("AppendableTable needs at least one column")
+        self.ctx = ctx
+        self._token = _next_token()
+        self._lock = threading.RLock()
+        self._specs: List[_ColSpec] = [
+            _infer_spec(name, values) for name, values in data.items()
+        ]
+        self._arena = HostArena(
+            [(s.name, s.dtype, True) for s in self._specs]
+        )
+        self._gen = 0
+        #: watermarks[g] = cumulative arena rows as of generation g
+        self._marks: List[int] = [0]
+        #: (generation, Table) single-slot snapshot cache; views retain
+        #: older generations themselves by holding the Table
+        self._snap: Optional[Tuple[int, Table]] = None
+        #: weakrefs to subscription-like listeners (``_on_append(src)``)
+        self._listeners: List = []
+        self._closed = False
+        n0 = self._ingest_batch(data)
+        self._marks[0] = self._arena.rows
+        if n0 == 0:
+            raise ValueError("AppendableTable needs non-empty initial data")
+
+    # -- introspection -------------------------------------------------
+    @property
+    def generation(self) -> int:
+        """The monotone generation counter (0 = the initial load)."""
+        return self._gen
+
+    @property
+    def row_count(self) -> int:
+        """Total ingested rows (host-known; never syncs a device)."""
+        return self._arena.rows
+
+    @property
+    def state_bytes(self) -> int:
+        """Current state-arena footprint in bytes."""
+        return self._arena.nbytes
+
+    @property
+    def column_names(self) -> List[str]:
+        return [s.name for s in self._specs]
+
+    def watermark(self, gen: Optional[int] = None) -> int:
+        """Cumulative row count as of ``gen`` (default: current)."""
+        g = self._gen if gen is None else gen
+        if not (0 <= g <= self._gen):
+            raise ValueError(f"generation {g} not in [0, {self._gen}]")
+        return self._marks[g]
+
+    def rows_since(self, gen: int) -> int:
+        """Rows appended after generation ``gen`` (host-known)."""
+        return self._arena.rows - self.watermark(gen)
+
+    # -- ingest --------------------------------------------------------
+    def _ingest_batch(self, data: Dict[str, Any]) -> int:
+        """Validate + normalize + chunk-copy one batch into the arena.
+        Returns the staged row count. Raises (ValueError on schema,
+        OSError from the arena ladder) WITHOUT committing — the caller
+        owns rollback and the typed surface."""
+        names = list(data.keys())
+        if names != self.column_names:
+            raise ValueError(
+                f"append schema mismatch: expected {self.column_names}, "
+                f"got {names}"
+            )
+        cols = [s.normalize(data[s.name]) for s in self._specs]
+        n = len(cols[0][0])
+        for (d, _v), s in zip(cols, self._specs):
+            if len(d) != n:
+                raise ValueError(
+                    f"column {s.name!r}: ragged append ({len(d)} vs {n})"
+                )
+        if n == 0:
+            return 0
+        budget = _state_budget()
+        if budget is not None:
+            est = sum(
+                n * (8 if s.kind == "str" else s.dtype.itemsize) + n
+                for s in self._specs
+            )
+            if self._arena.nbytes + est > budget:
+                raise StreamIngestError(
+                    f"append of {n} rows (~{est} B) would exceed "
+                    f"CYLON_TPU_STREAM_STATE_BUDGET={budget} "
+                    f"(state at {self._arena.nbytes} B)"
+                )
+        # the ingestion seam: inside the OSError ladder below, between
+        # validation/budget admission and the first arena write
+        _fault.check("stream.append")
+        chunk = _chunk_rows()
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            self._arena.append_batch(
+                [(d[lo:hi], v[lo:hi]) for d, v in cols]
+            )
+            bump("stream.append.chunks")
+        return n
+
+    def append(self, data: Dict[str, Any]) -> int:
+        """Append one batch; returns the new generation. Atomic: commits
+        (generation bumped, watermark recorded, listeners notified) or
+        rolls back typed — see the module docstring's failure domain. An
+        empty batch is a no-op (no generation bump)."""
+        with self._lock:
+            if self._closed:
+                raise StreamIngestError("append on a closed AppendableTable")
+            saved_rows = self._arena.rows
+            try:
+                n = self._ingest_batch(data)
+            except StreamIngestError:
+                raise
+            except (ValueError, TypeError) as e:
+                # schema/shape rejection: nothing staged past validation,
+                # but restore the cursor anyway (a ragged batch can fail
+                # AFTER earlier columns normalized — staging is all-or-
+                # nothing by construction, validation precedes writes)
+                self._arena.rows = saved_rows
+                bump("stream.append.rejected")
+                raise StreamIngestError("append rejected", cause=e) from e
+            except OSError as e:
+                # the arena ladder (ENOSPC/EIO/ENOMEM, the stream.append
+                # seam, arena.alloc/spill.write underneath): roll the
+                # row cursor back — rows past it are dead capacity, the
+                # prior generation is untouched and still queryable
+                self._arena.rows = saved_rows
+                bump("stream.append.rollback")
+                raise StreamIngestError(
+                    "append rolled back", cause=e
+                ) from e
+            if n == 0:
+                return self._gen
+            self._gen += 1
+            self._marks.append(self._arena.rows)
+            self._snap = None
+            bump("stream.append", rows=n)
+            gauge("stream.state_bytes", self._arena.nbytes)
+            listeners, self._listeners = self._listeners, []
+            for ref in listeners:
+                sub = ref()
+                if sub is not None:
+                    self._listeners.append(ref)
+            gen = self._gen
+        # notify OUTSIDE the lock: a listener may re-enter (refresh ->
+        # snapshot) and must not deadlock against a concurrent append
+        for ref in list(listeners):
+            sub = ref()
+            if sub is not None:
+                sub._on_append(self)
+        return gen
+
+    # -- snapshots -----------------------------------------------------
+    def _slice_pydict(self, lo: int, hi: int) -> Dict[str, Any]:
+        cols = self._arena.columns()
+        return {
+            s.name: s.decode(d[lo:hi], None if v is None else v[lo:hi])
+            for s, (d, v) in zip(self._specs, cols)
+        }
+
+    def _build(self, lo: int, hi: int, stamp) -> Table:
+        t = Table.from_pydict(self.ctx, self._slice_pydict(lo, hi))
+        # generation identity: Scan._params live-reads this into
+        # gated_fingerprint (no aliasing across refreshes); _stream_src
+        # lets delta.py map a plan's Scans back to their sources
+        t._stream_gen = stamp
+        t._stream_src = weakref.ref(self)
+        return t
+
+    def table(self, at_gen: Optional[int] = None) -> Table:
+        """Snapshot of generation ``at_gen`` (default: current) as an
+        ordinary :class:`Table`. Fresh encode per generation — NO
+        ordering/stat descriptors carry over from earlier snapshots (the
+        invalidation discipline; appends break sortedness and widen
+        ranges)."""
+        with self._lock:
+            g = self._gen if at_gen is None else at_gen
+            hi = self.watermark(g)
+            if g == self._gen and self._snap is not None:
+                return self._snap[1]
+            t = self._build(0, hi, (self._token, g))
+            if g == self._gen:
+                self._snap = (g, t)
+            return t
+
+    def delta_table(self, since_gen: int) -> Table:
+        """Only the rows appended AFTER generation ``since_gen`` — the
+        delta that rides the ordinary shuffle/gate machinery unchanged.
+        Stamped with a 3-tuple identity ``(token, since, current)`` so a
+        delta plan never aliases a snapshot plan in the caches."""
+        with self._lock:
+            lo = self.watermark(since_gen)
+            hi = self._arena.rows
+            if lo >= hi:
+                raise ValueError(
+                    f"no rows after generation {since_gen} "
+                    f"(current {self._gen})"
+                )
+            return self._build(lo, hi, (self._token, since_gen, self._gen))
+
+    # -- lifecycle -----------------------------------------------------
+    def subscribe_listener(self, listener) -> None:
+        """Register a listener object (``_on_append(src)`` is called,
+        outside the ingest lock, after each committed append). Held by
+        weakref — dropping the listener unsubscribes it."""
+        with self._lock:
+            self._listeners.append(weakref.ref(listener))
+
+    def close(self) -> None:
+        """Release the state arena (idempotent). Snapshots already built
+        remain valid (their rows were copied to device at encode)."""
+        with self._lock:
+            self._closed = True
+            self._snap = None
+            self._arena.close()
+            gauge("stream.state_bytes", 0)
+
+    def __repr__(self) -> str:
+        return (
+            f"AppendableTable[{', '.join(self.column_names)}] "
+            f"gen={self._gen} rows={self._arena.rows} "
+            f"state={self._arena.nbytes}B"
+        )

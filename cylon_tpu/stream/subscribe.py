@@ -1,0 +1,122 @@
+"""Subscription futures: serve-scheduler-driven refresh of incremental
+views.
+
+A :class:`Subscription` is the QueryFuture-flavored handle over an
+:class:`~cylon_tpu.stream.delta.IncrementalView`: it re-resolves when
+its input tables grow. Appends mark it stale (ingest.py notifies
+registered listeners outside the ingest lock); ``refresh_async()``
+submits the view's primary refresh plan through the context's shared
+:class:`~cylon_tpu.serve.ServeScheduler` — the SAME admission budget,
+byte leases, deadline enforcement, per-fingerprint batching and typed
+failure contract every served query rides. Because every delta plan's
+``gated_fingerprint`` carries its snapshot generations, subscriptions of
+one view shape at one generation batch together (one stacked program)
+while refreshes of different generations can never alias.
+
+``result()`` re-resolves: stale -> submit + wait; fresh -> the retained
+result, no dispatch. The refresh's merge step (delta aggregate + partial
+merge, stream/delta.py) runs in the CALLER's thread inside the future's
+``wrap`` — the scheduler worker stays sync-free.
+
+Refresh wall latencies are journaled into the observation store under
+the refresh plan's profile identity (delta.py ``_journal``) and the
+``stream.refresh`` latency histogram, so the autopilot's re-coster sees
+refresh-vs-recompute evidence beside ordinary serving latencies and can
+re-cost the crossover (a view whose deltas approach full size stops
+being worth maintaining).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..fault.errors import CylonError
+from ..utils.tracing import bump
+from .delta import IncrementalView
+
+
+class Subscription:
+    """A re-resolving future over an :class:`IncrementalView` (see
+    module docstring). Future-flavored surface: ``result()`` /
+    ``done()`` / ``stale()``; plus ``refresh_async()`` returning the
+    underlying :class:`~cylon_tpu.serve.QueryFuture` per refresh."""
+
+    def __init__(self, view: IncrementalView):
+        self._view = view
+        self._ctx = view.ctx
+        self._stale = True          # initial resolution pending
+        self._inflight = None       # the in-flight QueryFuture, if any
+        for src in view._sources:
+            src.subscribe_listener(self)
+        bump("stream.subs")
+
+    # -- ingest-side ---------------------------------------------------
+    def _on_append(self, _src) -> None:
+        """Called by ingest.py after each committed append: the current
+        resolution is superseded — the next result() re-resolves."""
+        self._stale = True
+        bump("stream.subs.stale")
+
+    # -- future surface ------------------------------------------------
+    def stale(self) -> bool:
+        """Has an input grown past the last resolved result?"""
+        return self._stale or self._view.stale()
+
+    def done(self) -> bool:
+        """A result is resolved and no newer append superseded it."""
+        return self._view._result is not None and not self.stale()
+
+    def refresh_async(self):
+        """Submit this subscription's refresh through the serving
+        scheduler; returns a :class:`~cylon_tpu.serve.QueryFuture` whose
+        ``result()`` is the refreshed view result (merge applied in the
+        caller's thread). A fresh subscription returns an
+        already-fulfilled future without touching the scheduler.
+
+        DISPATCH-SAFE up to the scheduler's own admission path: the
+        refresh planner builds plans and host-known snapshots only; the
+        single deferred materialize stays in ``result()``."""
+        from ..serve.future import QueryFuture
+        from ..serve.scheduler import submit as _serve_submit
+
+        mode, lf, commit = self._view._plan_refresh()
+        self._stale = False
+        if lf is None:
+            fut = QueryFuture(time.perf_counter(), 0)
+            fut._fulfill(commit(None))
+            return fut
+        bump(f"stream.subs.refresh.{mode}")
+        fut = _serve_submit(lf, block=True, wrap=commit)
+        self._inflight = fut
+        return fut
+
+    def result(self, timeout: Optional[float] = None):
+        """The current view result, re-resolving first when stale. The
+        one host sync of a refresh happens here (QueryFuture.result's
+        deferred materialize), never in the scheduler worker."""
+        if not self.stale():
+            inflight, self._inflight = self._inflight, None
+            if inflight is not None and not inflight.done():
+                # a prior refresh_async is still in flight and nothing
+                # superseded it: consume that resolution
+                return inflight.result(timeout)
+            with self._view._lock:
+                if self._view._result is not None:
+                    return self._view._result
+        try:
+            return self.refresh_async().result(timeout)
+        except CylonError:
+            # a failed refresh must not wedge the subscription fresh:
+            # the retained state is untouched, the next result() retries
+            self._stale = True
+            raise
+
+    def close(self) -> None:
+        """Drop this subscription (listeners are weakrefs — explicit
+        close just clears the in-flight handle)."""
+        self._inflight = None
+
+
+def subscribe(view: IncrementalView) -> Subscription:
+    """Sugar: ``stream.subscribe(stream.view(build, *tabs))``."""
+    return Subscription(view)

@@ -202,6 +202,27 @@ SKETCH_BITS = EnvKnob(
     note="semi-join sketch bit cap (config.py)",
 )
 
+# -- streaming ingest + incremental views (cylon_tpu/stream/; the
+# CYLON_TPU_NO_IVM kill switch — the full-recompute differential oracle
+# — is declared at its consumer module, stream/delta.py, via env_gate) --
+STREAM_CHUNK_ROWS = EnvKnob(
+    "CYLON_TPU_STREAM_CHUNK_ROWS", "", kind="tuning",
+    keyed_via="host-side staging only: chunking bounds the per-append "
+    "copy into the state arena and never reaches a kernel shape (the "
+    "snapshot's shard caps are derived from TOTAL arena rows)",
+    note="max rows copied into the stream state arena per staging chunk "
+    "(stream/ingest.py); unset/empty = 65536",
+)
+STREAM_STATE_BUDGET = EnvKnob(
+    "CYLON_TPU_STREAM_STATE_BUDGET", "", kind="tuning",
+    keyed_via="host-side admission only (append-time byte check against "
+    "the table's state arena); rejected appends roll back before any "
+    "buffer is touched, so no compiled program ever sees the decision",
+    note="max state-arena bytes per appendable table (stream/ingest.py); "
+    "an append that would exceed it fails typed (StreamIngestError, "
+    "prior generation untouched); unset/empty = unlimited",
+)
+
 # -- quantized float wire tier (ops/quant.py; the CYLON_TPU_NO_QUANT
 # kill switch is declared at its consumer module via env_gate) ----------
 QUANT_TOL = EnvKnob(

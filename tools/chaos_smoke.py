@@ -330,6 +330,85 @@ def main() -> None:
     obsprof.reset()
 
     # ------------------------------------------------------------------
+    # stream ingestion round (ISSUE 16): an injected append failure must
+    # end typed with the state arena rolled back and the prior
+    # generation still queryable; an injected refresh failure leaves the
+    # view's retained result untouched and the SAME delta retries clean
+    # ------------------------------------------------------------------
+    from cylon_tpu import stream
+
+    t_round = time.monotonic()
+    live0, _pk0, disk0, _dp0 = spill_mod.arena_bytes()
+
+    def sbatch(m):
+        return {"k": rng.integers(0, 40, m).astype(np.int32),
+                "v": rng.integers(-50, 50, m).astype(np.float32)}
+
+    atab = stream.AppendableTable(ctx, sbatch(2000))
+    sbuild = lambda t: t.lazy().groupby("k", {"v": "sum"})
+    sview = stream.view(sbuild, atab)
+    sview.refresh()
+    atab.append(sbatch(300))  # a clean delta, refreshed under fire below
+    with stream.ivm_disabled():
+        stream_oracle = canon(stream.view(sbuild, atab).refresh())
+    pre = (atab.generation, atab.row_count, atab.state_bytes)
+    os.environ["CYLON_TPU_FAULTS"] = f"stream.append:p=1:seed={seed}"
+    fault.reset()
+    try:
+        atab.append(sbatch(500))
+        _fail("stream.append: injected append failure never surfaced")
+    except CylonError as e:
+        print(f"  [stream.append] append: typed {type(e).__name__} "
+              f"(scope={e.scope}, retryable={e.retryable})")
+    except Exception as e:  # noqa: BLE001 - the gate IS the type check
+        _fail(f"stream.append: UNTYPED {type(e).__name__}: {e}")
+    if fault.fired("stream.append") < 1:
+        _fail("stream.append: seam never fired — the round proves nothing")
+    if (atab.generation, atab.row_count, atab.state_bytes) != pre:
+        _fail(f"stream.append: state not rolled back: "
+              f"{(atab.generation, atab.row_count, atab.state_bytes)} "
+              f"!= {pre}")
+    # the prior generation must still be queryable mid-round, and the
+    # pending delta must refresh oracle-identical with the seam armed
+    if canon(sview.refresh()) != stream_oracle:
+        _fail("stream.append: prior generation not oracle-identical "
+              "after the injected append")
+    os.environ["CYLON_TPU_FAULTS"] = f"stream.refresh:n=1:seed={seed}"
+    fault.reset()
+    atab.append(sbatch(400))
+    retained = sview._result
+    try:
+        sview.refresh()
+        _fail("stream.refresh: injected refresh failure never surfaced")
+    except CylonError as e:
+        print(f"  [stream.refresh] refresh: typed {type(e).__name__}")
+    if fault.fired("stream.refresh") < 1:
+        _fail("stream.refresh: seam never fired")
+    if sview._result is not retained:
+        _fail("stream.refresh: retained result was clobbered by a "
+              "failed refresh")
+    got = canon(sview.refresh())  # n=1 exhausted: the same delta retries
+    with stream.ivm_disabled():
+        want = canon(stream.view(sbuild, atab).refresh())
+    if got != want:
+        _fail("stream.refresh: post-fault retry not oracle-identical")
+    os.environ.pop("CYLON_TPU_FAULTS", None)
+    fault.reset()
+    atab.close()
+    del atab, sview
+    gc.collect()
+    live, _pk, disk, _dp = spill_mod.arena_bytes()
+    if live != live0 or disk != disk0:
+        _fail(f"stream round: state arena bytes leaked: live={live} "
+              f"(baseline {live0}) disk={disk} (baseline {disk0})")
+    wall = time.monotonic() - t_round
+    if wall > ROUND_DEADLINE_S:
+        _fail(f"stream round exceeded the {ROUND_DEADLINE_S:.0f}s "
+              f"deadline ({wall:.1f}s) — hang")
+    print(f"[chaos] stream: append rollback + refresh retention ok "
+          f"({wall:.1f}s)")
+
+    # ------------------------------------------------------------------
     # faults disabled: byte-identical + the <2% hook-overhead pin
     # ------------------------------------------------------------------
     os.environ.pop("CYLON_TPU_FAULTS", None)

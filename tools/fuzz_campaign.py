@@ -1372,6 +1372,97 @@ def chaos_round_once(seed) -> bool:
     return ok
 
 
+def stream_round_once(seed) -> bool:
+    """Streaming-IVM differential round (ISSUE 16): random appendable
+    topology (scan / join / filter-only / mean-fallback), random append
+    sizes, dtype mixes, null densities and worlds; EVERY refresh is
+    checked against the ``CYLON_TPU_NO_IVM=1`` full-recompute oracle
+    (a fresh view over the same sources). Payloads are integer-valued
+    f32 so the incremental merge's different association cannot perturb
+    sums — the oracle stays exact equality."""
+    from cylon_tpu import col, stream
+
+    rng = np.random.default_rng(seed)
+    keyspace = int(rng.integers(2, 40))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.15]))
+    world = int(rng.choice([1, 2, 4, 8]))
+    topo = str(rng.choice(["scan", "join", "filter", "mean"]))
+    ops = list(rng.choice(["sum", "min", "max", "count"],
+                          size=int(rng.integers(1, 3)), replace=False))
+    filt = bool(rng.integers(0, 2))
+    n_refresh = int(rng.integers(1, 4))
+    chunk = int(rng.choice([0, 7, 64]))  # 0 = default staging chunk
+    params = dict(seed=seed, profile="stream", keyspace=keyspace,
+                  dtype=dtype, null_p=null_p, world=world, topo=topo,
+                  ops=ops, filt=filt, n_refresh=n_refresh, chunk=chunk)
+    ctx = ctx_for(world)
+
+    def mk_batch(n, key, vname, initial=False):
+        n = max(int(n), 2)
+        df = rand_frame(rng, n, keyspace, dtype, null_p, vname)
+        k = df["k"].to_numpy()
+        if initial and all(v is None for v in k):
+            # the spec is inferred from the initial batch: keep it typed
+            df2 = rand_frame(rng, 1, keyspace, dtype, 0.0, vname)
+            k[0] = df2["k"].to_numpy()[0]
+        return {key: k,
+                vname: rng.integers(-50, 50, n).astype(np.float32)}
+
+    prev_chunk = os.environ.get("CYLON_TPU_STREAM_CHUNK_ROWS")
+    if chunk:
+        os.environ["CYLON_TPU_STREAM_CHUNK_ROWS"] = str(chunk)
+    try:
+        left = stream.AppendableTable(
+            ctx, mk_batch(rng.integers(8, MAX_N), "k", "v", initial=True))
+        sources = [left]
+        if topo == "join":
+            right = stream.AppendableTable(
+                ctx, mk_batch(rng.integers(8, MAX_N), "rk", "w",
+                              initial=True))
+            sources.append(right)
+
+        def build(*tabs):
+            lazy = tabs[0].lazy()
+            if topo == "join":
+                lazy = lazy.join(tabs[1].lazy(), left_on="k", right_on="rk")
+            if filt:
+                lazy = lazy.filter(col("v") > 0.0)
+            if topo == "filter":
+                return lazy
+            if topo == "mean":
+                return lazy.groupby("k", {"v": "mean"})
+            return lazy.groupby("k", {"v": ops})
+
+        v = stream.view(build, *sources)
+        ok = True
+        for r in range(n_refresh):
+            for _ in range(int(rng.integers(1, 3))):
+                src = sources[int(rng.integers(0, len(sources)))]
+                key, vname = (("rk", "w") if src is not left else ("k", "v"))
+                src.append(mk_batch(rng.integers(2, MAX_N // 2), key, vname))
+            got = v.refresh()
+            with stream.ivm_disabled():
+                want = stream.view(build, *sources).refresh()
+            ok &= check(got.to_pandas(), want.to_pandas(),
+                        f"stream/{topo}[{r}/{n_refresh}]",
+                        dict(params, stats=dict(v.stats)))
+        # the FIRST refresh is always the initial full compute; any later
+        # refresh of these topologies must have taken the delta path
+        if topo in ("scan", "join") and n_refresh >= 2 and v.stats["inc"] == 0:
+            print(f"MISMATCH stream/{topo} never took the incremental "
+                  f"path params={params} stats={v.stats}", flush=True)
+            ok = False
+        for s in sources:
+            s.close()
+        return ok
+    finally:
+        if prev_chunk is None:
+            os.environ.pop("CYLON_TPU_STREAM_CHUNK_ROWS", None)
+        else:
+            os.environ["CYLON_TPU_STREAM_CHUNK_ROWS"] = prev_chunk
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -1382,7 +1473,8 @@ def main():
     ap.add_argument("--profile",
                     choices=["default", "skew", "plan", "shuffle",
                              "ordering", "semi", "packing", "serve",
-                             "spill", "autotune", "quant", "chaos"],
+                             "spill", "autotune", "quant", "chaos",
+                             "stream"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -1414,7 +1506,11 @@ def main():
                          "wave + forced-spill join vs the faults-"
                          "disabled oracle — every query must be oracle-"
                          "identical or typed-failed, leases/arenas back "
-                         "to baseline")
+                         "to baseline; 'stream': streaming-IVM rounds "
+                         "(random appendable topology / append sizes / "
+                         "dtype mix / staging chunk / world, ISSUE 16) — "
+                         "every incremental refresh vs the "
+                         "CYLON_TPU_NO_IVM=1 full-recompute oracle")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -1427,7 +1523,8 @@ def main():
           "spill": spill_round_once,
           "autotune": autotune_round_once,
           "quant": quant_round_once,
-          "chaos": chaos_round_once}.get(args.profile, round_once)
+          "chaos": chaos_round_once,
+          "stream": stream_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0
