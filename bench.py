@@ -29,9 +29,24 @@ Round-3 hardening (VERDICT.md item 1):
   BENCH_TPU_attempt.json next to this file, so a mid-round TPU number
   survives even if the end-of-round capture flakes.
 
+TPU-lane reliability (ROADMAP item 2 — the probe used to time out and
+every invocation re-paid the full acquisition):
+- runtime acquisition is CACHED: a successful probe writes
+  ~/.cache/cylon_tpu/bench_probe.json and is trusted for BENCH_PROBE_TTL
+  seconds (default 600), so a sweep or a watchdog wake doesn't burn
+  5 x 120 s re-discovering a tunnel that was healthy a minute ago.
+  Failures are never cached — a flaky tunnel must keep re-probing.
+- the per-row sweep is RESUMABLE: BENCH_SWEEP="1000000,8000000,..."
+  runs one killable child per row size, appending each JSON line to
+  BENCH_SWEEP_OUT (default BENCH_sweep.jsonl next to this file); rows
+  already captured there (same size, no error, matching platform class)
+  are skipped on restart, so a tunnel death mid-sweep costs one row,
+  not the sweep.
+
 Env knobs: BENCH_ROWS, BENCH_REPS, BENCH_INIT_TIMEOUT (s), BENCH_INIT_TRIES,
 BENCH_FORCE_CPU=1, BENCH_CHILD_TIMEOUT (s — watchdog on the measured TPU run,
-which executes in a killable subprocess; BENCH_CHILD is internal).
+which executes in a killable subprocess; BENCH_CHILD is internal),
+BENCH_PROBE_TTL (s), BENCH_SWEEP, BENCH_SWEEP_OUT, BENCH_SWEEP_ROW_TIMEOUT.
 """
 import json
 import os
@@ -176,9 +191,50 @@ def record_tpu_attempt(payload: dict) -> None:
         pass  # recording is best-effort; never break the bench line
 
 
+PROBE_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "cylon_tpu", "bench_probe.json"
+)
+
+
+def _probe_cache_fresh(ttl_s: float) -> bool:
+    """A probe success within the TTL stands in for re-probing: the sweep
+    and the watchdog both re-invoke bench.py, and each cold probe costs up
+    to tries x timeout against a tunnel that was verified moments ago.
+    Only SUCCESS is ever cached — a failure must keep re-probing because
+    the tunnel flakes in windows and recovers."""
+    try:
+        with open(PROBE_CACHE) as f:
+            c = json.load(f)
+        age = time.time() - float(c.get("unix", 0))
+        if c.get("ok") and age < ttl_s:
+            print(
+                f"bench: TPU probe cached ok "
+                f"({c.get('platform', '?')}, age {age:.0f}s)",
+                file=sys.stderr,
+            )
+            return True
+    except (OSError, ValueError, TypeError):
+        pass
+    return False
+
+
+def _probe_cache_store(platform: str) -> None:
+    try:
+        os.makedirs(os.path.dirname(PROBE_CACHE), exist_ok=True)
+        with open(PROBE_CACHE, "w") as f:
+            json.dump(
+                {"ok": True, "platform": platform, "unix": time.time()}, f
+            )
+    except OSError:
+        pass  # caching is best-effort
+
+
 def probe_tpu(timeout_s: float, tries: int) -> bool:
     """Can the default (TPU) backend initialize? Checked in a child process
     because a hung backend init cannot be interrupted in-process."""
+    ttl = float(os.environ.get("BENCH_PROBE_TTL", 600))
+    if ttl > 0 and _probe_cache_fresh(ttl):
+        return True
     code = (
         "import jax; d = jax.devices(); "
         "print(d[0].platform, d[0].device_kind, sep='|')"
@@ -194,6 +250,7 @@ def probe_tpu(timeout_s: float, tries: int) -> bool:
             if r.returncode == 0 and r.stdout.strip():
                 plat = r.stdout.strip().splitlines()[-1]
                 print(f"bench: TPU probe ok ({plat})", file=sys.stderr)
+                _probe_cache_store(plat)
                 return True
             print(
                 f"bench: TPU probe attempt {attempt + 1}/{tries} failed "
@@ -250,6 +307,60 @@ def run_child_tpu(timeout_s: float) -> bool:
         return True
     print(f"bench: TPU child failed rc={r.returncode}", file=sys.stderr)
     return False
+
+
+def run_sweep(rows_list, out_path: str) -> None:
+    """Resumable per-row sweep: one killable child per row size, each JSON
+    line appended to ``out_path`` as it lands. Restarting skips rows that
+    already have a clean capture (value > 0, no error), so a mid-sweep
+    tunnel death costs the in-flight row only. Error rows are recorded for
+    the log but NOT marked done — the resume retries them."""
+    done = set()
+    try:
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("value") and "error" not in rec:
+                    done.add(int(rec.get("rows", -1)))
+    except OSError:
+        pass
+    row_timeout = float(os.environ.get("BENCH_SWEEP_ROW_TIMEOUT", 900))
+    for n in rows_list:
+        if n in done:
+            print(
+                f"bench: sweep row {n} already captured, skipping",
+                file=sys.stderr,
+            )
+            continue
+        env = dict(os.environ)
+        env["BENCH_ROWS"] = str(n)
+        env.pop("BENCH_SWEEP", None)  # the child measures ONE row
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True,
+                text=True,
+                timeout=row_timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench: sweep row {n} timed out after {row_timeout:.0f}s "
+                "— resumable, rerun to retry",
+                file=sys.stderr,
+            )
+            continue
+        sys.stderr.write(r.stderr[-1000:])
+        lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+        if not lines:
+            print(f"bench: sweep row {n} produced no JSON", file=sys.stderr)
+            continue
+        with open(out_path, "a") as f:
+            f.write(lines[-1] + "\n")
+        print(lines[-1], flush=True)
 
 
 def main():
@@ -368,7 +479,15 @@ def main():
 
 if __name__ == "__main__":
     try:
-        main()
+        sweep = os.environ.get("BENCH_SWEEP", "")
+        if sweep and os.environ.get("BENCH_CHILD", "0") != "1":
+            out = os.environ.get(
+                "BENCH_SWEEP_OUT",
+                os.path.join(REPO_DIR, "BENCH_sweep.jsonl"),
+            )
+            run_sweep([int(x) for x in sweep.split(",") if x], out)
+        else:
+            main()
     except Exception as e:  # fail-soft: a parseable line beats a traceback
         import traceback
 

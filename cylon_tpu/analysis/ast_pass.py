@@ -58,6 +58,21 @@ JIT_WRAPPERS = {"jit", "shard_map", "make_jaxpr", "pmap"}
 # kinds whose reads must be threaded into a reachable cache key
 KEYED_KINDS = {"impl", "kill-switch"}
 
+# method names shared with the builtin containers: an ``x.append(...)``
+# or ``cfg.get(...)`` in engine code is overwhelmingly a list/dict/set
+# operation, so the unique-name fallback must never hand those calls to
+# whichever analyzed class happens to define the name — one class method
+# called ``append`` would otherwise absorb every list append in every
+# kernel body (phantom call edges => phantom trace-time knob reads).
+# Genuine calls on such methods still resolve through the class-scoped
+# ``self.`` path and module-alias attribute path.
+_CONTAINER_METHODS = frozenset(
+    m
+    for t in (list, dict, set, frozenset, tuple, str, bytes)
+    for m in dir(t)
+    if not m.startswith("_")
+)
+
 _LINT_RE = re.compile(
     r"#\s*lint:\s*(key|keyed|operand|guarded|sync)\s*=\s*"
     r"([A-Za-z0-9_.]+(?:\s*,\s*[A-Za-z0-9_.]+)*)"
@@ -174,6 +189,8 @@ class _Analysis:
         return None
 
     def _unique_method(self, meth: str) -> Optional[str]:
+        if meth in _CONTAINER_METHODS:
+            return None
         cands = self.method_index.get(meth, [])
         return cands[0] if len(cands) == 1 else None
 

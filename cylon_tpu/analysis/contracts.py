@@ -136,6 +136,37 @@ def fused_q3_collectives(respill: int, num_slices: int = 1) -> int:
     return 2 * num_slices * (1 + respill) + 3
 
 
+#: a two-hop exchange under a declared 2-D topology (PR 17) issues
+#: exactly TWO grouped all_to_alls where the flat exchange issues one:
+#: the inner-axis combining hop plus the outer-axis shipping hop. The
+#: count still rides the header rows of hop 1 and the re-fused combined
+#: headers of hop 2 — the sync discipline is unchanged.
+TWO_HOP_COLLECTIVES_PER_EXCHANGE = 2
+
+
+def shuffle_two_hop_collectives(k: int) -> int:
+    """A K-round chunked shuffle under a 2-D topology: 2K grouped
+    all_to_alls (inner + outer hop per round), still zero extra host
+    syncs — the per-axis byte accounting is host arithmetic."""
+    return TWO_HOP_COLLECTIVES_PER_EXCHANGE * k
+
+
+def fused_join_two_hop_collectives(respill: int) -> int:
+    """The fused join step with a 2-D topology threaded through the
+    pipeline: each side's (1 + respill) exchanges decompose into 2
+    grouped all_to_alls, plus the same 2 overflow psums."""
+    return 2 * TWO_HOP_COLLECTIVES_PER_EXCHANGE * (1 + respill) + 2
+
+
+def fused_q3_two_hop_collectives(respill: int, num_slices: int = 1) -> int:
+    """The fused q3 step under a 2-D topology: the pair's sliced
+    two-hop shuffle rounds plus the same 3 psums."""
+    return (
+        2 * TWO_HOP_COLLECTIVES_PER_EXCHANGE * num_slices * (1 + respill)
+        + 3
+    )
+
+
 #: per-table host syncs of one chunked shuffle: the count-phase fetch and
 #: the ONE deferred round-count fetch after the last dispatch — both in
 #: ``_shuffle_many``, and K-independent by construction
@@ -629,6 +660,48 @@ CONTRACTS: Dict[str, CollectiveContract] = {
         ),
         collectives=lambda respill: fused_q3_collectives(respill),
         all_to_all=lambda respill: 2 * (1 + respill),
+        psum=3,
+    ),
+    "shuffle_two_hop": CollectiveContract(
+        name="shuffle_two_hop",
+        description=(
+            "K-round hash shuffle under a declared 2-D topology (PR 17): "
+            "2K grouped all_to_alls — the inner-axis combining hop plus "
+            "the outer-axis shipping hop per round — with the SAME 2-site "
+            "sync discipline as the flat shuffle (counts ride headers on "
+            "both hops). The CYLON_TPU_NO_TOPO kill switch restores "
+            "shuffle_single's census exactly"
+        ),
+        collectives=shuffle_two_hop_collectives,
+        all_to_all=shuffle_two_hop_collectives,
+        host_syncs=SHUFFLE_HOST_SYNCS_PER_TABLE,
+        sync_sites=SHUFFLE_SYNC_SITES,
+    ),
+    "fused_join_step_topo": CollectiveContract(
+        name="fused_join_step_topo",
+        description=(
+            "fully fused distributed join program with a 2-D topology "
+            "threaded through the pipeline: 2 x 2 x (1 + respill) grouped "
+            "all_to_alls (each side's exchange = inner hop + outer hop) "
+            "+ the same 2 overflow psums, all inside ONE XLA program"
+        ),
+        collectives=lambda respill: fused_join_two_hop_collectives(respill),
+        all_to_all=lambda respill: 2
+        * TWO_HOP_COLLECTIVES_PER_EXCHANGE
+        * (1 + respill),
+        psum=2,
+    ),
+    "q3_fused_step_topo": CollectiveContract(
+        name="q3_fused_step_topo",
+        description=(
+            "fused join->groupby-SUM (q3) program with a 2-D topology: "
+            "2 x 2 x (1 + respill) grouped all_to_alls + 3 psums (2 "
+            "overflow reductions + the global grand-total)"
+        ),
+        collectives=lambda respill: fused_q3_two_hop_collectives(respill),
+        all_to_all=lambda respill: 2
+        * TWO_HOP_COLLECTIVES_PER_EXCHANGE
+        * (1 + respill),
         psum=3,
     ),
     "eager_sync_free": CollectiveContract(

@@ -18,6 +18,15 @@ Paths covered (the ISSUE-6 registry):
 - ``fused_join_step``  — the fully fused join program (jaxpr census);
 - ``q3_fused_step``    — the fused join->groupby-SUM (q3) program.
 
+The ISSUE-17 topology entries:
+
+- ``shuffle_two_hop``  — eager shuffle under a declared 4x2 topology:
+  2K grouped all_to_alls, flat sync discipline, and the kill switch
+  restores ``shuffle_single``'s census exactly;
+- ``fused_join_step_topo`` / ``q3_fused_step_topo`` — the fused
+  programs with a two-hop exchange (jaxpr census: doubled all_to_all,
+  identical psums).
+
 And the ISSUE-7 sync-freedom entries:
 
 - ``eager_sync_free``  — filter/groupby/unique dispatch with ZERO
@@ -322,6 +331,91 @@ def run_q3_fused_step(ctx, _rng) -> List[PlanResult]:
     ]
 
 
+def _topo_context(world: int = 8):
+    """A dryrun context with a declared 4x2 topology (PR 17)."""
+    import jax
+
+    import cylon_tpu as ct
+
+    return ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=jax.devices()[:world], mesh_shape="4x2")
+    )
+
+
+def run_shuffle_two_hop(ctx, rng) -> List[PlanResult]:
+    """The two-hop eager shuffle (PR 17): under a declared 4x2 topology
+    every round's exchange is TWO grouped all_to_alls (inner combine +
+    outer ship) with the flat shuffle's exact 2-site sync discipline;
+    flipping the CYLON_TPU_NO_TOPO kill switch on the SAME context
+    restores shuffle_single's census — the 1-D collective-count-identity
+    acceptance pin."""
+    from ..parallel import topo as _topo
+    from ..utils.tracing import get_count, report, reset_trace
+
+    ctx2 = _topo_context()
+    t = _shuffle_table(ctx2, rng)
+    contract = CONTRACTS["shuffle_two_hop"]
+
+    def op():
+        return t.shuffle(["k"])
+
+    reset_trace()
+    op()
+    k = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    res = _measure(op, contract, k)
+    if not get_count("shuffle.coll_bytes.inter"):
+        res.violations.append(
+            "shuffle_two_hop: the per-axis byte counters never moved — "
+            "the plan is not exercising the two-hop path"
+        )
+    out = [res]
+    with _topo.disabled():
+        flat = _measure(op, CONTRACTS["shuffle_single"], k)
+        flat.name = "shuffle_two_hop_killswitch"
+        out.append(flat)
+    return out
+
+
+def run_fused_join_step_topo(ctx, _rng) -> List[PlanResult]:
+    from ..ops import join as _j
+    from ..parallel.pipeline import make_distributed_join_step
+    from ..parallel.topo import Topology
+
+    contract = CONTRACTS["fused_join_step_topo"]
+
+    def make(respill):
+        return make_distributed_join_step(
+            ctx.mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,),
+            how=_j.INNER, bucket_cap=32, join_cap=512, respill=respill,
+            topo=Topology(4, 2),
+        )
+
+    return [
+        _fused_step_census(ctx, make, respill, contract)
+        for respill in (0, 1)
+    ]
+
+
+def run_q3_fused_step_topo(ctx, _rng) -> List[PlanResult]:
+    from ..ops import join as _j
+    from ..parallel.pipeline import make_join_groupby_step
+    from ..parallel.topo import Topology
+
+    contract = CONTRACTS["q3_fused_step_topo"]
+
+    def make(respill):
+        return make_join_groupby_step(
+            ctx.mesh, ctx.axis_name, l_key_idx=(0,), r_key_idx=(0,),
+            agg_col_idx=1, how=_j.INNER, bucket_cap=32, join_cap=512,
+            group_cap=512, respill=respill, topo=Topology(4, 2),
+        )
+
+    return [
+        _fused_step_census(ctx, make, respill, contract)
+        for respill in (0, 1)
+    ]
+
+
 def run_eager_sync_free(ctx, rng) -> List[PlanResult]:
     """The dispatch-async eager ops (ISSUE 7): filter, groupby and unique
     dispatched WITHOUT materializing the results must perform ZERO
@@ -406,6 +500,9 @@ PLAN_RUNNERS = [
     run_dist_join_semi,
     run_fused_join_step,
     run_q3_fused_step,
+    run_shuffle_two_hop,
+    run_fused_join_step_topo,
+    run_q3_fused_step_topo,
     run_eager_sync_free,
     run_q3_dispatch,
 ]

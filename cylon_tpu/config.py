@@ -141,6 +141,16 @@ class TPUConfig(CommConfig):
     built — the analog of mpirun launching N ranks (reference
     net/mpi/mpi_communicator.cpp:51-66, lazy MPI_Init). On TPU pods the three
     values are auto-detected when left None.
+
+    Topology: ``mesh_shape="OxI"`` declares a LOGICAL 2-D factorization
+    (outer x inner, product = device count) of the still-1-D mesh —
+    device p is (outer group p // inner, inner index p % inner), so an
+    inner group is a contiguous device range (ICI neighbors on a TPU
+    slice). A 2-D topology makes every shuffle a two-hop exchange
+    (parallel/topo.py): inner-axis all_to_all first, combined cross-group
+    chunks over the outer axis second. Default None (flat, unchanged);
+    env ``CYLON_TPU_MESH`` applies when the config leaves it unset;
+    ``CYLON_TPU_NO_TOPO=1`` kills the decomposition at dispatch time.
     """
 
     def __init__(
@@ -150,6 +160,7 @@ class TPUConfig(CommConfig):
         coordinator_address: Optional[str] = None,
         num_processes: Optional[int] = None,
         process_id: Optional[int] = None,
+        mesh_shape: Optional[str] = None,
     ):
         super().__init__()
         self.devices = devices
@@ -157,6 +168,7 @@ class TPUConfig(CommConfig):
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
         self.process_id = process_id
+        self.mesh_shape = mesh_shape
 
     def comm_type(self) -> CommType:
         return CommType.TPU

@@ -130,7 +130,10 @@ class CylonContext:
                 )
         devices = config.devices if config.devices is not None else jax.devices()
         mesh = Mesh(np.asarray(devices), (config.axis_name,))
-        return cls(mesh, config.axis_name, config.comm_type())
+        ctx = cls(mesh, config.axis_name, config.comm_type())
+        if getattr(config, "mesh_shape", None):
+            ctx.add_config("mesh_shape", str(config.mesh_shape))
+        return ctx
 
     # -- identity -----------------------------------------------------------
     def get_world_size(self) -> int:
@@ -182,6 +185,23 @@ class CylonContext:
         from .config import sketch_bits
 
         return sketch_bits(self._config.get("sketch_bits"))
+
+    @property
+    def topology(self):
+        """Declared logical 2-D topology (config KV ``mesh_shape`` >
+        CYLON_TPU_MESH env > None = flat), validated against the mesh
+        size and resolved once per context. This is the DECLARED shape;
+        the per-shuffle decision (which also honors the
+        CYLON_TPU_NO_TOPO kill switch and collapses degenerate 1xN/Nx1
+        factorizations) is ``parallel.topo.effective(ctx)``."""
+        cached = self.__dict__.get("_topology_cache")
+        if cached is None:
+            from .parallel import topo as _topo
+
+            spec = self._config.get("mesh_shape") or _topo.MESH_ENV.get()
+            cached = (_topo.parse_mesh(spec, self.mesh.size),)
+            self.__dict__["_topology_cache"] = cached
+        return cached[0]
 
     @property
     def quant_tol(self) -> float:

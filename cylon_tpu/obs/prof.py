@@ -99,12 +99,21 @@ from . import metrics as _metrics
 STAGE_WEIGHTS: Dict[str, float] = {
     "pack": 3.0,
     "collective": 1.0,
+    "coll_inner": 1.0,
+    "coll_outer": 1.0,
     "compact": 1.0,
     "relay": 4.0,
 }
 
-#: render/lay-out order of the stage tracks (pipeline order)
-STAGE_ORDER: Tuple[str, ...] = ("pack", "collective", "compact", "relay")
+#: render/lay-out order of the stage tracks (pipeline order). A two-hop
+#: topology shuffle (parallel/topo.py) splits the single ``collective``
+#: track into per-axis ``coll_inner`` (grouped inner all_to_all) and
+#: ``coll_outer`` (combined-chunk outer all_to_all) clocks — flat
+#: shuffles keep the merged track, so the ledger is comparable across
+#: the CYLON_TPU_NO_TOPO differential.
+STAGE_ORDER: Tuple[str, ...] = (
+    "pack", "collective", "coll_inner", "coll_outer", "compact", "relay"
+)
 
 #: the key under which a QueryTrace carries its attached StageProfiles
 #: (``__``-prefixed: the exporters exclude it from plain attr rendering
@@ -207,19 +216,36 @@ def shuffle_units(
 ) -> Dict[str, np.ndarray]:
     """Per-shard weighted work units of one ``_shuffle_many`` call from
     its host-known plan: ``parts`` is one ``(send_counts [src, dst],
-    n_rounds, bucket_cap, relay-or-None)`` tuple per shuffled table.
-    Pure numpy over counts the phase-0 fetch already returned."""
+    n_rounds, bucket_cap, relay-or-None, topo_plan-or-None)`` tuple per
+    shuffled table (``topo_plan`` = the two-hop ``(outer, inner, cap_o,
+    n_header)`` when the 2-D topology decomposed the exchange). Pure
+    numpy over counts the phase-0 fetch already returned."""
     units = {s: np.zeros(world, np.float64) for s in STAGE_ORDER}
-    for send_counts, n_rounds, bucket_cap, relay in parts:
+    for send_counts, n_rounds, bucket_cap, relay, topo_plan in parts:
         m = np.asarray(send_counts, np.float64).reshape(-1, world)
         k = max(int(n_rounds), 1)
         # pack scans the local table once per round
         units["pack"] += STAGE_WEIGHTS["pack"] * k * m.sum(axis=1)
         # the collective ships K x world x cap padded slots per shard —
-        # uniform by construction (the padding IS the skew cost)
-        units["collective"] += (
-            STAGE_WEIGHTS["collective"] * k * world * int(bucket_cap)
-        )
+        # uniform by construction (the padding IS the skew cost). A
+        # two-hop plan splits the clock per axis: the inner grouped
+        # all_to_all still moves world x cap slots, the outer hop moves
+        # outer x cap_o COMBINED slots (the decomposition's saving
+        # reads directly off this track vs the flat world x cap).
+        if topo_plan is not None:
+            outer, inner, cap_o = (
+                int(topo_plan[0]), int(topo_plan[1]), int(topo_plan[2])
+            )
+            units["coll_inner"] += (
+                STAGE_WEIGHTS["coll_inner"] * k * world * int(bucket_cap)
+            )
+            units["coll_outer"] += (
+                STAGE_WEIGHTS["coll_outer"] * k * outer * cap_o
+            )
+        else:
+            units["collective"] += (
+                STAGE_WEIGHTS["collective"] * k * world * int(bucket_cap)
+            )
         # compact front-packs what each shard received
         units["compact"] += STAGE_WEIGHTS["compact"] * m.sum(axis=0)
         if relay is not None:

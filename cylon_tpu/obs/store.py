@@ -130,7 +130,18 @@ def new_profile() -> Dict[str, Any]:
         "coll_sum": 0,       # total collective bytes shipped
         "rounds_sum": 0,
         "wire_n": 0,         # wire-narrowing engagements
-        "relay_n": 0,        # skew-split relays
+        "relay_n": 0,
+        # 2-D topology hop-mode evidence (parallel/topo.py): per
+        # observation the exec record carries the cross-outer bytes of
+        # BOTH hop modes (one measured, one modeled — both host-exact
+        # formulas), accumulated by mode so the hop_mode proposer
+        # (plan/feedback.py) compares means regardless of which ran
+        "topo": None,        # last observed (outer, inner)
+        "hop_n": 0,          # observations carrying hop evidence
+        "hop2_n": 0,         # of those, ran two-hop
+        "hop_i2_sum": 0,     # cross-outer bytes under two-hop
+        "hop_i1_sum": 0,     # cross-outer bytes under flat (1-hop)
+        "intra_sum": 0,      # inner-axis bytes actually shipped        # skew-split relays
         "sel_sum": 0.0,      # semi-filter selectivity accumulator
         "sel_n": 0,
         # straggler ledger (obs/prof.py stage clocks): the max per-stage
@@ -303,6 +314,24 @@ def _absorb_record(profiles: Dict, hists: Dict, rec: Dict, seq: int) -> int:
         p["relay_n"] += 1 if rec.get("relay") else 0
         if rec.get("static_budget"):
             p["static_budget"] = int(rec["static_budget"])
+        # 2-D topology hop evidence: both modes' cross-outer bytes per
+        # observation (one measured, one modeled — see note_shuffle)
+        if rec.get("topo") is not None:
+            p["topo"] = list(rec["topo"])
+            p["hop_n"] = p.get("hop_n", 0) + 1
+            ran2 = bool(rec.get("hop2"))
+            p["hop2_n"] = p.get("hop2_n", 0) + (1 if ran2 else 0)
+            inter = int(rec.get("inter", 0))
+            alt = int(rec.get("inter_alt", -1))
+            if ran2:
+                p["hop_i2_sum"] = p.get("hop_i2_sum", 0) + inter
+                if alt >= 0:
+                    p["hop_i1_sum"] = p.get("hop_i1_sum", 0) + alt
+            else:
+                p["hop_i1_sum"] = p.get("hop_i1_sum", 0) + inter
+                if alt >= 0:
+                    p["hop_i2_sum"] = p.get("hop_i2_sum", 0) + alt
+            p["intra_sum"] = p.get("intra_sum", 0) + int(rec.get("intra", 0))
         sels = rec.get("sel")
         if sels:
             for s in sels:
@@ -788,9 +817,23 @@ def note_shuffle(
     static_budget: int = 0,
     wire: bool = False,
     relay: bool = False,
+    topo: Optional[tuple] = None,
+    hop2: bool = False,
+    intra: int = 0,
+    inter: int = 0,
+    inter_alt: int = -1,
 ) -> None:
     """Fold one shuffle's planner measurements into the active exec
-    record (table._shuffle_many phase 1 — data the host already holds)."""
+    record (table._shuffle_many phase 1 — data the host already holds).
+
+    ``topo``/``hop2``/``intra``/``inter`` carry the 2-D topology
+    evidence (parallel/topo.py): the declared (outer, inner) shape,
+    whether the two-hop decomposition ran, and the exact per-axis
+    collective bytes it shipped. ``inter_alt`` is the OTHER hop mode's
+    modeled cross-outer bytes for the same plan (both formulas are
+    host-exact), so the feedback proposer (plan/feedback.py hop_mode)
+    compares the modes on every observation regardless of which one
+    ran; -1 = no topology, no evidence."""
     rec = _EXEC.get()
     if rec is None:
         return
@@ -809,6 +852,13 @@ def note_shuffle(
         rec["wire"] = True
     if relay:
         rec["relay"] = True
+    if topo is not None:
+        rec["topo"] = list(topo)
+        rec["hop2"] = bool(hop2)
+        rec["intra"] = rec.get("intra", 0) + int(intra)
+        rec["inter"] = rec.get("inter", 0) + int(inter)
+        if inter_alt >= 0:
+            rec["inter_alt"] = rec.get("inter_alt", 0) + int(inter_alt)
 
 
 def note_semi(

@@ -41,6 +41,7 @@ from ..ops import join as _j
 from ..ops import partition as _p
 from ..ops.sort import KeyCol
 from . import shuffle as _sh
+from . import topo as _topo
 
 
 class ShardTable(NamedTuple):
@@ -68,6 +69,33 @@ def fused_exchange_bytes(
     return per_side * (row_bytes_l + row_bytes_r)
 
 
+def fused_axis_bytes(
+    world: int,
+    bucket_cap: int,
+    respill: int,
+    row_bytes: int,
+    topo: Optional[_topo.Topology],
+    num_slices: int = 1,
+) -> Tuple[int, int]:
+    """(intra, inter) collective bytes of one side's fused shuffles — the
+    fused twin of ``topo.axis_coll_bytes`` feeding the same
+    ``shuffle.coll_bytes.{intra,inter}`` counters. The STRUCTURED two-hop
+    (``topo.exchange_buffer_structured``) keeps cap-sized chunks, so the
+    cross-outer volume equals the flat exchange's — the win is message
+    aggregation ((outer - 1) combined transfers instead of (P - inner)
+    small ones over the slow fabric) — while the inner hop re-ships every
+    chunk across the fast links once more. Flat on a declared 2-D mesh
+    splits by destination group; no topology counts everything inter."""
+    k = max(num_slices * (1 + respill), 1)
+    rows_chunk = bucket_cap + _sh.HEADER_ROWS
+    if topo is None:
+        return 0, k * world * (world - 1) * rows_chunk * row_bytes
+    o, i = topo
+    intra = k * world * (i - 1) * o * rows_chunk * row_bytes
+    inter = k * world * (o - 1) * i * rows_chunk * row_bytes
+    return intra, inter
+
+
 def _shuffle_rounds(
     st: ShardTable,
     cnt: jax.Array,
@@ -77,6 +105,7 @@ def _shuffle_rounds(
     axis_name: str,
     respill: int,
     quant=None,
+    topo: Optional[_topo.Topology] = None,
 ) -> Tuple[ShardTable, jax.Array]:
     """The shared respill-round loop: ``dest_fn(r) -> (dest, leftover)``
     supplies each round's send slots (plain hash shuffle or one hash
@@ -108,7 +137,7 @@ def _shuffle_rounds(
         dest, leftover = dest_fn(r)
         got, recv_counts = _sh.exchange_columns_fused(
             st.cols, dest, _sh.round_counts(cnt, bucket_cap, r),
-            world, bucket_cap, axis_name, wire=wire,
+            world, bucket_cap, axis_name, wire=wire, topo=topo,
         )
         for ci, dv in enumerate(got):
             parts[ci].append(dv)
@@ -133,6 +162,7 @@ def shuffle_shard(
     axis_name: str,
     respill: int = 1,
     quant=None,
+    topo: Optional[_topo.Topology] = None,
 ) -> Tuple[ShardTable, jax.Array]:
     """Static-capacity hash shuffle of one table (per-shard code).
 
@@ -150,7 +180,7 @@ def shuffle_shard(
     return _shuffle_rounds(
         st, cnt,
         lambda r: _sh.build_send_slots_round(pid, cnt, world, bucket_cap, r),
-        world, bucket_cap, axis_name, respill, quant=quant,
+        world, bucket_cap, axis_name, respill, quant=quant, topo=topo,
     )
 
 
@@ -173,6 +203,7 @@ def sliced_shuffle_shard(
     axis_name: str,
     respill: int = 1,
     quant=None,
+    topo: Optional[_topo.Topology] = None,
 ) -> Tuple[ShardTable, jax.Array]:
     """One hash-slice's shuffle, driven by the precomputed
     :class:`shuffle.SlicePlan` (one combined sort serves every slice —
@@ -182,7 +213,7 @@ def sliced_shuffle_shard(
     return _shuffle_rounds(
         st, cnt,
         lambda r: _sh.slice_round_dest(plan, slice_idx, bucket_cap, r),
-        world, bucket_cap, axis_name, respill, quant=quant,
+        world, bucket_cap, axis_name, respill, quant=quant, topo=topo,
     )
 
 
@@ -229,6 +260,7 @@ def make_distributed_join_step(
     num_slices: int = 1,
     quant_l=None,
     quant_r=None,
+    topo: Optional[_topo.Topology] = None,
 ):
     """Build the jittable distributed-join step over the mesh.
 
@@ -238,6 +270,13 @@ def make_distributed_join_step(
     through each fused shuffle, block scales in the exchange headers.
     Static build parameters: the caller's kernel cache key must include
     them (table._fused_join appends the pair).
+
+    ``topo``: the effective 2-D topology (parallel/topo.effective) — each
+    fused shuffle's exchange then routes as the structured two-hop
+    (inner grouped all_to_all, then outer; topo.exchange_buffer_
+    structured) with an output layout identical to the flat collective.
+    Static build parameter like the quant specs: it joins the caller's
+    cache key, and the CYLON_TPU_NO_TOPO differential passes None here.
 
     Signature of the returned fn (global, row-sharded arrays):
       (l_cols, l_counts[P], r_cols, r_counts[P]) ->
@@ -282,11 +321,11 @@ def make_distributed_join_step(
         if num_slices == 1:
             lt, ovl = shuffle_shard(
                 lt0, l_key_idx, world, bucket_cap, axis_name, respill,
-                quant=quant_l,
+                quant=quant_l, topo=topo,
             )
             rt, ovr = shuffle_shard(
                 rt0, r_key_idx, world, bucket_cap, axis_name, respill,
-                quant=quant_r,
+                quant=quant_r, topo=topo,
             )
             jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
             overflow = jnp.stack([ovl + ovr, ovj])
@@ -312,11 +351,11 @@ def make_distributed_join_step(
             ov_sh, ov_j = carry
             lt, ovl = sliced_shuffle_shard(
                 lt0, plan_l, s, world, bucket_cap, axis_name, respill,
-                quant=quant_l,
+                quant=quant_l, topo=topo,
             )
             rt, ovr = sliced_shuffle_shard(
                 rt0, plan_r, s, world, bucket_cap, axis_name, respill,
-                quant=quant_r,
+                quant=quant_r, topo=topo,
             )
             jt, ovj = join_shard(lt, rt, l_key_idx, r_key_idx, how, join_cap)
             # validity presence is a STATIC per-column property (identical
@@ -411,6 +450,7 @@ def make_join_groupby_step(
     quant_l=None,
     quant_r=None,
     quant_tol: float = 0.0,
+    topo: Optional[_topo.Topology] = None,
 ):
     """Distributed join followed by groupby-sum on the join key and a global
     psum'd total — the TPC-H Q3-ish fused step used by benchmarks and the
@@ -435,11 +475,11 @@ def make_join_groupby_step(
         if world > 1:
             lt, _ = shuffle_shard(
                 lt, l_key_idx, world, bucket_cap, axis_name, respill,
-                quant=quant_l,
+                quant=quant_l, topo=topo,
             )
             rt, _ = shuffle_shard(
                 rt, r_key_idx, world, bucket_cap, axis_name, respill,
-                quant=quant_r,
+                quant=quant_r, topo=topo,
             )
         # group key == join key and SUM over a floating LEFT column: the
         # whole join+groupby collapses into the probe sort (per key run,

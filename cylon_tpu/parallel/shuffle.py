@@ -224,6 +224,7 @@ def relay_send_slots(
     num_partitions: int,
     quota,
     relay_cap: int,
+    sel: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Destination slot in the [relay_cap] RELAY buffer for every row whose
     within-bucket position is past the collective quota — the skew-split
@@ -239,6 +240,13 @@ def relay_send_slots(
     splits each source's buffer into per-destination runs with the
     planner's own [src, dst] relay counts — no count lane needed. Rows
     under quota (and padding) get the dropped slot ``relay_cap``.
+
+    ``sel``: optional [P] bool per-DESTINATION selector — the two-hop
+    engine splits one relay tail into the device ppermute ring (same
+    outer group) and the host relay (cross-outer) by running this twice
+    with complementary selectors. Selection keeps a subsequence of the
+    destination-major order, so the host's per-destination-run split
+    still works against the selector-masked relay count matrix.
     """
     cap = pid.shape[0]
     order = shuffle_gather_order(pid, num_partitions)
@@ -248,6 +256,8 @@ def relay_send_slots(
     pos = jnp.arange(cap, dtype=jnp.int32) - starts[safe_pid]
     q = jnp.asarray(quota, jnp.int32)
     ok = (spid < num_partitions) & (pos >= q)
+    if sel is not None:
+        ok = ok & sel[safe_pid]
     slot_sorted = jnp.where(
         ok, jnp.cumsum(ok.astype(jnp.int32)) - 1, relay_cap
     ).astype(jnp.int32)
@@ -574,6 +584,7 @@ def exchange_columns_fused(
     axis_name: str,
     wire=None,
     bases: Optional[jax.Array] = None,
+    topo=None,
 ) -> Tuple[List[Tuple[jax.Array, Optional[jax.Array]]], jax.Array]:
     """:func:`exchange_columns` with the COUNT EXCHANGE FUSED into the
     payload collective: the per-destination round send counts ride the
@@ -594,7 +605,21 @@ def exchange_columns_fused(
     Returns (received cols, recv_counts [P]). Tables with no int32 lanes at
     all (pure f64, no validity masks) fall back to a dedicated tiny count
     exchange — there is no lane buffer for the header to ride.
+
+    ``topo``: an optional :class:`~cylon_tpu.parallel.topo.Topology` —
+    each payload collective then routes as the STRUCTURED two-hop
+    (:func:`~cylon_tpu.parallel.topo.exchange_buffer_structured`):
+    identical received layout (recv_counts, chunk order, headers all
+    unchanged), but same-outer-group rows never cross the outer links.
     """
+    if topo is not None:
+        from . import topo as _topo
+
+        def _xchg(buf):
+            return _topo.exchange_buffer_structured(buf, topo, axis_name)
+    else:
+        def _xchg(buf):
+            return exchange_buffer(buf, num_partitions, axis_name)
     qrows = None
     header_extra = None
     nq8 = len(wire_q8_cols(wire)) if wire is not None else 0
@@ -617,7 +642,7 @@ def exchange_columns_fused(
             lanes, dest, counts_round, num_partitions, bucket_cap,
             header_extra=header_extra, n_header=n_header,
         )
-        got = exchange_buffer(buf, num_partitions, axis_name)
+        got = _xchg(buf)
         data, recv_counts = split_header(got, num_partitions, n_header)
         if nq8:
             qsc_rows = recv_row_scales(
@@ -629,8 +654,8 @@ def exchange_columns_fused(
         recv_counts = exchange_counts(counts_round, axis_name)
 
     def handle_pt(ci):
-        return exchange_column(
-            passthrough[ci], dest, num_partitions, bucket_cap, axis_name
+        return _xchg(
+            scatter_send(passthrough[ci], dest, num_partitions, bucket_cap)
         )
 
     def make_valid(lane):

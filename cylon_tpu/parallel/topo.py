@@ -1,0 +1,558 @@
+"""Topology-aware two-hop shuffle: the (outer x inner) decomposition of
+the flat P-way all_to_all.
+
+A pod slice is not a crossbar: inner-axis neighbors share fast ICI links
+while cross-group traffic rides the slow (DCN-class) outer hop, and the
+flat exchange pays every (src, dst) chunk's pow2 padding across the
+slowest link. This module teaches the chunked engine a LOGICAL 2-D
+topology ``(outer, inner)`` over the existing 1-D device mesh — device
+``p`` has outer group ``p // inner`` and inner index ``p % inner``
+(outer-major, so an inner group is a contiguous device range = physical
+ICI neighbors on a TPU slice) — and decomposes each round's exchange
+into TWO grouped collectives ("Memory-efficient array redistribution",
+arXiv 2112.01075: axis-wise decompositions into portable collective
+sequences with O(chunk) peak memory):
+
+  hop 1 (inner axis): ``lax.all_to_all`` over each inner group routes
+    every row to the group-mate whose inner index matches the row's
+    DESTINATION inner index. The packed chunk headers ride along, so
+    after hop 1 device ``(o_s, i_d)`` holds, for every outer group
+    ``o_d``, the rows all its group-mates send to ``(o_d, i_d)`` — with
+    exact per-(source, o_d) counts parsed from the headers.
+  hop 2 (outer axis): same-group rows (``o_d == o_s``) are FINAL after
+    hop 1 and never touch the outer hop. Cross-outer rows are DENSELY
+    repacked (header-count cumsum offsets — no sort) into one combined
+    chunk per remote outer group, sized ``cap_o`` = the host-planned max
+    cross-outer aggregate, and shipped over the outer-axis all_to_all.
+
+Cross-outer padded-chunk overhead drops from O(P * cap) to
+O(outer * cap_o): the flat exchange pads every one of the (P - inner)
+remote chunks to the global bucket cap, the two-hop exchange pads
+(outer - 1) combined chunks to the aggregate max — group-local traffic
+(the common case for time- or range-clustered keys) never crosses the
+outer axis at all, and a skewed remote bucket's padding is paid
+(outer - 1) times instead of (P - inner) times.
+
+The skew tail upgrades with the same decomposition: intra-group relay
+rows ride a device-direct inner-axis ``ppermute`` ring
+(:func:`ring_relay`) instead of the host relay — only cross-outer tails
+still detour through the host (parallel/spill.fetch_relay).
+
+Everything here is a pure function of the 1-D mesh: no Mesh /
+axis_name / PartitionSpec changes anywhere, so ``CYLON_TPU_NO_TOPO=1``
+(and any 1-D mesh) keeps the engine byte-identical to the flat path.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import envgate as _envgate
+
+# kill switch: CYLON_TPU_NO_TOPO=1 forces the flat 1-hop exchange on any
+# mesh — the flat-oracle differential for tests/benches. The gate
+# decision rides every two-hop kernel cache key (table._shuffle_state
+# appends the effective topology) and the plan fingerprint
+# (plan/lazy.gated_fingerprint includes gate_state()).
+enabled, disabled = _envgate.env_gate(
+    "CYLON_TPU_NO_TOPO",
+    keyed_via="effective topology joins every shuffle kernel cache key "
+    "(table._shuffle_state) and the plan fingerprint "
+    "(plan/lazy.gated_fingerprint via topo.gate_state)",
+    note="=1 forces the flat 1-hop all_to_all on 2-D meshes (flat-oracle "
+    "differential); 1-D meshes are always flat",
+)
+
+# the 2-D mesh shape request: "OxI" (e.g. "4x2") — outer x inner, read
+# once at context init (TPUConfig.mesh_shape wins over the env). The RAW
+# value also joins gate_state so a mid-process re-point re-fingerprints.
+MESH_ENV = _envgate.EnvKnob(
+    "CYLON_TPU_MESH", "", kind="startup",
+    note="2-D topology 'OxI' (outer x inner), e.g. '4x2'; product must "
+    "equal the mesh world size; unset = flat 1-D",
+)
+
+
+class Topology(NamedTuple):
+    """The logical 2-D factorization of the 1-D mesh: ``world ==
+    outer * inner``; device ``p`` = (outer group ``p // inner``, inner
+    index ``p % inner``)."""
+
+    outer: int
+    inner: int
+
+
+def parse_mesh(spec: str, world: int) -> Optional[Topology]:
+    """'OxI' -> Topology, validated against the mesh world size.
+    Returns None for '' (flat). Degenerate factors (outer or inner == 1)
+    are accepted but collapse to flat in :func:`effective`."""
+    s = spec.strip().lower()
+    if not s:
+        return None
+    parts = s.split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"CYLON_TPU_MESH/mesh_shape {spec!r}: expected 'OxI' (e.g. 4x2)"
+        )
+    try:
+        o, i = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"CYLON_TPU_MESH/mesh_shape {spec!r}: non-integer factors"
+        ) from None
+    if o < 1 or i < 1:
+        raise ValueError(f"mesh_shape {spec!r}: factors must be >= 1")
+    if o * i != world:
+        raise ValueError(
+            f"mesh_shape {spec!r}: {o}x{i} != world size {world}"
+        )
+    return Topology(o, i)
+
+
+def effective(ctx) -> Optional[Topology]:
+    """The topology the engine actually decomposes over: the context's
+    resolved 2-D shape, unless the kill switch is flipped or either axis
+    is degenerate (a 1xN / Nx1 factorization IS the flat exchange)."""
+    topo = getattr(ctx, "topology", None)
+    if topo is None or not enabled():
+        return None
+    if topo.outer <= 1 or topo.inner <= 1:
+        return None
+    return topo
+
+
+def gate_state() -> tuple:
+    """The topology component of the plan fingerprint / executable
+    identity (plan/lazy.gated_fingerprint): the kill switch AND the raw
+    mesh request — a mid-process flip of either must re-optimize and
+    re-key, never alias a cached flat/two-hop executor."""
+    return (enabled(), MESH_ENV.get())
+
+
+def inner_groups(topo: Topology) -> Tuple[Tuple[int, ...], ...]:
+    """axis_index_groups of the inner-axis collectives: one group per
+    outer group, contiguous device ranges (ICI neighbors)."""
+    o, i = topo
+    return tuple(tuple(g * i + j for j in range(i)) for g in range(o))
+
+
+def outer_groups(topo: Topology) -> Tuple[Tuple[int, ...], ...]:
+    """axis_index_groups of the outer-axis collectives: one group per
+    inner index, stride-``inner`` device combs."""
+    o, i = topo
+    return tuple(tuple(g * i + j for g in range(o)) for j in range(i))
+
+
+def ring_perm(topo: Topology) -> Tuple[Tuple[int, int], ...]:
+    """The inner-axis neighbor ring of :func:`ring_relay`: every device
+    forwards to its next group-mate (wrapping), so after t hops a device
+    holds the buffer its group-mate ``(i - t) mod inner`` extracted."""
+    o, i = topo
+    return tuple(
+        (g * i + j, g * i + (j + 1) % i) for g in range(o) for j in range(i)
+    )
+
+
+# ----------------------------------------------------------------------
+# host planning: the outer-hop capacity and the per-axis byte ledger
+# ----------------------------------------------------------------------
+
+class TwoHopPlan(NamedTuple):
+    """Host-planned static state of one two-hop shuffle (joins the coll /
+    compact kernel cache keys through table._shuffle_state)."""
+
+    outer: int
+    inner: int
+    cap_o: int        # outer-hop combined-chunk capacity (pow2)
+    n_header: int     # header rows per chunk (1 — q8 plans stay flat)
+
+
+def hop2_window_counts(
+    send_counts: np.ndarray, topo: Topology, bucket_cap: int, n_rounds: int
+) -> np.ndarray:
+    """[rounds, world, outer] cross-outer aggregates: entry (r, p, o_d) =
+    rows device ``p = (o_s, i_d)`` ships to outer group ``o_d`` in round
+    r's hop 2 = sum over group-mates i_s of the round window of
+    ``send_counts[(o_s, i_s), (o_d, i_d)]``. Same-group entries
+    (o_d == o_s) are zeroed — those rows are final after hop 1."""
+    o, i = topo
+    world = o * i
+    m = np.asarray(send_counts, np.int64).reshape(world, world)
+    out = np.zeros((max(n_rounds, 1), world, o), np.int64)
+    for r in range(max(n_rounds, 1)):
+        w = np.clip(m - r * bucket_cap, 0, bucket_cap)
+        # w4[o_s, i_s, o_d, i_d]; aggregate over source inner index
+        w4 = w.reshape(o, i, o, i)
+        agg = w4.sum(axis=1)  # [o_s, o_d, i_d]
+        for g in range(o):
+            agg[g, g, :] = 0
+        # device (o_s, i_d) -> per-o_d aggregate
+        out[r] = agg.transpose(0, 2, 1).reshape(world, o)
+    return out
+
+
+def plan_two_hop(
+    send_counts: np.ndarray,
+    topo: Topology,
+    bucket_cap: int,
+    n_rounds: int,
+    n_header: int,
+) -> TwoHopPlan:
+    """Size the outer hop from the already-fetched count matrix: cap_o =
+    round_cap of the largest per-(device, remote outer group, round)
+    aggregate — exact, so the dense hop-2 repack can never overflow."""
+    from ..engine import round_cap
+
+    agg = hop2_window_counts(send_counts, topo, bucket_cap, n_rounds)
+    cap_o = round_cap(int(agg.max()) if agg.size else 0)
+    return TwoHopPlan(topo.outer, topo.inner, cap_o, n_header)
+
+
+# per-axis budgeting: the outer hop's per-round combined buffer is
+# ``outer * (cap_o + n_header) * row_bytes``. With the default (shared)
+# shuffle budget it always fits — cap_o <= inner * cap, so
+# outer * cap_o <= P * cap, the bound the inner budget already paid. A
+# TIGHTER outer budget (a slow DCN-class outer fabric) makes the planner
+# halve the GLOBAL byte budget — more, smaller rounds — until the
+# combined buffer fits (the clamp loop lives in table._shuffle_many
+# beside the round planner it re-runs).
+OUTER_BUDGET = _envgate.EnvKnob(
+    "CYLON_TPU_OUTER_BUDGET", "", kind="tuning",
+    keyed_via="budget -> cross-outer combined-chunk capacity (cap_o) -> "
+    "static shapes of the two-hop coll/compact kernels' operands AND "
+    "the TwoHopPlan tuple in their dispatch keys",
+    note="per-round cross-outer (hop 2) exchange byte budget for 2-D "
+    "topologies; unset = the shared shuffle byte budget (never binds)",
+)
+
+
+def outer_budget() -> int:
+    """Configured outer-hop byte budget; 0 = unset (shared budget)."""
+    v = OUTER_BUDGET.get()
+    return int(v) if v else 0
+
+
+def axis_coll_bytes(
+    topo: Optional[Topology],
+    world: int,
+    bucket_cap: int,
+    n_rounds: int,
+    row_bytes: int,
+    n_header: int,
+    cap_o: Optional[int] = None,
+) -> Tuple[int, int]:
+    """(intra, inter) collective bytes of one shuffle — the per-axis
+    ledger behind ``shuffle.coll_bytes.{intra,inter}``. Self-chunks of an
+    all_to_all never leave the device, so they count in neither axis.
+
+    flat (topo known but 1-hop, or ``cap_o is None``): every round ships
+    (P - 1) remote chunks of (cap + header) rows per device — (inner - 1)
+    of them same-group (intra), (P - inner) cross-group (inter).
+    two-hop: hop 1 ships (inner - 1) remote chunks of outer*(cap+header)
+    rows (intra); hop 2 ships (outer - 1) combined chunks of
+    (cap_o + header) rows (inter).
+    """
+    k = max(int(n_rounds), 1)
+    rows_chunk = int(bucket_cap) + int(n_header)
+    if topo is None:
+        # no topology: the whole flat exchange is "inter" by convention
+        # (no inner axis exists to be near)
+        return 0, k * world * (world - 1) * rows_chunk * int(row_bytes)
+    o, i = topo
+    if cap_o is None:
+        intra = k * world * (i - 1) * rows_chunk * int(row_bytes)
+        inter = k * world * (world - i) * rows_chunk * int(row_bytes)
+        return intra, inter
+    intra = k * world * (i - 1) * o * rows_chunk * int(row_bytes)
+    inter = k * world * (o - 1) * (int(cap_o) + int(n_header)) * int(row_bytes)
+    return intra, inter
+
+
+def split_relay(
+    relay: Optional[np.ndarray], topo: Topology
+) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+    """(intra, inter) split of the skew-relay [src, dst] count matrix:
+    same-outer-group tails ride the device ppermute ring, cross-outer
+    tails keep the host relay. Either part collapses to None when empty."""
+    if relay is None:
+        return None, None
+    o, i = topo
+    world = o * i
+    m = np.asarray(relay, np.int64).reshape(world, world)
+    same = np.equal.outer(np.arange(world) // i, np.arange(world) // i)
+    intra = np.where(same, m, 0)
+    inter = np.where(same, 0, m)
+    return (
+        intra if intra.sum() else None,
+        inter if inter.sum() else None,
+    )
+
+
+def ring_cap(relay_intra: np.ndarray) -> int:
+    """Pow2 per-source ring buffer rows: the largest intra-group tail any
+    single device extracts."""
+    from ..engine import round_cap
+
+    return round_cap(int(np.asarray(relay_intra).sum(axis=1).max()))
+
+
+def ring_bytes(topo: Topology, cap_ri: int, row_bytes: int) -> int:
+    """ICI bytes the relay ring ships per device: (inner - 1) ppermute
+    steps x the ring buffer (payload rows + the int32 pid lane)."""
+    return (topo.inner - 1) * int(cap_ri) * (int(row_bytes) + 4)
+
+
+# ----------------------------------------------------------------------
+# device-side primitives (per-shard code inside shard_map)
+# ----------------------------------------------------------------------
+
+def exchange_buffer_grouped(
+    buf, num_partitions: int, axis_name: str, groups
+):
+    """:func:`~cylon_tpu.parallel.shuffle.exchange_buffer` restricted to
+    ``axis_index_groups``: an all_to_all among each group's members only.
+    Chunk s of the output holds what the group-mate at position s sent."""
+    import jax
+
+    trailing = buf.shape[1:]
+    rows = buf.shape[0] // num_partitions
+    return jax.lax.all_to_all(
+        buf.reshape(num_partitions, rows, *trailing),
+        axis_name,
+        split_axis=0,
+        concat_axis=0,
+        tiled=False,
+        axis_index_groups=[list(g) for g in groups],
+    ).reshape(num_partitions * rows, *trailing)
+
+
+def chunks_to_inner_major(buf, topo: Topology, rows: int):
+    """Permute a [P * rows, *t] chunked send buffer from global-pid order
+    (o_d, i_d) to inner-destination-major (i_d, o_d) order — the hop-1
+    layout, where chunk j aggregates everything bound for inner index j.
+    Pure reshape/transpose; headers ride inside their chunks."""
+    o, i = topo
+    trailing = buf.shape[1:]
+    return (
+        buf.reshape(o, i, rows, *trailing)
+        .transpose(1, 0, *range(2, 2 + 1 + len(trailing)))
+        .reshape(o * i * rows, *trailing)
+    )
+
+
+def hop2_slots(cnt, topo: Topology, bucket_cap: int, cap_o: int,
+               n_header: int, o_self, with_header: bool):
+    """Dense hop-2 scatter destinations: for the hop-1 received buffer
+    flattened [inner * outer * bucket_cap] (headers stripped), element
+    (i_s, o_d, pos) is live iff pos < cnt[i_s, o_d] and o_d != o_self;
+    its slot front-packs chunk o_d via the exclusive cumsum of cnt over
+    i_s. Returns int32 [inner * outer * bucket_cap]; dead elements get
+    the dropped sentinel (one past the buffer)."""
+    import jax.numpy as jnp
+
+    o, i = topo
+    rows2 = (cap_o + n_header) if with_header else cap_o
+    idx = jnp.arange(i * o * bucket_cap, dtype=jnp.int32)
+    i_s = idx // (o * bucket_cap)
+    o_d = (idx // bucket_cap) % o
+    pos = idx % bucket_cap
+    c = cnt.astype(jnp.int32)
+    off = jnp.cumsum(c, axis=0) - c  # exclusive over i_s per o_d
+    live = (pos < c[i_s, o_d]) & (o_d != o_self)
+    base = n_header if with_header else 0
+    return jnp.where(
+        live,
+        o_d * rows2 + base + off[i_s, o_d] + pos,
+        o * rows2,
+    ).astype(jnp.int32)
+
+
+def exchange_buffer_structured(buf, topo: Topology, axis_name: str):
+    """Structured two-hop drop-in for
+    :func:`~cylon_tpu.parallel.shuffle.exchange_buffer` — same input
+    (send chunks in global-pid order), SAME output layout (chunk p =
+    what source shard p sent), but routed as inner-hop-then-outer-hop:
+    permute chunks inner-dest-major, all_to_all each inner group (now
+    big-chunk o_d holds every group-mate's rows for (o_d, i_self)),
+    transpose to outer-dest-major, all_to_all each outer comb. Chunk
+    (o_s, i_s) of the result is source (o_s, i_s)'s rows with original
+    headers, so ``split_header(got, P)`` and every downstream consumer
+    are unchanged. No padded-slot savings (chunks stay cap-sized) — the
+    win is that same-outer-group rows land in the outer hop's self chunk
+    and never cross the outer links. The fused pipeline rides this
+    variant; the eager engine uses the count-informed dense
+    :func:`two_hop_exchange`."""
+    o, i = topo
+    rows = buf.shape[0] // (o * i)
+    t = buf.shape[1:]
+    nd = list(range(3 + len(t)))
+    swap = [1, 0] + nd[2:]
+    b1 = (
+        buf.reshape(o, i, rows, *t).transpose(swap).reshape(buf.shape)
+    )
+    g1 = exchange_buffer_grouped(b1, i, axis_name, inner_groups(topo))
+    b2 = (
+        g1.reshape(i, o, rows, *t).transpose(swap).reshape(buf.shape)
+    )
+    return exchange_buffer_grouped(b2, o, axis_name, outer_groups(topo))
+
+
+def self_chunk(got1, topo: Topology, rows: int, o_self):
+    """Extract the same-outer-group sub-chunks of the hop-1 received
+    buffer [inner * outer * rows, *t]: -> [inner * rows, *t] (these rows
+    are FINAL — their destination is this device)."""
+    import jax
+
+    o, i = topo
+    g = got1.reshape(i, o, rows, *got1.shape[1:])
+    return jax.lax.dynamic_index_in_dim(
+        g, o_self, axis=1, keepdims=False
+    ).reshape(i * rows, *got1.shape[1:])
+
+
+def two_hop_exchange(
+    head,
+    pts,
+    topo: Topology,
+    bucket_cap: int,
+    cap_o: int,
+    n_header: int,
+    axis_name: str,
+):
+    """The two-hop collective kernel body (replaces the flat
+    ``exchange_buffer`` round): takes the STANDARD header-augmented send
+    buffer [P * (cap + H), L] (the pack kernel is unchanged) plus the
+    headerless passthrough buffers [P * cap, *t], returns
+
+      (got2, self_rows, self_cnt, pts2, pts_self)
+
+    where ``self_rows [inner * cap, L]`` / ``pts_self`` carry the
+    same-group rows (final after hop 1) with per-source counts
+    ``self_cnt [inner]``, and ``got2 [outer * (cap_o + H), L]`` /
+    ``pts2`` carry the densely-combined cross-outer chunks after the
+    outer hop (headers carry the combined counts). The compact kernel
+    (:func:`two_hop_received`) fuses both parts into one front-pack."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    o, i = topo
+    igroups, ogroups = inner_groups(topo), outer_groups(topo)
+    me = lax.axis_index(axis_name)
+    o_self = (me // i).astype(jnp.int32)
+    rows1 = bucket_cap + n_header
+
+    # hop 1: permute chunks inner-major, all_to_all each inner group
+    got1 = exchange_buffer_grouped(
+        chunks_to_inner_major(head, topo, rows1), i, axis_name, igroups
+    )
+    g1 = got1.reshape(i, o, rows1, got1.shape[-1])
+    cnt = g1[:, :, 0, 0].astype(jnp.int32)  # [i_s, o_d] exact counts
+    self_rows = self_chunk(got1, topo, rows1, o_self)
+    self_rows = self_rows.reshape(i, rows1, -1)[:, n_header:].reshape(
+        i * bucket_cap, -1
+    )
+    self_cnt = lax.dynamic_index_in_dim(
+        cnt, o_self, axis=1, keepdims=False
+    )
+
+    # hop 2: dense repack of the cross-outer rows + combined-count headers
+    data1 = g1[:, :, n_header:].reshape(i * o * bucket_cap, -1)
+    slots = hop2_slots(
+        cnt, topo, bucket_cap, cap_o, n_header, o_self, with_header=True
+    )
+    rows2 = cap_o + n_header
+    buf2 = jnp.zeros((o * rows2, data1.shape[-1]), head.dtype)
+    tot = jnp.where(
+        jnp.arange(o, dtype=jnp.int32) != o_self, cnt.sum(axis=0), 0
+    ).astype(head.dtype)
+    buf2 = buf2.at[jnp.arange(o, dtype=jnp.int32) * rows2, 0].set(tot)
+    buf2 = buf2.at[slots].set(data1, mode="drop")
+    got2 = exchange_buffer_grouped(buf2, o, axis_name, ogroups)
+
+    # passthrough columns ride the same routing, headerless
+    pslots = hop2_slots(
+        cnt, topo, bucket_cap, cap_o, n_header, o_self, with_header=False
+    )
+    pts2 = []
+    pts_self = []
+    for p in pts:
+        p1 = exchange_buffer_grouped(
+            chunks_to_inner_major(p, topo, bucket_cap), i, axis_name,
+            igroups,
+        )
+        pts_self.append(self_chunk(p1, topo, bucket_cap, o_self))
+        pbuf = jnp.zeros((o * cap_o, *p1.shape[1:]), p1.dtype)
+        pbuf = pbuf.at[pslots].set(p1, mode="drop")
+        pts2.append(exchange_buffer_grouped(pbuf, o, axis_name, ogroups))
+    return got2, self_rows, self_cnt, tuple(pts2), tuple(pts_self)
+
+
+def two_hop_received(
+    got2,
+    self_rows,
+    self_cnt,
+    topo: Topology,
+    bucket_cap: int,
+    cap_o: int,
+    n_header: int,
+):
+    """Receive-side fusion of the two buffers into ONE (rows, mask,
+    total) triple the standard lane compaction consumes: the same-group
+    rows first (mask from the hop-1 header counts), then the hop-2
+    combined chunks (mask from the received combined counts — the self
+    chunk arrives empty by construction)."""
+    import jax.numpy as jnp
+
+    from . import shuffle as _sh
+
+    o, i = topo
+    data2, recv2 = _sh.split_header(got2, o, n_header)
+    mask2, tot2 = _sh.received_row_mask(recv2, o, cap_o)
+    pos = jnp.arange(bucket_cap, dtype=jnp.int32)
+    mask1 = (pos[None, :] < self_cnt[:, None]).reshape(i * bucket_cap)
+    rows = jnp.concatenate([self_rows, data2], axis=0)
+    mask = jnp.concatenate([mask1, mask2])
+    total = (self_cnt.sum() + tot2).astype(jnp.int32)
+    return rows, mask, total
+
+
+def ring_relay(
+    lanes_mat,
+    pid_lane,
+    pts,
+    topo: Topology,
+    axis_name: str,
+):
+    """Device-direct intra-group skew relay: rotate the extracted tail
+    buffers around the inner-axis neighbor ring ((inner - 1) ppermute
+    steps — never a host crossing), absorbing at every step the rows
+    whose pid lane names this device. Returns the stacked
+    ([inner * cap_ri, L] lanes, [inner * cap_ri] mask, stacked pts) —
+    step t's slice holds group-mate (i_self - t) mod inner's buffer with
+    only rows destined here live. Dead slots carry pid -1 (never
+    matches)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    i = topo.inner
+    perm = list(ring_perm(topo))
+    me = lax.axis_index(axis_name).astype(jnp.int32)
+    lanes_steps: List = []
+    mask_steps: List = []
+    pts_steps: List[List] = [[] for _ in pts]
+    buf, pidl, ptl = lanes_mat, pid_lane, list(pts)
+    for t in range(i):
+        mask_steps.append(pidl == me)
+        lanes_steps.append(buf)
+        for j, p in enumerate(ptl):
+            pts_steps[j].append(p)
+        if t + 1 < i:
+            buf = lax.ppermute(buf, axis_name, perm)
+            pidl = lax.ppermute(pidl, axis_name, perm)
+            ptl = [lax.ppermute(p, axis_name, perm) for p in ptl]
+    lanes_all = jnp.concatenate(lanes_steps, axis=0)
+    mask_all = jnp.concatenate(mask_steps, axis=0)
+    pts_all = tuple(jnp.concatenate(s, axis=0) for s in pts_steps)
+    return lanes_all, mask_all, pts_all

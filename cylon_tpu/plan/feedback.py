@@ -116,6 +116,15 @@ class Decisions(NamedTuple):
     #: static trigger ignores; ``table._shuffle_many`` threads it into
     #: ``spill.plan_schedule(trigger=)``
     skew_trigger: Optional[int] = None
+    #: topology hop mode (parallel/topo.py): ``"1hop"`` forces the flat
+    #: single all_to_all on a declared 2-D mesh when the observed
+    #: per-axis bytes show the two-hop decomposition saves nothing
+    #: cross-outer (dense cross-group traffic drives cap_o to its
+    #: I*cap ceiling — the extra inner hop is then pure cost);
+    #: ``"2hop"`` pins the decomposition; None = the default (two-hop
+    #: whenever a topology is declared). Policy only: both modes are
+    #: row-exact, the CYLON_TPU_NO_TOPO oracle pins it.
+    hop_mode: Optional[str] = None
 
 
 DECISIONS_OFF = Decisions()
@@ -255,6 +264,11 @@ def tuned_skew_trigger() -> Optional[int]:
     return d.skew_trigger if d is not None else None
 
 
+def tuned_hop_mode() -> Optional[str]:
+    d = _APPLIED.get()
+    return d.hop_mode if d is not None else None
+
+
 # ----------------------------------------------------------------------
 # proposers + hysteresis (called by the store as observations absorb)
 # ----------------------------------------------------------------------
@@ -279,6 +293,7 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         dec.get("spill_tier"),
         dec.get("footprint"),
         dec.get("skew_trigger"),
+        dec.get("hop_mode"),
     )
 
 
@@ -371,6 +386,15 @@ def _proposals(
         ):
             cand, ok = _skew_trigger_proposal(p, mg)
             out["skew_trigger"] = (cand, ok)
+
+        # -- topology hop mode: 1-hop vs 2-hop from the observed
+        # per-axis bytes (parallel/topo.py). Every observation on a
+        # 2-D-declared shape carries BOTH modes' cross-outer bytes
+        # (note_shuffle's inter/inter_alt — exact host formulas), so
+        # the comparison never needs an exploratory flip ---------------
+        if p.get("hop_n", 0) >= m and p.get("topo"):
+            cand, ok = _hop_mode_proposal(p, mg)
+            out["hop_mode"] = (cand, ok)
 
         # -- admission footprint: lease observed bytes, not the static
         # input-size estimate. The p95 of the ledger-attributed per-query
@@ -498,6 +522,29 @@ def _skew_trigger_proposal(p: Dict[str, Any], mg: float) -> Tuple[Any, bool]:
     )
 
 
+def _hop_mode_proposal(p: Dict[str, Any], mg: float) -> Tuple[Any, bool]:
+    """Candidate hop mode from the per-axis byte evidence.
+
+    Two-hop exists to shrink the cross-outer (slow-axis) traffic: the
+    padded-chunk overhead drops from O(world * cap) to O(outer * cap_o),
+    but only when traffic is clustered enough that cap_o stays under its
+    I*cap ceiling — a dense cross-group workload gets NO outer saving
+    and pays the inner hop on top. The profile holds both modes' mean
+    cross-outer bytes for the same observed plans, so: propose "1hop"
+    when two-hop's cross-outer bytes fail to undercut flat's by the
+    margin (the decomposition is pure cost here), settle back to None
+    (the two-hop default) once the saving clears it. Results are
+    identical either way — only bytes and recompiles move."""
+    n = max(int(p.get("hop_n", 1)), 1)
+    i2 = p.get("hop_i2_sum", 0) / n
+    i1 = p.get("hop_i1_sum", 0) / n
+    if i1 <= 0:
+        return (None, True)
+    if i2 > i1 * (1.0 - mg):
+        return ("1hop", True)
+    return (None, True)
+
+
 def _serve_bucket_proposal(
     p: Dict[str, Any], target: float, mg: float
 ) -> Tuple[Any, bool]:
@@ -572,5 +619,10 @@ def describe(base: tuple) -> list:
             f"skew_trigger tuned: {d.skew_trigger}x-mean "
             f"(was {SKEW_MIN_RATIO}x-mean, "
             f"n={p.get('strag_n', 0)})"
+        )
+    if d.hop_mode is not None:
+        lines.append(
+            f"hop_mode tuned: {d.hop_mode} "
+            f"(was 2hop-on-topology, n={p.get('hop_n', 0)})"
         )
     return lines

@@ -57,6 +57,7 @@ def gated_fingerprint(plan: Node) -> tuple:
     from ..ops.stats import enabled as _pack_enabled
     from ..ordering import enabled as _ord_enabled
     from ..parallel.spill import gate_state as _spill_gate
+    from ..parallel.topo import gate_state as _topo_gate
 
     # the spill component carries the forced-tier knob and the skew-split
     # gate: both are host dispatch policy, but a cached executor's lowered
@@ -67,9 +68,15 @@ def gated_fingerprint(plan: Node) -> tuple:
     # shuffle's codec picks, so a flip (including turning the tier on)
     # re-optimizes and re-keys the serving batch cache instead of
     # aliasing an exact-wire executor
+    # the topo component carries the 2-D topology kill switch + the
+    # CYLON_TPU_MESH declaration: together with the per-context
+    # mesh_shape (which rides the shuffle kernel cache keys), they
+    # decide whether every lowered exchange is flat or two-hop — a
+    # mid-process flip re-optimizes instead of aliasing a two-hop
+    # executor onto a flat run (parallel/topo.py)
     base = (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
-        _spill_gate(), _quant_gate(),
+        _spill_gate(), _quant_gate(), _topo_gate(),
     )
     # the feedback component: (autotune active, tuned Decisions) — every
     # telemetry-driven override (shuffle budget, semi mode, serve bucket,

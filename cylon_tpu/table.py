@@ -54,6 +54,7 @@ from .ops import stats as _st
 from .fault import errors as _fault_errors
 from .parallel import shuffle as _sh
 from .parallel import spill as _spill
+from .parallel import topo as _topo
 from .obs import prof as _prof
 from .obs import resource as _obsres
 from .obs import store as _obsstore
@@ -1915,12 +1916,19 @@ class Table:
             join_cap = round_cap(2 * (1 + respill) * world * bucket_cap)
         else:
             join_cap = round_cap(cap_l + cap_r)
+        # the effective 2-D topology routes every fused shuffle as the
+        # structured two-hop (parallel/topo.py); a static build parameter
+        # exactly like the quant specs — it joins the step cache key below
+        topo_cfg = _topo.effective(ctx) if world > 1 else None
         for attempt in range(max_retries):
             if world > 1:
                 # fused-path exchange accounting: same counter family the
                 # eager planner feeds, so fused and eager regimes compare
                 # like-for-like in BENCH / EXPLAIN (pipeline.py helper)
-                from .parallel.pipeline import fused_exchange_bytes
+                from .parallel.pipeline import (
+                    fused_axis_bytes,
+                    fused_exchange_bytes,
+                )
 
                 bump(
                     "shuffle.exchanged_bytes",
@@ -1931,6 +1939,17 @@ class Table:
                         num_slices,
                     ),
                 )
+                for rb_side in (
+                    _sh.exchange_row_bytes(lflat),
+                    _sh.exchange_row_bytes(rflat),
+                ):
+                    fi, fo = fused_axis_bytes(
+                        world, bucket_cap, respill, rb_side, topo_cfg,
+                        num_slices,
+                    )
+                    if fi:
+                        bump("shuffle.coll_bytes.intra", rows=fi)
+                    bump("shuffle.coll_bytes.inter", rows=fo)
             # the quantized wire tier rides the fused shuffles too: per-
             # side codec specs (key columns excluded) are static build
             # parameters, so they join the step cache key — a tolerance
@@ -1945,6 +1964,7 @@ class Table:
                 "fused_join", howi, lk_idx, rk_idx, len(lflat), len(rflat),
                 bucket_cap, join_cap, respill, num_slices,
                 _st.enabled(), quant_l, quant_r,
+                ("topo", tuple(topo_cfg) if topo_cfg else None),
             ) + _j.impl_tag()
             cache = ctx.__dict__.setdefault("_jit_cache", {})
             step = cache.get(key)
@@ -1952,7 +1972,7 @@ class Table:
                 step = make_distributed_join_step(
                     ctx.mesh, ctx.axis_name, lk_idx, rk_idx, howi,
                     bucket_cap, join_cap, respill, num_slices,
-                    quant_l=quant_l, quant_r=quant_r,
+                    quant_l=quant_l, quant_r=quant_r, topo=topo_cfg,
                 )
                 cache[key] = step
             t0_prof = _time.perf_counter()
@@ -3340,10 +3360,14 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
     # probe changes both kernels' bodies, so its statics join the key,
     # and so do the stats columns the count pass measures and the
     # quantized-tier codec signature (tolerance flips recompile, never
-    # alias)
+    # alias). The effective 2-D topology (parallel/topo.py; None = flat /
+    # CYLON_TPU_NO_TOPO) joins too: the relay builder reads it and the
+    # coll/compact dispatch keys below carry the full two-hop plan, so a
+    # mesh-shape or kill-switch flip recompiles, never aliases.
+    topo_cfg = _topo.effective(ctx)
     key = (
         "shuffle", kind, key_idx, asc0, nb, plan_sig, tm_key, stat_cols,
-        quant_sig,
+        quant_sig, ("topo", tuple(topo_cfg) if topo_cfg else None),
     ) + (
         ("semi", spec.probe_row, spec.use_range) if semi else ()
     )
@@ -3454,8 +3478,22 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         return kern
 
     def build_coll():
+        # late-bound like st["wire"]: the two-hop plan (st["topo_plan"],
+        # a topo.TwoHopPlan or None) is decided on the host after the
+        # count fetch; the dispatch key carries its full tuple, so each
+        # decision compiles its own program
         def kern(dp, rep):
             (head, pts) = dp
+            tp = st["topo_plan"]
+            if tp is not None:
+                # two-hop exchange: inner grouped all_to_all, dense
+                # count-informed cross-outer repack, outer grouped
+                # all_to_all — the pack output rides in UNCHANGED
+                bc = head.shape[0] // world - tp.n_header
+                return _topo.two_hop_exchange(
+                    head, pts, _topo.Topology(tp.outer, tp.inner),
+                    bc, tp.cap_o, tp.n_header, ax,
+                )
             # a decided wire plan guarantees word lanes even when the
             # plain codec had none (pure-f64 quantized tables)
             if has_lanes or st["wire"] is not None:
@@ -3494,7 +3532,15 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 )
             rc = dummy.shape[0]
             cnt = _sh.bucket_counts(pid, world)
-            dest = _sh.relay_send_slots(pid, cnt, world, quota, rc)
+            sel = None
+            if st["relay_mode"] == "inter":
+                # two-hop relay split: same-outer-group tails left this
+                # kernel for the device ppermute ring (build_ring); only
+                # cross-outer tails still cross the host
+                inner = st["topo_plan"].inner
+                o_self = jax.lax.axis_index(ax) // inner
+                sel = (jnp.arange(world, dtype=jnp.int32) // inner) != o_self
+            dest = _sh.relay_send_slots(pid, cnt, world, quota, rc, sel=sel)
             if relay_qcols:
                 lanes, passthrough, qcodes, qscales = (
                     _g_pack.pack_cols_quant(
@@ -3523,9 +3569,101 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
 
         return kern
 
+    def build_ring():
+        # device-direct intra-group skew relay (parallel/topo.ring_relay):
+        # the same tail extraction as build_relay, restricted to SAME-
+        # outer-group destinations, packed as plain int32 lanes plus a
+        # destination-pid lane, then rotated around the inner-axis
+        # ppermute neighbor ring with every device absorbing its own rows
+        # — the tail never crosses a host. Compacted in-kernel; the host
+        # rebuilds from the planner's own intra relay counts (no extra
+        # fetch beyond the one deferred count stack).
+        def kern(dp, rep):
+            if semi:
+                (cols, kcols, counts, sk) = dp
+                (dummy, quota, usef) = rep
+            else:
+                (cols, kcols, counts) = dp
+                (dummy, quota) = rep
+            n = counts[0]
+            pid = compute_pid(cols, kcols, n)
+            if semi:
+                pid = jnp.where(
+                    (usef != 0) & ~probe_ok(cols, sk), world, pid
+                )
+            rc = dummy.shape[0]
+            cnt = _sh.bucket_counts(pid, world)
+            tp = st["topo_plan"]
+            o_self = jax.lax.axis_index(ax) // tp.inner
+            sel = (
+                jnp.arange(world, dtype=jnp.int32) // tp.inner
+            ) == o_self
+            dest = _sh.relay_send_slots(
+                pid, cnt, world, quota, rc, sel=sel
+            )
+            _plan2, lanes, passthrough = _g_pack.pack_cols(list(cols))
+            if lanes:
+                mat = _sh.scatter_send(
+                    jnp.stack(lanes, axis=1), dest, 1, rc
+                )
+            else:
+                mat = jnp.zeros((rc, 0), jnp.int32)
+            pidl = jnp.full((rc,), -1, jnp.int32).at[dest].set(
+                pid, mode="drop"
+            )
+            pts = tuple(
+                _sh.scatter_send(passthrough[ci], dest, 1, rc)
+                for ci in pt_order
+            )
+            lanes_all, mask_all, pts_all = _topo.ring_relay(
+                mat, pidl, pts,
+                _topo.Topology(tp.outer, tp.inner), ax,
+            )
+            out = _sh.compact_received_lanes(
+                list(plan_sig),
+                lanes_all if has_lanes else None,
+                dict(zip(pt_order, pts_all)),
+                mask_all,
+            )
+            return out, _scalar(mask_all.sum().astype(jnp.int32))
+
+        return kern
+
     def build_compact():
         def kern(dp, rep):
             wire = st["wire"]
+            tp = st["topo_plan"]
+            if tp is not None:
+                # two-hop receive: same-group rows (final after hop 1)
+                # fuse with the combined cross-outer chunks into ONE
+                # front-pack — the self chunk of the outer hop arrived
+                # empty by construction, so its mask is all dead
+                (got2, self_rows, self_cnt, pts2, ptsS) = dp
+                bc = self_rows.shape[0] // tp.inner
+                lane_rows, mask, total = _topo.two_hop_received(
+                    got2, self_rows, self_cnt,
+                    _topo.Topology(tp.outer, tp.inner),
+                    bc, tp.cap_o, tp.n_header,
+                )
+                pt_eff = (
+                    _g_pack.wire_pt_order(wire, pt_order)
+                    if wire is not None
+                    else pt_order
+                )
+                pt_cols = {
+                    ci: jnp.concatenate([ps, p2], axis=0)
+                    for ci, ps, p2 in zip(pt_eff, ptsS, pts2)
+                }
+                if wire is not None:
+                    (bases,) = rep
+                    out = _sh.compact_received_wire(
+                        wire, bases, lane_rows, pt_cols, mask
+                    )
+                else:
+                    out = _sh.compact_received_lanes(
+                        list(plan_sig), lane_rows, pt_cols, mask
+                    )
+                return out, _scalar(total)
             (head, pts) = dp
             qsc_rows = None
             if wire is not None:
@@ -3576,9 +3714,11 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         key=key, plan_sig=plan_sig, has_lanes=has_lanes, n_pt=len(pt_order),
         pt_order=pt_order, stat_cols=stat_cols, wire=None, bases=None,
         quant_sig=quant_sig, relay_qsig=relay_qsig,
+        topo_cfg=topo_cfg, topo_plan=None, relay_mode="all", ring=None,
         build_count=build_count, build_pack=build_pack,
         build_coll=build_coll, build_compact=build_compact,
-        build_relay=build_relay, pending_spill=None,
+        build_relay=build_relay, build_ring=build_ring,
+        pending_spill=None,
     )
     return st
 
@@ -3818,7 +3958,100 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             row_bytes if st["wire"] is None
             else _g_pack.wire_row_bytes(st["wire"])
         )
-        coll_bytes = sched.coll_row_slots(w) * int(rb_eff)
+        # two-hop decision (parallel/topo.py): a configured 2-D topology
+        # routes this exchange as inner-hop + dense cross-outer hop.
+        # Requirements: word lanes for the headers to ride, and exactly
+        # one header row (q8-widened wire headers keep the flat path —
+        # their per-chunk scale blocks don't survive the hop-2 repack).
+        # The autopilot's tuned hop_mode (plan/feedback.py) can force
+        # "1hop" per shape; None defaults to two-hop when configured.
+        n_hdr = (
+            _sh.wire_header_rows(st["wire"])
+            if st["wire"] is not None
+            else _sh.HEADER_ROWS
+        )
+        two_hop_ok = (
+            st["topo_cfg"] is not None
+            and st["has_lanes_eff"]
+            and n_hdr == 1
+        )
+        if two_hop_ok and _feedback.tuned_hop_mode() != "1hop":
+            tcfg = st["topo_cfg"]
+            ob = _topo.outer_budget()
+            while True:
+                tp = _topo.plan_two_hop(
+                    st["send_counts"], tcfg, st["bucket_cap"],
+                    st["n_rounds"], n_hdr,
+                )
+                # per-axis budgeting: with the default (shared) budget
+                # the outer hop always fits (cap_o <= inner * cap, so
+                # outer * cap_o <= P * cap); a tighter CYLON_TPU_OUTER
+                # _BUDGET shrinks the global cap — more, smaller rounds
+                # — until the combined-chunk buffer fits
+                if (
+                    not ob
+                    or st["bucket_cap"] <= 8
+                    or tcfg.outer * (tp.cap_o + n_hdr) * int(rb_eff) <= ob
+                ):
+                    break
+                budget //= 2
+                sched = _spill.plan_schedule(
+                    st["send_counts"], int(rb_eff), w, budget,
+                    trigger=skew_trigger,
+                )
+                st["sched"] = sched
+                st["bucket_cap"], st["n_rounds"] = (
+                    sched.bucket_cap, sched.n_rounds,
+                )
+            st["topo_plan"] = tp
+        tp = st["topo_plan"]
+        # received-buffer capacity of one round's compact output: flat
+        # receives world cap-chunks; two-hop receives inner hop-1 self
+        # chunks + outer combined chunks
+        st["recv_cap"] = (
+            tp.inner * st["bucket_cap"] + tp.outer * tp.cap_o
+            if tp is not None
+            else w * st["bucket_cap"]
+        )
+        # per-axis byte ledger (traced counters + the hop_mode autopilot's
+        # observation substrate): intra = inner-axis/ICI bytes, inter =
+        # cross-outer bytes; inter_alt = the OTHER hop mode's inter bytes
+        # computed exactly from the same count matrix, so the feedback
+        # proposer compares modes without reconstructing anything
+        intra_b = inter_b = 0
+        st["inter_alt"] = None
+        if st["topo_cfg"] is not None:
+            intra_b, inter_b = _topo.axis_coll_bytes(
+                st["topo_cfg"], w, st["bucket_cap"], st["n_rounds"],
+                int(rb_eff), n_hdr,
+                cap_o=tp.cap_o if tp is not None else None,
+            )
+            bump("shuffle.coll_bytes.intra", rows=intra_b)
+            bump("shuffle.coll_bytes.inter", rows=inter_b)
+            annotate_add(
+                coll_bytes_intra=intra_b, coll_bytes_inter=inter_b
+            )
+        if two_hop_ok:
+            alt_cap_o = (
+                None if tp is not None
+                else _topo.plan_two_hop(
+                    st["send_counts"], st["topo_cfg"], st["bucket_cap"],
+                    st["n_rounds"], n_hdr,
+                ).cap_o
+            )
+            st["inter_alt"] = _topo.axis_coll_bytes(
+                st["topo_cfg"], w, st["bucket_cap"], st["n_rounds"],
+                int(rb_eff), n_hdr, cap_o=alt_cap_o,
+            )[1]
+            # traced beside intra/inter so one run carries BOTH modes'
+            # cross-outer bytes (tools/topo_smoke.py reads the pair for
+            # its reduction gate without a second oracle execution)
+            bump("shuffle.coll_bytes.inter_alt", rows=st["inter_alt"])
+        coll_bytes = (
+            intra_b + inter_b
+            if tp is not None
+            else sched.coll_row_slots(w) * int(rb_eff)
+        )
         annotate_add(
             coll_bytes=coll_bytes,
             shuffle_rounds=int(st["n_rounds"]),
@@ -3847,6 +4080,33 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         if st["spec"].sink is not None and tier == _spill.TIER_HBM:
             tier = _spill.TIER_HOST
         st["tier"] = tier
+        # relay ladder under a two-hop plan: same-outer-group skew tails
+        # upgrade from the host relay to the device-direct inner-axis
+        # ppermute ring (build_ring) — only cross-outer tails keep the
+        # host crossing. In-HBM plain-lane relays only: q8-staged tails
+        # and spilled shuffles keep the full host relay (their rows are
+        # host-bound anyway), and a caller-owned sink expects every row
+        # through the arena path.
+        if sched.adaptive and tp is not None:
+            intra_m, inter_m = _topo.split_relay(
+                sched.relay, st["topo_cfg"]
+            )
+            if (
+                intra_m is not None
+                and tier == _spill.TIER_HBM
+                and st["relay_qsig"] is None
+                and st["spec"].sink is None
+            ):
+                cap_ri = _topo.ring_cap(intra_m)
+                st["ring"] = (intra_m, cap_ri)
+                st["relay_inter"] = inter_m
+                st["relay_mode"] = "inter"
+                ring_b = _topo.ring_bytes(
+                    st["topo_cfg"], cap_ri, int(row_bytes)
+                )
+                bump("shuffle.relay.ring_rows", rows=int(intra_m.sum()))
+                bump("shuffle.coll_bytes.intra", rows=ring_b)
+                annotate_add(coll_bytes_intra=ring_b)
         st["src_pairs"] = list(
             zip(st["t"].column_names, st["t"]._columns.values())
         )
@@ -3904,8 +4164,13 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
         peak_rows = (
             st["t"].shard_cap
             + 2 * w * (bc + hdr_rows)
-            + staged_rounds * w * bc
+            + staged_rounds * st["recv_cap"]
             + sched.relay_cap()
+            + (
+                st["topo_cfg"].inner * st["ring"][1]
+                if st["ring"] is not None
+                else 0
+            )
         )
         st["dev_peak_bytes"] = peak_rows * row_bytes
         if tier != _spill.TIER_HBM:
@@ -3929,6 +4194,15 @@ def _shuffle_many(specs: Sequence["_ShuffleSpec"]) -> List["Table"]:
             static_budget=int(st["ctx"].shuffle_byte_budget),
             wire=st["wire"] is not None,
             relay=sched.adaptive,
+            topo=tuple(st["topo_cfg"]) if st["topo_cfg"] else None,
+            hop2=tp is not None,
+            intra=int(intra_b),
+            inter=int(inter_b),
+            inter_alt=(
+                int(st["inter_alt"])
+                if st["inter_alt"] is not None
+                else -1
+            ),
         )
     gauge(
         "shuffle.spill.peak_device_bytes",
@@ -3968,20 +4242,40 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
         for st in states:
             if not st["sched"].adaptive:
                 continue
-            rc = st["sched"].relay_cap()
-            rep = (
-                jnp.zeros((rc,), jnp.int8),
-                jnp.asarray(st["sched"].quota, jnp.int32),
-            )
             dp = (st["flat"], st["khash"], st["t"].counts_dev)
+            usef = ()
             if st["spec"].sketch is not None:
                 dp = dp + (st["spec"].sketch,)
-                rep = rep + (
+                usef = (
                     jnp.asarray(1 if st["use_filter"] else 0, jnp.int32),
                 )
+            quota = jnp.asarray(st["sched"].quota, jnp.int32)
+            if st["relay_mode"] == "inter":
+                # two-hop relay ladder: the intra-group tail rides the
+                # device ppermute ring (never a host crossing); the
+                # ring/inter/flat relay bodies differ, so each dispatches
+                # under its own key suffix
+                cap_ri = st["ring"][1]
+                with span(
+                    "shuffle.round.relay_ring",
+                    rows=int(st["ring"][0].sum()),
+                ):
+                    st["ring_out"] = get_kernel(
+                        st["ctx"], st["key"] + ("relay", "ring"),
+                        st["build_ring"],
+                    )(dp, (jnp.zeros((cap_ri,), jnp.int8), quota) + usef)
+                if st["relay_inter"] is None:
+                    continue
+            rc = st["sched"].relay_cap()
+            rep = (jnp.zeros((rc,), jnp.int8), quota) + usef
+            rkey = st["key"] + (
+                ("relay", "inter")
+                if st["relay_mode"] == "inter"
+                else ("relay",)
+            )
             with span("shuffle.round.relay", rows=st["sched"].relay_rows()):
                 st["relay_out"] = get_kernel(
-                    st["ctx"], st["key"] + ("relay",), st["build_relay"]
+                    st["ctx"], rkey, st["build_relay"]
                 )(dp, rep)
         for r in range(max(st["n_rounds"] for st in states)):
             for st in states:
@@ -4005,21 +4299,29 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                         ctx, st["key"] + ("pack", st["wire"]),
                         st["build_pack"],
                     )(dp, rep)
+                # the two-hop plan joins both dispatch keys: its cap_o /
+                # header statics are baked into the kernel bodies, so a
+                # plan (or kill-switch) flip compiles its own program
+                tp_key = (
+                    tuple(st["topo_plan"])
+                    if st["topo_plan"] is not None
+                    else None
+                )
                 with span("shuffle.round.collective"):
-                    head, pts = get_kernel(
+                    coll_out = get_kernel(
                         ctx,
                         ("shuffle_coll", st["has_lanes_eff"],
-                         len(st["pt_eff"])),
+                         len(st["pt_eff"]), tp_key),
                         st["build_coll"],
                     )((head, pts), ())
                 with span("shuffle.round.compact"):
                     out, nout = get_kernel(
                         ctx,
                         ("shuffle_compact", st["plan_sig"],
-                         st["has_lanes"], st["wire"]),
+                         st["has_lanes"], st["wire"], tp_key),
                         st["build_compact"],
                     )(
-                        (head, pts),
+                        coll_out,
                         (st["bases"],) if st["wire"] is not None else (),
                     )
                 if st["tier"] != _spill.TIER_HBM:
@@ -4040,7 +4342,7 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                         .astype(np.int64)
                     )
                     rt = st["t"]._rebuild_cols(
-                        st["src_pairs"], out, expect_r, st["world"] * bc
+                        st["src_pairs"], out, expect_r, st["recv_cap"]
                     )
                     st["spill_fresh"] = (rt, expect_r)
                     st["rounds_out"].append((None, nout))
@@ -4070,6 +4372,11 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
             bc = st["bucket_cap"]
             spilled = st["tier"] != _spill.TIER_HBM
             nouts = [nout for _out, nout in st["rounds_out"]]
+            ring_out = st.get("ring_out")
+            if ring_out is not None:
+                # the ring's absorbed-row count rides the SAME stacked
+                # fetch as the round counts — the ring adds no host sync
+                nouts.append(ring_out[1])
             got_all = _fetch(
                 nouts[0] if len(nouts) == 1 else jnp.stack(nouts)
             ).reshape(len(nouts), -1).astype(np.int64)
@@ -4091,7 +4398,7 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                     )
                 if not spilled:
                     round_tables.append(
-                        t._rebuild_cols(src_pairs, out, got, st["world"] * bc)
+                        t._rebuild_cols(src_pairs, out, got, st["recv_cap"])
                     )
             if spilled and st["pending_spill"] is not None:
                 # flush the one-deep staging window
@@ -4104,10 +4411,34 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
             # the arenas; in-HBM shuffles restage them as one extra table
             # in the round concat.
             relay_tbl = None
-            if st["sched"].adaptive:
+            ring_tbl = None
+            if ring_out is not None:
+                # ring rows are device-resident and their per-destination
+                # counts are host-known from the planner's intra matrix —
+                # validate against the fetched absorb count, then restage
+                # as one extra table in the round concat
+                intra_m, cap_ri = st["ring"]
+                expect_ring = intra_m.sum(axis=0).astype(np.int64)
+                got_ring = got_all[len(st["rounds_out"])]
+                if not (got_ring == expect_ring).all():
+                    raise RuntimeError(
+                        f"shuffle relay ring: absorbed row counts "
+                        f"{got_ring} != expected {expect_ring} — "
+                        "internal routing bug"
+                    )
+                ring_tbl = t._rebuild_cols(
+                    src_pairs, ring_out[0], expect_ring,
+                    st["topo_plan"].inner * cap_ri,
+                )
+            if st["sched"].adaptive and st.get("relay_out") is not None:
+                relay_m = (
+                    st["relay_inter"]
+                    if st["relay_mode"] == "inter"
+                    else st["sched"].relay
+                )
                 per_dst, rcounts = _spill.fetch_relay(
                     st["ctx"], list(st["plan_sig"]), st["pt_order"],
-                    *st["relay_out"], st["sched"].relay,
+                    *st["relay_out"], relay_m,
                     qspec=st["relay_qsig"],
                 )
                 if spilled:
@@ -4124,7 +4455,7 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
             else:
                 parts = round_tables + (
                     [relay_tbl] if relay_tbl is not None else []
-                )
+                ) + ([ring_tbl] if ring_tbl is not None else [])
                 res = parts[0] if len(parts) == 1 else _concat_tables(parts)
                 # compact when the uniform bucket sizing overshot; any
                 # input sortedness is gone — rows arrive source-major per
@@ -4159,7 +4490,8 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
         _prof.record_shuffle(
             [
                 (st["send_counts"], st["n_rounds"], st["bucket_cap"],
-                 st["sched"].relay)
+                 st["sched"].relay,
+                 tuple(st["topo_plan"]) if st["topo_plan"] else None)
                 for st in states
             ],
             states[0]["world"], t0, t_dev,

@@ -19,15 +19,33 @@ properties with real threads:
 Rows are deliberately small: this is a race hunt, not a throughput
 bench — tier-1 runs it unmarked.
 """
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 import numpy.testing as npt
+import pytest
 
 import cylon_tpu as ct
 from cylon_tpu import col
 from cylon_tpu.utils import tracing
+
+# XLA:CPU executes each virtual device's collective participant on a
+# host thread; with a single host core the backend's dispatch pool has
+# exactly device-count slots, so TWO programs in flight can strand one
+# program's last participant behind the other's parked rendezvous — a
+# guaranteed cross-run deadlock (observed: run A holds 7 threads at its
+# rendezvous while its rank 6's slot runs run B's rank 3, which waits
+# on A). That is a backend thread-pool limitation, not the property
+# under test — the lock discipline these hammers certify is already
+# statically checked by graft-lint L3, and the runtime hammer needs
+# real thread parallelism to hunt races anyway.
+pytestmark = pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="thread hammer deadlocks XLA:CPU's collective rendezvous "
+    "on a single-core host (dispatch-pool exhaustion across runs)",
+)
 
 
 def _mk_tables(ctx, rng, n=1500):

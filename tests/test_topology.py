@@ -1,0 +1,482 @@
+"""Topology-aware hierarchical shuffle pins (ISSUE 17).
+
+The 2-D ``(outer x inner)`` mesh factorization decomposes the fused
+exchange into a two-hop shuffle: an inner-axis grouped all_to_all that
+combines rows bound for the same remote outer group, then an outer-axis
+grouped all_to_all shipping the combined buffers. These tests pin:
+
+- host planning: mesh parsing, group tables, the exact cross-outer
+  capacity (``plan_two_hop``) and the per-axis byte ledger formulas;
+- exact differentials: every two-hop execution (uniform / Zipf /
+  one-hot keys, dict-strings + nulls, worlds 4 and 8, joins, groupby)
+  must match the ``CYLON_TPU_NO_TOPO`` flat oracle row-for-row;
+- the per-axis traced counters (``shuffle.coll_bytes.{intra,inter,
+  inter_alt}``) and the locality-clustered cross-outer reduction the
+  decomposition exists for;
+- gate discipline: flat 1-D contexts stay byte-identical and counter-
+  clean, the kill switch re-fingerprints, repeated dispatch does not
+  recompile, a tight outer budget re-plans without changing results;
+- the relay ladder: same-outer-group skew tails ride the device
+  ppermute ring (``shuffle.relay.ring_rows``), and the result still
+  matches the flat oracle exactly;
+- the ``hop_mode`` autopilot proposal math.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.engine import round_cap
+from cylon_tpu.parallel import topo as _topo
+from cylon_tpu.utils.tracing import report, reset_trace
+
+
+def _ctx(devices, world, mesh=None):
+    return ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world], mesh_shape=mesh)
+    )
+
+
+@pytest.fixture(scope="module")
+def tctx(devices):
+    """The canonical 4x2 topology context (8 devices)."""
+    return _ctx(devices, 8, "4x2")
+
+
+def _sorted_frame(t, cols):
+    return (
+        t.to_pandas()
+        .sort_values(cols)
+        .reset_index(drop=True)
+    )
+
+
+def _assert_tables_equal(got, want, cols):
+    gp, wp = _sorted_frame(got, cols), _sorted_frame(want, cols)
+    assert len(gp) == len(wp)
+    for c in gp.columns:
+        g, w = gp[c].to_numpy(), wp[c].to_numpy()
+        if g.dtype.kind == "f":
+            assert np.allclose(g, w, equal_nan=True), c
+        else:
+            assert np.array_equal(g, w), c
+
+
+# ----------------------------------------------------------------------
+# host planning units
+# ----------------------------------------------------------------------
+def test_parse_mesh():
+    assert _topo.parse_mesh("", 8) is None
+    assert _topo.parse_mesh("4x2", 8) == _topo.Topology(4, 2)
+    assert _topo.parse_mesh(" 2X4 ", 8) == _topo.Topology(2, 4)
+    # degenerate factors parse (effective() collapses them to flat)
+    assert _topo.parse_mesh("8x1", 8) == _topo.Topology(8, 1)
+    with pytest.raises(ValueError, match="expected 'OxI'"):
+        _topo.parse_mesh("4", 8)
+    with pytest.raises(ValueError, match="non-integer"):
+        _topo.parse_mesh("ax2", 8)
+    with pytest.raises(ValueError, match="!= world size"):
+        _topo.parse_mesh("4x2", 16)
+
+
+def test_group_tables_and_ring_perm():
+    t = _topo.Topology(4, 2)
+    assert _topo.inner_groups(t) == ((0, 1), (2, 3), (4, 5), (6, 7))
+    assert _topo.outer_groups(t) == ((0, 2, 4, 6), (1, 3, 5, 7))
+    # every device forwards to its next group-mate, wrapping per group
+    assert _topo.ring_perm(t) == (
+        (0, 1), (1, 0), (2, 3), (3, 2), (4, 5), (5, 4), (6, 7), (7, 6),
+    )
+
+
+def test_plan_two_hop_exact_capacity(rng):
+    t = _topo.Topology(4, 2)
+    world, cap = 8, 64
+    counts = rng.integers(0, 2 * cap, (world, world)).astype(np.int64)
+    k = int(-(-counts.max() // cap))
+    plan = _topo.plan_two_hop(counts, t, cap, k, 1)
+    agg = _topo.hop2_window_counts(counts, t, cap, k)
+    # exact: the pow2 round-up of the true max, never an overflow
+    assert plan.cap_o == round_cap(int(agg.max()))
+    assert agg.max() <= plan.cap_o <= t.inner * round_cap(cap)
+    # same-outer-group aggregates are zeroed (final after hop 1)
+    w4 = agg.reshape(k, 4, 2, 4)
+    for g in range(4):
+        assert w4[:, g, :, g].sum() == 0
+
+
+def test_axis_coll_bytes_formulas():
+    t = _topo.Topology(4, 2)
+    world, cap, k, rb, h = 8, 64, 2, 12, 1
+    rows = cap + h
+    # no topology: everything is "inter" by convention
+    assert _topo.axis_coll_bytes(None, world, cap, k, rb, h) == (
+        0, k * world * (world - 1) * rows * rb,
+    )
+    # flat-on-2D (1-hop forced): per-axis split of the flat exchange
+    intra, inter = _topo.axis_coll_bytes(t, world, cap, k, rb, h)
+    assert intra == k * world * (t.inner - 1) * rows * rb
+    assert inter == k * world * (world - t.inner) * rows * rb
+    # two-hop: the outer hop ships (outer-1) COMBINED chunks of cap_o
+    cap_o = 128
+    intra2, inter2 = _topo.axis_coll_bytes(
+        t, world, cap, k, rb, h, cap_o=cap_o
+    )
+    assert intra2 == k * world * (t.inner - 1) * t.outer * rows * rb
+    assert inter2 == k * world * (t.outer - 1) * (cap_o + h) * rb
+    # the decomposition's point: fewer, larger cross-outer messages —
+    # at equal payload the padded-chunk overhead drops from
+    # (P - inner) chunks to (outer - 1)
+    assert inter2 < inter
+
+
+def test_split_relay_and_ring_sizing():
+    t = _topo.Topology(2, 2)
+    m = np.zeros((4, 4), np.int64)
+    m[0, 1] = 30   # same outer group (devices 0,1)
+    m[0, 2] = 50   # cross-group
+    m[3, 2] = 7    # same group (devices 2,3)
+    intra, inter = _topo.split_relay(m, t)
+    assert intra[0, 1] == 30 and intra[3, 2] == 7 and intra.sum() == 37
+    assert inter[0, 2] == 50 and inter.sum() == 50
+    assert _topo.ring_cap(intra) == round_cap(30)
+    # empty sides collapse to None
+    assert _topo.split_relay(np.zeros((4, 4), np.int64), t) == (None, None)
+    only_inter = np.zeros((4, 4), np.int64)
+    only_inter[0, 2] = 5
+    a, b = _topo.split_relay(only_inter, t)
+    assert a is None and b is not None
+
+
+def test_effective_collapses_degenerate(devices):
+    flat = _ctx(devices, 8)
+    assert _topo.effective(flat) is None
+    deg = _ctx(devices, 8, "8x1")
+    assert _topo.effective(deg) is None
+    two = _ctx(devices, 8, "2x4")
+    assert _topo.effective(two) == _topo.Topology(2, 4)
+    with _topo.disabled():
+        assert _topo.effective(two) is None
+
+
+# ----------------------------------------------------------------------
+# exact differentials vs the flat oracle
+# ----------------------------------------------------------------------
+def _key_values(rng, dist, n):
+    if dist == "uniform":
+        return rng.integers(0, 500, n).astype(np.int32)
+    if dist == "zipf":
+        return np.minimum(rng.zipf(1.3, n), 499).astype(np.int32)
+    return np.zeros(n, np.int32)  # one-hot
+
+
+@pytest.mark.parametrize("mesh,world", [("4x2", 8), ("2x4", 8), ("2x2", 4)])
+@pytest.mark.parametrize("dist", ["uniform", "zipf", "onehot"])
+def test_join_matches_flat_oracle(devices, mesh, world, dist):
+    rng = np.random.default_rng(11)
+    ctx = _ctx(devices, world, mesh)
+    n = 1500
+    lt = ct.Table.from_pydict(
+        ctx,
+        {"k": _key_values(rng, dist, n),
+         "v": rng.normal(size=n).astype(np.float32)},
+    )
+    rt = ct.Table.from_pydict(
+        ctx,
+        {"k": _key_values(rng, dist, n // 2),
+         "w": rng.normal(size=n // 2).astype(np.float32)},
+    )
+    got = lt.distributed_join(rt, on="k", how="inner")
+    with _topo.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    _assert_tables_equal(got, want, ["k_x", "v", "w"])
+
+
+def test_strings_nulls_groupby_sort_match_oracle(devices):
+    """Dict-encoded string keys with nulls through shuffle, groupby and
+    distributed_sort on a 2x4 mesh — all exact vs the flat oracle."""
+    rng = np.random.default_rng(5)
+    ctx = _ctx(devices, 8, "2x4")
+    n = 2000
+    words = np.array(
+        ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", None] * 40,
+        dtype=object,
+    )
+    df = pd.DataFrame(
+        {
+            "s": words[rng.integers(0, len(words), n)],
+            "k": rng.integers(0, 60, n).astype(np.int32),
+            "v": np.where(
+                rng.random(n) < 0.1, np.nan, rng.normal(size=n)
+            ).astype(np.float32),
+        }
+    )
+    t = ct.Table.from_pandas(ctx, df)
+    got_s = t.shuffle(["s"])
+    got_g = t.distributed_groupby("k", {"v": "sum"})
+    got_o = t.distributed_sort(["k"])
+    with _topo.disabled():
+        want_s = t.shuffle(["s"])
+        want_g = t.distributed_groupby("k", {"v": "sum"})
+        want_o = t.distributed_sort(["k"])
+    assert got_s.row_count == want_s.row_count == n
+    assert (got_s.row_counts == want_s.row_counts).all()
+    _assert_tables_equal(got_g, want_g, ["k"])
+    # distributed_sort: identical global order
+    gp = got_o.to_pandas()["k"].to_numpy()
+    wp = want_o.to_pandas()["k"].to_numpy()
+    assert np.array_equal(gp, wp)
+
+
+# ----------------------------------------------------------------------
+# per-axis byte ledger + the locality win
+# ----------------------------------------------------------------------
+def test_per_axis_counters_and_killswitch_clean(devices, rng):
+    ctx = _ctx(devices, 8, "4x2")
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 300, 3000).astype(np.int32),
+         "v": rng.normal(size=3000).astype(np.float32)},
+    )
+    reset_trace()
+    t.shuffle(["k"])
+    r = report("shuffle.")
+    intra = int(r["shuffle.coll_bytes.intra"]["rows"])
+    inter = int(r["shuffle.coll_bytes.inter"]["rows"])
+    # both axes moved bytes, and the total IS the exchanged ledger
+    assert intra > 0 and inter > 0
+    assert intra + inter == int(r["shuffle.exchanged_bytes"]["rows"])
+    # the other mode's cross-outer bytes ride beside them (the one-run
+    # differential tools/topo_smoke.py gates on)
+    assert int(r["shuffle.coll_bytes.inter_alt"]["rows"]) > 0
+    # kill switch: counter-clean — the per-axis ledger never moves, the
+    # byte-identical-to-1-D acceptance check
+    reset_trace()
+    with _topo.disabled():
+        t.shuffle(["k"])
+    rb = report("shuffle.")
+    assert "shuffle.coll_bytes.intra" not in rb
+    assert "shuffle.coll_bytes.inter" not in rb
+    assert "shuffle.coll_bytes.inter_alt" not in rb
+
+
+def test_flat_1d_context_counter_clean(devices, rng):
+    """A context with NO topology keeps today's exchange: same rounds,
+    same exchanged bytes, no per-axis counters — with the topo module
+    enabled and with it killed."""
+    ctx = _ctx(devices, 8)
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 300, 3000).astype(np.int32),
+         "v": rng.normal(size=3000).astype(np.float32)},
+    )
+    reset_trace()
+    t.shuffle(["k"])
+    r_on = report("shuffle.")
+    reset_trace()
+    with _topo.disabled():
+        t.shuffle(["k"])
+    r_off = report("shuffle.")
+    assert "shuffle.coll_bytes.intra" not in r_on
+    assert "shuffle.coll_bytes.inter" not in r_on
+    for key in ("shuffle.rounds", "shuffle.exchanged_bytes"):
+        assert r_on[key]["rows"] == r_off[key]["rows"]
+
+
+def _locality_shards(rng, world, inner, n_shard, own_frac=0.8):
+    """Per-shard key arrays where ``own_frac`` of each shard's keys hash
+    to its OWN outer group — the workload shape (grouped ingest, range-
+    loaded partitions) whose cross-outer traffic the two-hop exchange
+    collapses. Pools come from the engine's own partitioner so the test
+    can never drift from the routing hash."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops.partition import hash_partition_ids
+
+    cand = np.arange(20000, dtype=np.int32)
+    pid = np.asarray(
+        hash_partition_ids(
+            [(jnp.asarray(cand), None)], jnp.int32(len(cand)), world
+        )
+    )
+    outer = world // inner
+    pools = [cand[(pid // inner) == g] for g in range(outer)]
+    shards = []
+    for p in range(world):
+        own = rng.choice(pools[p // inner], size=int(n_shard * own_frac))
+        other = rng.choice(cand, size=n_shard - len(own))
+        shards.append(np.concatenate([own, other]).astype(np.int32))
+    return shards
+
+
+def test_locality_cross_outer_reduction(devices):
+    """The headline saving: on locality-clustered keys (80% own-group)
+    the two-hop cross-outer bytes land >= 25% under the flat oracle's —
+    read from ONE run via the inter/inter_alt counter pair — at an
+    exactly equal result."""
+    rng = np.random.default_rng(23)
+    ctx = _ctx(devices, 8, "4x2")
+    keys = _locality_shards(rng, 8, 2, 2048)
+    shards = [
+        {"k": ks, "v": rng.normal(size=len(ks)).astype(np.float32)}
+        for ks in keys
+    ]
+    t = ct.Table.from_shards(ctx, shards)
+    reset_trace()
+    got = t.shuffle(["k"])
+    r = report("shuffle.")
+    inter = int(r["shuffle.coll_bytes.inter"]["rows"])
+    inter_flat = int(r["shuffle.coll_bytes.inter_alt"]["rows"])
+    assert inter <= 0.75 * inter_flat, (inter, inter_flat)
+    with _topo.disabled():
+        want = t.shuffle(["k"])
+    assert got.row_count == want.row_count
+    assert (got.row_counts == want.row_counts).all()
+    _assert_tables_equal(got, want, ["k", "v"])
+
+
+# ----------------------------------------------------------------------
+# gate discipline
+# ----------------------------------------------------------------------
+def test_gate_state_in_fingerprint(devices, rng):
+    from cylon_tpu.plan.lazy import gated_fingerprint
+
+    ctx = _ctx(devices, 8, "4x2")
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 50, 200).astype(np.int32),
+         "v": rng.normal(size=200).astype(np.float32)},
+    )
+    lf = t.lazy().filter(ct.col("v") > 0.0)
+    fp_on = gated_fingerprint(lf.plan)
+    with _topo.disabled():
+        fp_off = gated_fingerprint(lf.plan)
+    assert fp_on != fp_off
+    # the component is topo.gate_state(): (kill switch, raw mesh request)
+    assert _topo.gate_state() == (True, os.environ.get("CYLON_TPU_MESH", ""))
+    prev = os.environ.get("CYLON_TPU_MESH")
+    os.environ["CYLON_TPU_MESH"] = "4x2"
+    try:
+        assert _topo.gate_state() == (True, "4x2")
+        fp_env = gated_fingerprint(lf.plan)
+        assert fp_env != fp_off
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_MESH", None)
+        else:
+            os.environ["CYLON_TPU_MESH"] = prev
+
+
+def test_repeat_dispatch_no_recompile(tctx, rng):
+    """Same shape + same plan: the second two-hop shuffle reuses every
+    cached kernel (the TwoHopPlan tuple in the dispatch key is stable)."""
+    t = ct.Table.from_pydict(
+        tctx,
+        {"k": rng.integers(0, 300, 3000).astype(np.int32),
+         "v": rng.normal(size=3000).astype(np.float32)},
+    )
+    t.shuffle(["k"])
+    before = len(tctx.__dict__.get("_jit_cache", {}))
+    t.shuffle(["k"])
+    assert len(tctx.__dict__.get("_jit_cache", {})) == before
+
+
+def test_outer_budget_replans_exact(devices):
+    """A tight cross-outer byte budget forces more, smaller rounds (the
+    halving clamp) — the result stays exact vs the unclamped run."""
+    rng = np.random.default_rng(31)
+    ctx = _ctx(devices, 8, "4x2")
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": rng.integers(0, 300, 4000).astype(np.int32),
+         "v": rng.normal(size=4000).astype(np.float32)},
+    )
+    reset_trace()
+    base = t.shuffle(["k"])
+    k0 = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    prev = os.environ.get("CYLON_TPU_OUTER_BUDGET")
+    os.environ["CYLON_TPU_OUTER_BUDGET"] = "2048"
+    try:
+        reset_trace()
+        got = t.shuffle(["k"])
+        k1 = int(report("shuffle.")["shuffle.rounds"]["rows"])
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_OUTER_BUDGET", None)
+        else:
+            os.environ["CYLON_TPU_OUTER_BUDGET"] = prev
+    assert k1 > k0
+    assert (got.row_counts == base.row_counts).all()
+    _assert_tables_equal(got, base, ["k", "v"])
+
+
+# ----------------------------------------------------------------------
+# the relay ladder: device-direct ring for same-group tails
+# ----------------------------------------------------------------------
+def test_ring_relay_engages_and_matches_oracle(devices):
+    """One-hot skew on a 4x2 mesh: the same-outer-group tail rides the
+    inner-axis ppermute ring (device-direct, no host crossing) and the
+    shuffle still matches the flat oracle exactly."""
+    ctx = _ctx(devices, 8, "4x2")
+    n = 2048
+    t = ct.Table.from_pydict(
+        ctx,
+        {"k": np.zeros(n, np.int32),
+         "v": np.arange(n, dtype=np.float32)},
+    )
+    reset_trace()
+    s = t.shuffle(["k"])
+    r = report("shuffle.")
+    assert int(r["shuffle.relay.ring_rows"]["rows"]) > 0
+    with _topo.disabled():
+        base = t.shuffle(["k"])
+    assert s.row_count == base.row_count == n
+    assert (s.row_counts == base.row_counts).all()
+    assert np.array_equal(
+        np.sort(s.to_pandas()["v"].to_numpy()),
+        np.sort(base.to_pandas()["v"].to_numpy()),
+    )
+
+
+# ----------------------------------------------------------------------
+# the hop_mode autopilot proposal
+# ----------------------------------------------------------------------
+def test_hop_mode_proposal_math():
+    from cylon_tpu.plan import feedback as fb
+
+    # two-hop saving real (i2 well under i1): keep the default (None)
+    p = {"hop_n": 4, "hop_i2_sum": 400, "hop_i1_sum": 4000}
+    assert fb._hop_mode_proposal(p, 0.1) == (None, True)
+    # two-hop NOT paying (i2 >= i1 within margin): force 1-hop
+    p = {"hop_n": 4, "hop_i2_sum": 4000, "hop_i1_sum": 4000}
+    assert fb._hop_mode_proposal(p, 0.1) == ("1hop", True)
+    # degenerate observation: no decision
+    assert fb._hop_mode_proposal({"hop_n": 0}, 0.1) == (None, True)
+
+
+def test_decisions_tuple_back_compat():
+    """Persisted 6-tuples (pre-topology stores) rehydrate with
+    hop_mode=None — the trailing-field discipline."""
+    from cylon_tpu.plan import feedback as fb
+
+    old = (None, None, None, None, None, None)
+    d = fb.Decisions(*old)
+    assert d.hop_mode is None
+    assert fb.Decisions(*(old + ("1hop",))).hop_mode == "1hop"
+
+
+def test_prof_per_axis_stage_clocks():
+    """The critical-path profiler splits the collective clock per axis
+    under a two-hop plan and keeps the flat track without one."""
+    from cylon_tpu.obs import prof
+
+    counts = np.full((8, 8), 10, np.int64)
+    flat = prof.shuffle_units([(counts, 1, 16, None, None)], 8)
+    assert flat["collective"].sum() > 0
+    # zero tracks are dropped from the ledger entirely
+    assert "coll_inner" not in flat and "coll_outer" not in flat
+    two = prof.shuffle_units([(counts, 1, 16, None, (4, 2, 32, 1))], 8)
+    assert "collective" not in two
+    assert two["coll_inner"].sum() > 0 and two["coll_outer"].sum() > 0

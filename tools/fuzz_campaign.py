@@ -39,6 +39,17 @@ def ctx_for(world):
     return CTXS[world]
 
 
+def topo_ctx_for(world, mesh):
+    # tuple-keyed beside the flat contexts so the cache-clearing loop in
+    # main() covers these meshes too
+    key = (world, mesh)
+    if key not in CTXS:
+        CTXS[key] = ct.CylonContext.init_distributed(
+            ct.TPUConfig(devices=DEVICES[:world], mesh_shape=mesh)
+        )
+    return CTXS[key]
+
+
 def rand_frame(rng, n, keyspace, dtype, null_p, vname="v"):
     if dtype == "int32":
         k = rng.integers(-keyspace, keyspace, n).astype(np.int32).astype(object)
@@ -1463,6 +1474,88 @@ def stream_round_once(seed) -> bool:
             os.environ["CYLON_TPU_STREAM_CHUNK_ROWS"] = prev_chunk
 
 
+def topo_round_once(seed) -> bool:
+    """Two-hop topology oracle round (ISSUE 17): randomize the 2-D mesh
+    factorization (2x2 / 4x2 / 2x4), dtype mix, null density, skew shape
+    and round count, then differential-check the two-hop shuffle AND a
+    distributed join against the CYLON_TPU_NO_TOPO flat oracle. The
+    decomposition is a wire-level rewrite — exact row equality always,
+    including the ppermute ring relay the skewed draws engage."""
+    from cylon_tpu.parallel import shuffle as _sh
+    from cylon_tpu.parallel import topo as _topo
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    rng = np.random.default_rng(seed)
+    world, mesh = [(4, "2x2"), (8, "4x2"), (8, "2x4")][
+        int(rng.integers(0, 3))
+    ]
+    n = int(rng.integers(64, max(MAX_N, 65)))
+    keyspace = int(rng.integers(2, 128))
+    dtype = str(rng.choice(["int32", "int64", "float32", "string"]))
+    null_p = float(rng.choice([0.0, 0.2]))
+    skew = str(rng.choice(["uniform", "one_hot", "hot_key", "empty_shards"]))
+    k_target = int(rng.choice([1, 2, 4]))
+    params = dict(seed=seed, profile="topo", world=world, mesh=mesh, n=n,
+                  keyspace=keyspace, dtype=dtype, null_p=null_p, skew=skew,
+                  k_target=k_target)
+    ctx = topo_ctx_for(world, mesh)
+
+    df = rand_frame(rng, n, keyspace, dtype, null_p)
+    karr = df["k"].to_numpy(copy=True)
+    non_null = [v for v in karr if v is not None]
+    hot = non_null[0] if non_null else None
+    if skew == "one_hot" and hot is not None:
+        karr[:] = hot
+        df["k"] = karr
+    elif skew == "hot_key" and hot is not None:
+        karr[rng.random(n) < 0.6] = hot
+        df["k"] = karr
+    if skew == "empty_shards":
+        shards = [{c: df[c].to_numpy() for c in df.columns}] + [
+            {c: df[c].to_numpy()[:0] for c in df.columns}
+            for _ in range(world - 1)
+        ]
+        t = ct.Table.from_shards(ctx, shards)
+    else:
+        t = ct.Table.from_pandas(ctx, df)
+
+    max_bucket = max(int(t.row_counts.max()), 1)
+    budget = _sh.budget_for_rounds(
+        max_bucket, k_target, world, _sh.exchange_row_bytes(t._flat_cols())
+    )
+    reset_trace()
+    got = t.shuffle(["k"], byte_budget=budget)
+    r = report("shuffle.")
+    params["rounds"] = int(r["shuffle.rounds"]["rows"])
+    params["ring_rows"] = int(
+        r.get("shuffle.relay.ring_rows", {}).get("rows", 0)
+    )
+    with _topo.disabled():
+        want = t.shuffle(["k"], byte_budget=budget)
+    ok = True
+    if not (got.row_counts == want.row_counts).all():
+        print(f"MISMATCH topo_routing params={params} "
+              f"got={got.row_counts} want={want.row_counts}", flush=True)
+        ok = False
+    ok &= check(got.to_pandas(), want.to_pandas(), "topo_shuffle", params)
+
+    # distributed join on a fresh pair, two-hop vs flat oracle
+    rdf = rand_frame(rng, max(n // 2, 1), keyspace, dtype, null_p, "w")
+    jdf = df[["k", "v"]].copy()
+    if null_p > 0:
+        for fr in (jdf, rdf):
+            ka = fr["k"].to_numpy(copy=True)
+            ka[0] = None
+            fr["k"] = ka
+    lt2 = ct.Table.from_pandas(ctx, jdf)
+    rt = ct.Table.from_pandas(ctx, rdf)
+    gotj = lt2.distributed_join(rt, on="k", how="inner").to_pandas()
+    with _topo.disabled():
+        wantj = lt2.distributed_join(rt, on="k", how="inner").to_pandas()
+    ok &= check(gotj, wantj, "topo_join", params)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
@@ -1474,7 +1567,7 @@ def main():
                     choices=["default", "skew", "plan", "shuffle",
                              "ordering", "semi", "packing", "serve",
                              "spill", "autotune", "quant", "chaos",
-                             "stream"],
+                             "stream", "topo"],
                     default="default",
                     help="'skew': adversarial hot-key rounds (one key ~50%% "
                          "of rows, world {4,8}, undersized fused capacities); "
@@ -1510,7 +1603,12 @@ def main():
                          "(random appendable topology / append sizes / "
                          "dtype mix / staging chunk / world, ISSUE 16) — "
                          "every incremental refresh vs the "
-                         "CYLON_TPU_NO_IVM=1 full-recompute oracle")
+                         "CYLON_TPU_NO_IVM=1 full-recompute oracle; "
+                         "'topo': two-hop hierarchical-shuffle rounds "
+                         "(random 2x2/4x2/2x4 mesh factorization, dtype "
+                         "mix, nulls, skew, K, ISSUE 17) — shuffle + "
+                         "distributed join vs the CYLON_TPU_NO_TOPO "
+                         "flat-exchange oracle, exact row equality")
     args = ap.parse_args()
     global MAX_N
     MAX_N = args.max_n
@@ -1524,7 +1622,8 @@ def main():
           "autotune": autotune_round_once,
           "quant": quant_round_once,
           "chaos": chaos_round_once,
-          "stream": stream_round_once}.get(args.profile, round_once)
+          "stream": stream_round_once,
+          "topo": topo_round_once}.get(args.profile, round_once)
     t_end = time.time() + args.minutes * 60
     seed = args.seed0
     failures = 0

@@ -16,7 +16,17 @@ Usage:
   python tools/precompile.py                 # caps 1M..16M, world=1
   python tools/precompile.py --lo 20 --hi 24 --ops join,sort
   python tools/precompile.py --cpu           # warm the CPU-backend cache
+  python tools/precompile.py --cpu --topo 4x2 --lo 12 --hi 16
+                                             # warm the two-hop shuffle
+                                             # kernels on an OxI mesh
 One JSON line per (op, cap): compile wall + cache status.
+
+``--topo OxI`` declares a 2-D mesh of O*I devices (CYLON_TPU_MESH
+equivalent), so the warmed set additionally covers the hierarchical
+shuffle: hop-1 pack + inner all_to_all, the count-informed cross-outer
+repack, hop-2 outer all_to_all, and the structured fused-join exchange
+— each per capacity bucket, exactly the kernels a topology-declared
+production context will request first.
 """
 from __future__ import annotations
 
@@ -41,36 +51,59 @@ def main():
     ap.add_argument("--hi", type=int, default=24, help="max cap = 2^hi")
     ap.add_argument("--ops", type=str, default=",".join(ALL_OPS))
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--topo", type=str, default="",
+                    help="OxI 2-D mesh (e.g. 4x2): warm the two-hop "
+                         "shuffle kernels on a world of O*I devices")
     args = ap.parse_args()
+
+    world = 1
+    if args.topo:
+        o, i = (int(x) for x in args.topo.lower().split("x"))
+        world = o * i
 
     if args.cpu:
         import __graft_entry__ as ge
 
-        ge._force_cpu_mesh(1)
+        ge._force_cpu_mesh(world)
 
     import jax
 
     import cylon_tpu as ct
 
     platform = jax.devices()[0].platform
+    if len(jax.devices()) < world:
+        raise SystemExit(
+            f"--topo {args.topo} needs {world} devices, have "
+            f"{len(jax.devices())} (add --cpu for a virtual mesh)"
+        )
     ops = [o.strip() for o in args.ops.split(",") if o.strip()]
-    ctx = ct.CylonContext.init_distributed(
-        ct.TPUConfig(devices=jax.devices()[:1])
-    )
+    cfg = ct.TPUConfig(devices=jax.devices()[:world])
+    if args.topo:
+        cfg = ct.TPUConfig(devices=jax.devices()[:world],
+                           mesh_shape=args.topo)
+    ctx = ct.CylonContext.init_distributed(cfg)
     rng = np.random.default_rng(0)
+
+    def make(n, vname):
+        df = {
+            "k": rng.integers(0, max(n, 2), n).astype(np.int32),
+            vname: rng.normal(size=n).astype(np.float32),
+        }
+        if world == 1:
+            return ct.Table.from_pydict(ctx, df)
+        per = max(n // world, 1)
+        return ct.Table.from_shards(ctx, [
+            {"k": df["k"][s * per:(s + 1) * per],
+             vname: df[vname][s * per:(s + 1) * per]}
+            for s in range(world)
+        ])
 
     for p in range(args.lo, args.hi + 1):
         cap = 1 << p
         # n just under the cap keeps the pow2 rounding AT this bucket
         n = cap - 1
-        left = ct.Table.from_pydict(ctx, {
-            "k": rng.integers(0, n, n).astype(np.int32),
-            "v": rng.normal(size=n).astype(np.float32),
-        })
-        right = ct.Table.from_pydict(ctx, {
-            "k": rng.integers(0, n, n).astype(np.int32),
-            "w": rng.normal(size=n).astype(np.float32),
-        })
+        left = make(n, "v")
+        right = make(n, "w")
 
         def timed(name, fn):
             t0 = time.perf_counter()

@@ -1,0 +1,300 @@
+"""topo-smoke: the CI topology-aware-shuffle gate (ISSUE 17).
+
+Runs on the 4x2 virtual-CPU mesh (8 devices), in one process:
+
+1. JOIN COLL-MB — a locality-clustered eager ``distributed_join`` (80%%
+   of each shard's keys hash to its OWN outer group — the grouped-ingest
+   / range-partitioned workload the two-hop decomposition exists for)
+   must ship >= 25%% fewer cross-outer collective bytes than the flat
+   oracle. Both modes' exact cross-outer bytes ride ONE run: the engine
+   traces ``shuffle.coll_bytes.inter`` (the mode that ran) beside
+   ``shuffle.coll_bytes.inter_alt`` (the other mode, computed from the
+   same count matrix), so the gate needs no second execution.
+2. Q3 COLL-MB  — the q3 shape (join -> groupby-SUM) over the same
+   locality pair, same >= 25%% cross-outer gate over the query's
+   summed shuffles.
+3. EXACTNESS   — both workloads re-run under ``CYLON_TPU_NO_TOPO=1``:
+   results must be row-for-row identical (the decomposition is a wire
+   rewrite, never a semantic one). The fused-pipeline join
+   (``mode='fused'``) is also checked exact: its structured two-hop
+   trades message COUNT (outer-1 large transfers vs P-inner small
+   ones), not bytes, so it gates on identity only.
+4. FLAT IDENTITY — a context with NO declared topology plans the same
+   rounds and ships the same ``shuffle.exchanged_bytes`` with the topo
+   module enabled and killed, and never moves a per-axis counter: 1-D
+   meshes are byte-identical to the pre-topology engine.
+5. MULTICHIP   — ``--widths 16[,32,64]``: each width runs the locality
+   shuffle on an 8x2 / 8x4 / 8x8 mesh in a FRESH subprocess (the
+   virtual device count must precede backend init), pins the per-axis
+   ledger (intra + inter == exchanged, inter <= 0.75 * inter_alt,
+   oracle-exact) and appends the sweep rows to MULTICHIP_topo.json.
+
+Usage: python tools/topo_smoke.py [--rows 40000] [--widths 16]
+Exit status: 0 ok, 1 gate failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("CYLON_TPU_NO_X64", "1")
+
+import __graft_entry__ as ge
+
+MIN_INTER_SAVING = 0.25
+
+
+def _fail(msg: str) -> None:
+    print(f"TOPO SMOKE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def locality_shards(rng, world, inner, n_shard, own_frac=0.8):
+    """Per-shard int32 key arrays with ``own_frac`` hashing to the
+    shard's OWN outer group, pooled via the engine's partitioner so the
+    workload can never drift from the routing hash."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cylon_tpu.ops.partition import hash_partition_ids
+
+    cand = np.arange(50000, dtype=np.int32)
+    pid = np.asarray(
+        hash_partition_ids(
+            [(jnp.asarray(cand), None)], jnp.int32(len(cand)), world
+        )
+    )
+    pools = [cand[(pid // inner) == g] for g in range(world // inner)]
+    out = []
+    for p in range(world):
+        own = rng.choice(pools[p // inner], size=int(n_shard * own_frac))
+        other = rng.choice(cand, size=n_shard - len(own))
+        out.append(np.concatenate([own, other]).astype(np.int32))
+    return out
+
+
+def _sorted(df, cols):
+    return df.sort_values(cols).reset_index(drop=True)
+
+
+def multichip_child(world: int, mesh: str, rows: int) -> None:
+    """One sweep width: locality shuffle on an OxI mesh, per-axis ledger
+    pins + oracle exactness, one JSON row on stdout."""
+    devices = ge._force_cpu_mesh(world)
+
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.parallel import topo as _topo
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    o, i = (int(x) for x in mesh.split("x"))
+    assert o * i == world
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world], mesh_shape=mesh)
+    )
+    rng = np.random.default_rng(17)
+    keys = locality_shards(rng, world, i, max(rows // world, 256))
+    t = ct.Table.from_shards(
+        ctx,
+        [{"k": ks, "v": rng.normal(size=len(ks)).astype(np.float32)}
+         for ks in keys],
+    )
+    reset_trace()
+    got = t.shuffle(["k"])
+    r = report("shuffle.")
+    intra = int(r["shuffle.coll_bytes.intra"]["rows"])
+    inter = int(r["shuffle.coll_bytes.inter"]["rows"])
+    alt = int(r["shuffle.coll_bytes.inter_alt"]["rows"])
+    exchanged = int(r["shuffle.exchanged_bytes"]["rows"])
+    with _topo.disabled():
+        want = t.shuffle(["k"])
+    exact = bool(
+        (got.row_counts == want.row_counts).all()
+        and got.row_count == want.row_count
+    )
+    row = {
+        "world": world,
+        "mesh": mesh,
+        "rows": int(t.row_count),
+        "coll_mb_intra": round(intra / 1e6, 3),
+        "coll_mb_inter": round(inter / 1e6, 3),
+        "coll_mb_inter_flat": round(alt / 1e6, 3),
+        "inter_saving": round(1 - inter / max(alt, 1), 3),
+        "ledger_exact": intra + inter == exchanged,
+        "oracle_exact": exact,
+    }
+    print("TOPO_MULTICHIP_ROW " + json.dumps(row), flush=True)
+
+
+def run_width(world: int, rows: int, timeout_s: float):
+    mesh = {16: "8x2", 32: "8x4", 64: "8x8"}.get(world, f"{world // 2}x2")
+    code = (
+        "import tools.topo_smoke as ts; "
+        f"ts.multichip_child({world}, {mesh!r}, {rows})"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+    )
+    if r.returncode != 0:
+        _fail(f"multichip width {world} failed:\n{r.stderr[-1500:]}")
+    for line in r.stdout.splitlines():
+        if line.startswith("TOPO_MULTICHIP_ROW "):
+            return json.loads(line.split(" ", 1)[1])
+    _fail(f"multichip width {world}: no sweep row in output")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40_000)
+    ap.add_argument("--widths", type=str, default="",
+                    help="comma list of multichip sweep widths "
+                         "(16/32/64); empty = skip the sweep")
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "MULTICHIP_topo.json"))
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    devices = ge._force_cpu_mesh(8)
+
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.parallel import topo as _topo
+    from cylon_tpu.utils.tracing import report, reset_trace
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:8], mesh_shape="4x2")
+    )
+    rng = np.random.default_rng(29)
+    n_shard = max(args.rows // 8, 512)
+    lkeys = locality_shards(rng, 8, 2, n_shard)
+    rkeys = locality_shards(rng, 8, 2, n_shard // 2)
+    lt = ct.Table.from_shards(
+        ctx,
+        [{"k": ks, "v": rng.normal(size=len(ks)).astype(np.float32)}
+         for ks in lkeys],
+    )
+    rt = ct.Table.from_shards(
+        ctx,
+        [{"k": ks, "w": rng.normal(size=len(ks)).astype(np.float32)}
+         for ks in rkeys],
+    )
+
+    # 1. JOIN COLL-MB + 3. EXACTNESS (eager two-hop vs flat oracle)
+    reset_trace()
+    got = lt.distributed_join(rt, on="k", how="inner")
+    got.row_count  # force
+    r = report("shuffle.")
+    inter = int(r["shuffle.coll_bytes.inter"]["rows"])
+    alt = int(r["shuffle.coll_bytes.inter_alt"]["rows"])
+    saving = 1 - inter / max(alt, 1)
+    print(f"topo-smoke join: cross-outer {inter / 1e6:.2f} MB two-hop vs "
+          f"{alt / 1e6:.2f} MB flat ({saving:.1%} saved)")
+    if saving < MIN_INTER_SAVING:
+        _fail(f"join cross-outer saving {saving:.1%} < "
+              f"{MIN_INTER_SAVING:.0%}")
+    with _topo.disabled():
+        want = lt.distributed_join(rt, on="k", how="inner")
+    gp = _sorted(got.to_pandas(), ["k_x", "v", "w"])
+    wp = _sorted(want.to_pandas(), ["k_x", "v", "w"])
+    if len(gp) != len(wp) or not all(
+        np.allclose(gp[c], wp[c], equal_nan=True) for c in gp.columns
+    ):
+        _fail("join result differs from the flat oracle")
+    print("topo-smoke join: oracle-exact ok")
+
+    # fused-pipeline lane: structured two-hop gates on identity (it
+    # aggregates messages at equal inter bytes, by design)
+    gotf = lt.distributed_join(rt, on="k", how="inner", mode="fused")
+    fp = _sorted(gotf.to_pandas(), ["k_x", "v", "w"])
+    if len(fp) != len(wp) or not all(
+        np.allclose(fp[c], wp[c], equal_nan=True) for c in fp.columns
+    ):
+        _fail("fused join result differs from the flat oracle")
+    print("topo-smoke fused join: oracle-exact ok")
+
+    # 2. Q3 COLL-MB — join -> groupby-SUM over the same locality pair
+    reset_trace()
+    q3 = lt.distributed_join(rt, on="k", how="inner")
+    q3g = q3.distributed_groupby("k_x", {"v": "sum"})
+    q3g.row_count
+    r = report("shuffle.")
+    inter = int(r["shuffle.coll_bytes.inter"]["rows"])
+    alt = int(r["shuffle.coll_bytes.inter_alt"]["rows"])
+    saving = 1 - inter / max(alt, 1)
+    print(f"topo-smoke q3: cross-outer {inter / 1e6:.2f} MB two-hop vs "
+          f"{alt / 1e6:.2f} MB flat ({saving:.1%} saved)")
+    if saving < MIN_INTER_SAVING:
+        _fail(f"q3 cross-outer saving {saving:.1%} < {MIN_INTER_SAVING:.0%}")
+    with _topo.disabled():
+        w3 = lt.distributed_join(rt, on="k", how="inner")
+        w3g = w3.distributed_groupby("k_x", {"v": "sum"})
+    g3 = _sorted(q3g.to_pandas(), ["k_x"])
+    w3p = _sorted(w3g.to_pandas(), ["k_x"])
+    if len(g3) != len(w3p) or not np.array_equal(
+        g3["k_x"].to_numpy(), w3p["k_x"].to_numpy()
+    ) or not np.allclose(g3["v_sum"].to_numpy(), w3p["v_sum"].to_numpy()):
+        _fail("q3 result differs from the flat oracle")
+    print("topo-smoke q3: oracle-exact ok")
+
+    # 4. FLAT IDENTITY — no declared topology: byte-identical, counter-clean
+    flat_ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:8])
+    )
+    tf = ct.Table.from_pydict(
+        flat_ctx,
+        {"k": rng.integers(0, 997, 20000).astype(np.int32),
+         "v": rng.normal(size=20000).astype(np.float32)},
+    )
+    reset_trace()
+    tf.shuffle(["k"])
+    r_on = report("shuffle.")
+    reset_trace()
+    with _topo.disabled():
+        tf.shuffle(["k"])
+    r_off = report("shuffle.")
+    for key in ("shuffle.rounds", "shuffle.exchanged_bytes"):
+        if r_on[key]["rows"] != r_off[key]["rows"]:
+            _fail(f"flat 1-D context not byte-identical: {key} "
+                  f"{r_on[key]['rows']} vs {r_off[key]['rows']}")
+    if any(k.startswith("shuffle.coll_bytes.") for k in r_on):
+        _fail("flat 1-D context moved a per-axis counter")
+    print("topo-smoke flat: 1-D byte-identical + counter-clean ok")
+
+    # 5. MULTICHIP sweep
+    widths = [int(x) for x in args.widths.split(",") if x]
+    if widths:
+        rows_list = []
+        for w in widths:
+            row = run_width(w, args.rows, args.timeout)
+            print(f"topo-smoke multichip {row['mesh']}: "
+                  f"inter {row['coll_mb_inter']} MB vs flat "
+                  f"{row['coll_mb_inter_flat']} MB "
+                  f"({row['inter_saving']:.1%}), "
+                  f"ledger_exact={row['ledger_exact']}, "
+                  f"oracle_exact={row['oracle_exact']}")
+            if not (row["ledger_exact"] and row["oracle_exact"]):
+                _fail(f"multichip width {w}: ledger/oracle pin failed")
+            if row["inter_saving"] < MIN_INTER_SAVING:
+                _fail(f"multichip width {w}: saving "
+                      f"{row['inter_saving']:.1%} < "
+                      f"{MIN_INTER_SAVING:.0%}")
+            rows_list.append(row)
+        with open(args.out, "w") as f:
+            json.dump({"runs": rows_list}, f, indent=1)
+            f.write("\n")
+        print(f"topo-smoke: wrote {args.out}")
+
+    print("topo-smoke: ALL GATES OK")
+
+
+if __name__ == "__main__":
+    main()
