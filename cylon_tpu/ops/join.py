@@ -27,8 +27,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import radix as _radix
 from .factorize import factorize_two
 from .sort import KeyCol
+
+
+def _ids_hint(ids: jax.Array, cap_cat: int):
+    """Radix digit-span hint for a canonical join-id lane
+    (:func:`_canonical_ids` output): the uint32 fast path carries raw
+    orderable keys (MAXU padding — full 32-bit span, no hint), the
+    factorize path dense int32 ids bounded by ``cap_cat`` (its padding
+    sentinel), so only ``bit_length(cap_cat)`` digit bits ever vary."""
+    if ids.dtype == jnp.uint32:
+        return None
+    return _radix.bound_hint(cap_cat)
 
 
 def _inv_perm(p: jax.Array) -> jax.Array:
@@ -83,7 +95,7 @@ def _merged_counts(
 
     keys = jnp.concatenate([r_ids, l_ids])  # rights FIRST (tie order matters)
     pay = jnp.arange(cap_r + cap_l, dtype=jnp.int32)
-    skey, spay = jax.lax.sort((keys, pay), num_keys=1, is_stable=True)
+    skey, spay = _radix.kv_sort(keys, pay, _ids_hint(keys, cap_r + cap_l))
     is_r_live = spay < nr
     is_l = spay >= cap_r
     rl = is_r_live.astype(jnp.int32)
@@ -150,7 +162,7 @@ def _key_order_emit(
     cap_cat = cap_r + cap_l
     keys = jnp.concatenate([r_ids, l_ids])  # rights FIRST (tie order matters)
     pay = jnp.arange(cap_cat, dtype=jnp.int32)
-    skey, spay = jax.lax.sort((keys, pay), num_keys=1, is_stable=True)
+    skey, spay = _radix.kv_sort(keys, pay, _ids_hint(keys, cap_cat))
     is_l = spay >= cap_r
     is_l_live = is_l & (spay < cap_r + nl)
     is_r_live = (~is_l) & (spay < nr)
@@ -198,7 +210,13 @@ def impl_tag() -> tuple:
     with after a mid-process env flip. Join-family cache keys append this
     tag so an A/B flip recompiles instead of reusing the stale program.
     The analyzer (cylon_tpu/analysis) treats a call to this function inside
-    a key expression as the keyed carrier of all four knobs."""
+    a key expression as the keyed carrier of all four knobs.
+
+    The sort-engine component rides along (ops/radix.impl_tag): the
+    probe/emit kv-sorts and the right ride sort lower through ops/radix,
+    so the resolved sort impl (CYLON_TPU_SORT_IMPL / CYLON_TPU_NO_RADIX /
+    the tuned per-shape decision) is part of every join-family program's
+    identity too."""
     from ..utils import envgate as _eg
 
     return (
@@ -206,7 +224,7 @@ def impl_tag() -> tuple:
         _eg.SEGSUM_IMPL.get(),
         _eg.EMIT_IMPL.get(),
         _eg.EXPAND_GATHER.get(),
-    )
+    ) + _radix.impl_tag()
 
 
 def _repeat_ss(ends: jax.Array, cap_out: int) -> jax.Array:
@@ -340,7 +358,9 @@ def _probe(
     l_ids, r_ids = _canonical_ids(
         l_key_cols, r_key_cols, nl, nr, cap_l, cap_r, fuse=fuse
     )
-    r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+    r_order = _radix.argsort_perm(r_ids, _ids_hint(r_ids, cap_l + cap_r))
+    if r_order is None:
+        r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
     lo, cnt, r_cnt = _merged_counts(
         l_ids, r_ids, nl, nr, cap_l, cap_r, need_rcnt
     )
@@ -783,7 +803,13 @@ def spec_join(
             r_sorted = list(r_cols)
         else:
             ride, payloads, heavy = split_ride_cols(r_cols)
-            if heavy:
+            perm = _radix.argsort_perm(r_ids, _ids_hint(r_ids, cap_l + cap_r))
+            if perm is not None:
+                # radix: one gather per column by the final perm replaces
+                # riding every bitonic pass
+                spays = [p[perm] for p in payloads]
+                heavy_sorted = pack_gather(heavy, perm)[0] if heavy else []
+            elif heavy:
                 # carry the order only when something needs gathering by it
                 iota = jnp.arange(cap_r, dtype=jnp.int32)
                 sorted_ops = jax.lax.sort(
@@ -823,7 +849,11 @@ def spec_join(
         if r_presorted:
             r_order = jnp.arange(cap_r, dtype=jnp.int32)
         else:
-            r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
+            r_order = _radix.argsort_perm(
+                r_ids, _ids_hint(r_ids, cap_l + cap_r)
+            )
+            if r_order is None:
+                r_order = jnp.argsort(r_ids, stable=True).astype(jnp.int32)
         out_cols, n_out = emit_gather(
             lo, cnt, r_order, r_cnt, l_cols, r_cols, nl, nr, how, cap_out,
             emit_impl,

@@ -169,9 +169,21 @@ def lexsort_with_payload(
     return ops[:k], ops[k:]
 
 
-def lexsort_indices(lanes: Sequence[jax.Array], cap: int) -> jax.Array:
+def lexsort_indices(
+    lanes: Sequence[jax.Array], cap: int, hints=None
+) -> jax.Array:
     """Permutation that stably lexsorts ``lanes`` (least-significant first):
-    the chained-pass replacement for ``jnp.lexsort``."""
+    the chained-pass replacement for ``jnp.lexsort``.
+
+    Impl-selected (ops/radix.py): when the resolved sort impl is a radix
+    tier and every lane has an integer digit plan, the permutation comes
+    from LSD histogram passes — the stable lexsort permutation is unique,
+    so the result is bit-identical to the chained bitonic path."""
+    from . import radix as _radix
+
+    perm = _radix.lexsort_perm(lanes, cap, hints)
+    if perm is not None:
+        return perm
     iota = jnp.arange(cap, dtype=jnp.int32)
     _, pays = lexsort_with_payload(lanes, [iota], keep_lanes=False)
     return pays[0]
@@ -406,8 +418,15 @@ def sorted_runs(
     The single implementation of the reversed-lanes chained sort +
     run-detect idiom shared by factorize and the set algebra.
     """
+    from . import radix as _radix
+
+    lanes = list(lanes_msb_first)
+    perm = _radix.lexsort_perm(list(reversed(lanes)), pay.shape[0])
+    if perm is not None:
+        # one gather per lane by the final perm replaces riding every pass
+        return pay[perm], lane_runs_differ([l[perm] for l in lanes])
     sorted_lanes, pays = lexsort_with_payload(
-        list(reversed(list(lanes_msb_first))), [pay]
+        list(reversed(lanes)), [pay]
     )
     return pays[0], lane_runs_differ(list(reversed(sorted_lanes)))
 
@@ -516,6 +535,8 @@ def lexsort_rows_payload(
     — the stats measured those values too); only the don't-care padding
     permutation may differ.
     """
+    from . import radix as _radix
+
     if ascending is None:
         ascending = [True] * len(key_cols)
     if fuse is not None:
@@ -525,25 +546,41 @@ def lexsort_rows_payload(
             nulls_last=nulls_last, prefix_lane=prefix_lane,
         )
         lanes = list(reversed(words))  # least-significant first
+        # radix over fused words: the layout's live widths bound the digit
+        # spans (the least-significant word additionally skips its
+        # constant-zero bottom tie padding)
+        perm = _radix.lexsort_perm(
+            lanes, cap, _radix.fuse_word_hints(fuse)
+        )
+        if perm is not None:
+            return perm, [p[perm] for p in payloads]
         iota = jnp.arange(cap, dtype=jnp.int32)
         _, pays = lexsort_with_payload(
             lanes, list(payloads) + [iota], keep_lanes=False
         )
         return pays[-1], pays[:-1]
     lanes = []  # least-significant first (lexsort convention)
+    hints = []  # per-lane radix digit spans, same order
     pad = row_class(n, cap, None)
     for (data, valid), asc in zip(
         reversed(list(key_cols)), list(reversed(list(ascending)))
     ):
         lanes.append(_norm_key(data, asc))
+        hints.append(None)  # dtype-default span (floats decline radix)
         if valid is not None:
             null_lane = (~valid).astype(jnp.int8)
             if not nulls_last:
                 null_lane = -null_lane
             lanes.append(null_lane)
+            hints.append(_radix.bias_hint(1, 2))  # {-1,0,1} null classes
     if prefix_lane is not None:
         lanes.append(prefix_lane)
+        hints.append(_radix.bound_hint(cap + 1))  # run ids + padding id
     lanes.append(pad)  # most significant: padding always last
+    hints.append(_radix.bias_hint(1, 2))  # {-1,0,1,2} row classes
+    perm = _radix.lexsort_perm(lanes, cap, hints)
+    if perm is not None:
+        return perm, [p[perm] for p in payloads]
     iota = jnp.arange(cap, dtype=jnp.int32)
     _, pays = lexsort_with_payload(
         lanes, list(payloads) + [iota], keep_lanes=False

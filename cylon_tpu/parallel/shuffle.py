@@ -90,7 +90,17 @@ def exchange_counts(counts: jax.Array, axis_name: str) -> jax.Array:
 
 
 def shuffle_gather_order(pid: jax.Array, num_partitions: int) -> jax.Array:
-    """Stable order grouping rows by target partition (padding last)."""
+    """Stable order grouping rows by target partition (padding last).
+
+    pid is bounded by ``num_partitions`` (the padding/dropped sentinel),
+    so the radix tier (ops/radix.py) groups in ``ceil(log2(P+1)/r)``
+    histogram passes — 1–2 at any real world size — where the bitonic
+    argsort pays the full ~log^2(cap)/2 network."""
+    from ..ops import radix as _radix
+
+    order = _radix.argsort_perm(pid, _radix.bound_hint(num_partitions))
+    if order is not None:
+        return order
     return jnp.argsort(pid, stable=True).astype(jnp.int32)
 
 

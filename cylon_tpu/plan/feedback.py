@@ -125,6 +125,15 @@ class Decisions(NamedTuple):
     #: whenever a topology is declared). Policy only: both modes are
     #: row-exact, the CYLON_TPU_NO_TOPO oracle pins it.
     hop_mode: Optional[str] = None
+    #: sort engine impl (ops/radix.py): ``"bitonic"`` walks a shape back
+    #: to the chained compare sort when its journaled sort-stage clocks
+    #: show radix not beating the bitonic lowering (the ROADMAP's "a
+    #: kernel must beat its XLA lowering to merge" rule, enforced at
+    #: runtime per fingerprint); ``"radix"``/``"radix_pallas"`` pin a
+    #: tier. None = the static default (radix where the lane plan is
+    #: eligible). Policy only: the stable lexsort permutation is unique,
+    #: so every impl is bit-exact — only milliseconds move.
+    sort_impl: Optional[str] = None
 
 
 DECISIONS_OFF = Decisions()
@@ -269,6 +278,11 @@ def tuned_hop_mode() -> Optional[str]:
     return d.hop_mode if d is not None else None
 
 
+def tuned_sort_impl() -> Optional[str]:
+    d = _APPLIED.get()
+    return d.sort_impl if d is not None else None
+
+
 # ----------------------------------------------------------------------
 # proposers + hysteresis (called by the store as observations absorb)
 # ----------------------------------------------------------------------
@@ -286,6 +300,10 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         sm = "explore"
     elif sm == STATIC:
         sm = None
+    si = dec.get("sort_impl")
+    if si == STATIC:
+        # decided: radix holds up, keep the static default
+        si = None
     return (
         dec.get("shuffle_budget"),
         sm,
@@ -294,6 +312,7 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         dec.get("footprint"),
         dec.get("skew_trigger"),
         dec.get("hop_mode"),
+        si,
     )
 
 
@@ -395,6 +414,17 @@ def _proposals(
         if p.get("hop_n", 0) >= m and p.get("topo"):
             cand, ok = _hop_mode_proposal(p, mg)
             out["hop_mode"] = (cand, ok)
+
+        # -- sort impl: radix must beat its bitonic lowering, judged on
+        # the journaled sort-stage dispatch clocks (obs/prof record_sort
+        # -> store.note_sort). Every observation also carries the pass
+        # counts of BOTH impls (host-side estimators, ops/radix.py), so
+        # a one-sided profile walks back through the per-pass cost model
+        # without an exploratory recompile ------------------------------
+        if p.get("sort_ev"):
+            cand, ok = _sort_impl_proposal(p, mg, m)
+            if ok is not None:
+                out["sort_impl"] = (cand, ok)
 
         # -- admission footprint: lease observed bytes, not the static
         # input-size estimate. The p95 of the ledger-attributed per-query
@@ -545,6 +575,57 @@ def _hop_mode_proposal(p: Dict[str, Any], mg: float) -> Tuple[Any, bool]:
     return (None, True)
 
 
+def _sort_impl_proposal(
+    p: Dict[str, Any], mg: float, m: int
+) -> Tuple[Any, Optional[bool]]:
+    """Candidate sort impl from the per-impl dispatch-clock evidence
+    ``p["sort_ev"] = {impl: [n, ms_sum, passes_sum, alt_passes_sum]}``.
+
+    Both impls measured: propose the faster by the margin — "bitonic"
+    when the compare sort wins (the auto-default walk-back), STATIC when
+    radix holds (decision MADE: keep the default, stop re-judging).
+    One impl measured: model the other through the pass-count ratio the
+    observation carried (a radix run knows the bitonic sweep count its
+    shape would have paid, and vice versa) — the same
+    no-exploratory-flip principle as the hop-mode proposal. Returns
+    ``(None, None)`` when the evidence floor is not met."""
+
+    def _ev(impl):
+        ev = (p.get("sort_ev") or {}).get(impl)
+        if not ev or ev[0] < m:
+            return None
+        n, ms, passes, alt = ev
+        return ms / n, passes / max(n, 1), alt / max(n, 1)
+
+    bit = _ev("bitonic")
+    rad = _ev("radix") or _ev("radix_pallas")
+    if bit is not None and rad is not None:
+        if bit[0] <= rad[0] * (1.0 - mg):
+            return ("bitonic", True)
+        if rad[0] <= bit[0] * (1.0 - mg):
+            return (STATIC, True)
+        return (None, True)  # within the margin: keep the static default
+    if rad is not None:
+        ms, passes, alt = rad
+        if passes <= 0 or alt <= 0:
+            return (None, True)
+        modeled_bitonic = ms / passes * alt
+        if ms > modeled_bitonic * (1.0 + mg):
+            return ("bitonic", True)
+        return (STATIC, True)
+    if bit is not None:
+        ms, passes, alt = bit
+        if passes <= 0 or alt <= 0:
+            # alt == 0: the shape's lanes are radix-ineligible — nothing
+            # to decide
+            return (None, True)
+        modeled_radix = ms / passes * alt
+        if modeled_radix > ms * (1.0 + mg):
+            return ("bitonic", True)
+        return (STATIC, True)
+    return (None, None)
+
+
 def _serve_bucket_proposal(
     p: Dict[str, Any], target: float, mg: float
 ) -> Tuple[Any, bool]:
@@ -624,5 +705,13 @@ def describe(base: tuple) -> list:
         lines.append(
             f"hop_mode tuned: {d.hop_mode} "
             f"(was 2hop-on-topology, n={p.get('hop_n', 0)})"
+        )
+    if d.sort_impl is not None:
+        n_sort = sum(
+            ev[0] for ev in (p.get("sort_ev") or {}).values()
+        )
+        lines.append(
+            f"sort_impl tuned: {d.sort_impl} "
+            f"(was radix-where-eligible, n={n_sort})"
         )
     return lines

@@ -53,6 +53,7 @@ def gated_fingerprint(plan: Node) -> tuple:
     with it (graft-lint L1 sees the gate reads threaded into both cache
     keys through this carrier)."""
     from ..ops.quant import gate_state as _quant_gate
+    from ..ops.radix import gate_state as _radix_gate
     from ..ops.sketch import enabled as _semi_enabled
     from ..ops.stats import enabled as _pack_enabled
     from ..ordering import enabled as _ord_enabled
@@ -74,9 +75,16 @@ def gated_fingerprint(plan: Node) -> tuple:
     # decide whether every lowered exchange is flat or two-hop — a
     # mid-process flip re-optimizes instead of aliasing a two-hop
     # executor onto a flat run (parallel/topo.py)
+    # the radix component carries the sort-engine kill switch + the
+    # forcing env (ops/radix.py): they decide which sort lowering every
+    # lexsort-consuming kernel traces, so a flip re-optimizes instead of
+    # aliasing a radix executor onto a bitonic run (the tuned per-shape
+    # sort_impl rides the feedback component below, NOT this one — the
+    # store keys profiles by `base`, which must hold still across
+    # decision flips)
     base = (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
-        _spill_gate(), _quant_gate(), _topo_gate(),
+        _spill_gate(), _quant_gate(), _topo_gate(), _radix_gate(),
     )
     # the feedback component: (autotune active, tuned Decisions) — every
     # telemetry-driven override (shuffle budget, semi mode, serve bucket,

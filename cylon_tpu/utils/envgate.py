@@ -173,6 +173,13 @@ EXPAND_GATHER = EnvKnob(
     keyed_via="ops.join.impl_tag appended to every join-family cache key",
     note="in-kernel gather flavor of the Pallas windowed expand",
 )
+SORT_IMPL = EnvKnob(
+    "CYLON_TPU_SORT_IMPL", "auto", kind="impl",
+    keyed_via="ops.radix.impl_tag appended to every sort-family cache "
+    "key; plan fingerprints carry ops.radix.gate_state",
+    note="sort engine: 'auto' (radix where the lane plan is eligible), "
+    "'bitonic', 'radix', 'radix_pallas'",
+)
 FORCE_SHARD_MAP = EnvKnob(
     "CYLON_TPU_FORCE_SHARD_MAP", "0", kind="impl",
     keyed_via="engine.get_kernel appends its wrapping flags "

@@ -54,7 +54,32 @@ def main():
     ap.add_argument("--topo", type=str, default="",
                     help="OxI 2-D mesh (e.g. 4x2): warm the two-hop "
                          "shuffle kernels on a world of O*I devices")
+    ap.add_argument("--sort-impl", type=str, default="",
+                    help="comma list from {bitonic,radix,radix_pallas} or "
+                         "'all': warm the requested ops once per sort "
+                         "engine impl (the impl rides every sort-family "
+                         "cache key, so each impl is a distinct program; "
+                         "an image baked with all three makes a runtime "
+                         "CYLON_TPU_SORT_IMPL flip compile-free)")
     args = ap.parse_args()
+
+    # literal (not imported from ops.radix): cylon_tpu must not import
+    # before _force_cpu_mesh has declared the virtual mesh
+    _SORT_IMPLS = ("bitonic", "radix", "radix_pallas")
+
+    sort_impls = [None]
+    if args.sort_impl:
+        req = (
+            list(_SORT_IMPLS) if args.sort_impl.strip() == "all"
+            else [x.strip() for x in args.sort_impl.split(",") if x.strip()]
+        )
+        bad = [x for x in req if x not in _SORT_IMPLS]
+        if bad:
+            raise SystemExit(
+                f"--sort-impl: unknown impl(s) {bad}; choose from "
+                f"{sorted(_SORT_IMPLS)} or 'all'"
+            )
+        sort_impls = req
 
     world = 1
     if args.topo:
@@ -105,7 +130,7 @@ def main():
         left = make(n, "v")
         right = make(n, "w")
 
-        def timed(name, fn):
+        def timed(name, fn, impl=None):
             t0 = time.perf_counter()
             try:
                 fn()
@@ -115,34 +140,45 @@ def main():
             wall = time.perf_counter() - t0
             line = {"op": name, "cap": cap, "platform": platform,
                     "wall_s": round(wall, 2)}
+            if impl:
+                line["sort_impl"] = impl
             if err:
                 line["error"] = err
             print(json.dumps(line), flush=True)
 
-        if "join" in ops:
-            timed("join_inner", lambda: left.join(right, on="k"))
-            timed("join_left", lambda: left.join(right, on="k", how="left"))
-            timed(
-                "dist_join",
-                lambda: left.distributed_join(right, on="k"),
-            )
-            timed(
-                "dist_join_fused",
-                lambda: left.distributed_join(right, on="k", mode="fused"),
-            )
-        if "sort" in ops:
-            timed("sort", lambda: left.sort("v"))
-            timed("dist_sort", lambda: left.distributed_sort("v"))
-        if "setops" in ops:
-            lk = left.project(["k"])
-            rk = right.project(["k"])
-            timed("union", lambda: lk.union(rk))
-            timed("subtract", lambda: lk.subtract(rk))
-        if "groupby" in ops:
-            timed(
-                "groupby_sum",
-                lambda: left.distributed_groupby("k", {"v": "sum"}),
-            )
+        for impl in sort_impls:
+            if impl is not None:
+                os.environ["CYLON_TPU_SORT_IMPL"] = impl
+
+            def t(name, fn):
+                timed(name, fn, impl)
+
+            if "join" in ops:
+                t("join_inner", lambda: left.join(right, on="k"))
+                t("join_left", lambda: left.join(right, on="k", how="left"))
+                t(
+                    "dist_join",
+                    lambda: left.distributed_join(right, on="k"),
+                )
+                t(
+                    "dist_join_fused",
+                    lambda: left.distributed_join(right, on="k", mode="fused"),
+                )
+            if "sort" in ops:
+                t("sort", lambda: left.sort("v"))
+                t("dist_sort", lambda: left.distributed_sort("v"))
+            if "setops" in ops:
+                lk = left.project(["k"])
+                rk = right.project(["k"])
+                t("union", lambda: lk.union(rk))
+                t("subtract", lambda: lk.subtract(rk))
+            if "groupby" in ops:
+                t(
+                    "groupby_sum",
+                    lambda: left.distributed_groupby("k", {"v": "sum"}),
+                )
+        if args.sort_impl:
+            os.environ.pop("CYLON_TPU_SORT_IMPL", None)
         # drop per-bucket jit caches so memory stays bounded across buckets
         ctx.__dict__.get("_jit_cache", {}).clear()
         jax.clear_caches()
