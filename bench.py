@@ -26,7 +26,7 @@ Round-3 hardening (VERDICT.md item 1):
   jax.block_until_ready returns WITHOUT waiting through the remote tunnel, so
   naive device-side timings are fantasy;
 - every successful TPU measurement also writes a timestamped
-  BENCH_TPU_attempt.json next to this file, so a mid-round TPU number
+  benchmarks/results/BENCH_TPU_attempt.json, so a mid-round TPU number
   survives even if the end-of-round capture flakes.
 
 TPU-lane reliability (ROADMAP item 2 — the probe used to time out and
@@ -143,7 +143,10 @@ def record_tpu_attempt(payload: dict) -> None:
     if payload.get("platform") == "cpu" or "error" in payload:
         return
     try:
-        path = os.path.join(REPO_DIR, "BENCH_TPU_attempt.json")
+        path = os.path.join(
+            REPO_DIR, "benchmarks", "results", "BENCH_TPU_attempt.json"
+        )
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         now = int(time.time())
         stamped = dict(payload, captured_unix=now)
         best = stamped
@@ -466,7 +469,12 @@ def main():
         # so a stale file from an earlier round is visibly stale rather
         # than silently presented as current
         try:
-            with open(os.path.join(REPO_DIR, "BENCH_TPU_attempt.json")) as f:
+            with open(
+                os.path.join(
+                    REPO_DIR, "benchmarks", "results",
+                    "BENCH_TPU_attempt.json",
+                )
+            ) as f:
                 attempt = json.load(f)
             cap = attempt.get("captured_unix")
             if cap is not None:

@@ -59,10 +59,19 @@ def record_kernels(enable: bool) -> None:
 
 
 def recorded_kernels():
+    return [(fn, spec) for _key, fn, spec in (_KERNEL_RECORD or [])]
+
+
+def recorded_kernel_entries():
+    """Recorded dispatches WITH their cache keys: (key, fn, spec) triples.
+    The key is the logical dispatch identity (None for dispatches that
+    bypass get_kernel), which lets stage-level analyzers classify each
+    recorded program — tools/codec_smoke.py buckets pack vs compact
+    traffic by key prefix this way."""
     return list(_KERNEL_RECORD or [])
 
 
-def record_dispatch(fn, *args) -> None:
+def record_dispatch(fn, *args, key=None) -> None:
     """Record a kernel dispatch for the roofline analyzer — the ONE copy of
     the recording discipline, used both by get_kernel's wrapper and by
     dispatches that bypass get_kernel (the fused-join step is cached
@@ -82,7 +91,7 @@ def record_dispatch(fn, *args) -> None:
     )
     # lint: guarded=gil -- list.append is GIL-atomic and the recorder is a
     # single-threaded bench/analysis harness, never enabled while serving
-    _KERNEL_RECORD.append((fn, spec))
+    _KERNEL_RECORD.append((key, fn, spec))
 
 
 def round_cap(n: int, minimum: int = 8) -> int:
@@ -149,8 +158,8 @@ def get_kernel(
     if _KERNEL_RECORD is None:
         return fn
 
-    def recording(*args, _fn=fn):
-        record_dispatch(_fn, *args)
+    def recording(*args, _fn=fn, _key=key):
+        record_dispatch(_fn, *args, key=_key)
         return _fn(*args)
 
     return recording

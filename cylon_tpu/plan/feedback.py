@@ -134,6 +134,15 @@ class Decisions(NamedTuple):
     #: eligible). Policy only: the stable lexsort permutation is unique,
     #: so every impl is bit-exact — only milliseconds move.
     sort_impl: Optional[str] = None
+    #: shuffle codec impl (ops/pallas_codec.py): ``"xla"`` walks a shape
+    #: back to the XLA pack/compact lowerings when its journaled codec
+    #: dispatch clocks show the fused Pallas kernels not beating them
+    #: (the same beat-your-lowering rule as sort_impl); ``"pallas"``
+    #: pins the fused tier. None = the static default (pallas where the
+    #: structural predicates accept). Policy only: the codec is
+    #: bit-lossless on non-quant lanes and the CYLON_TPU_NO_PALLAS_CODEC
+    #: oracle pins exact equality — only milliseconds move.
+    codec_impl: Optional[str] = None
 
 
 DECISIONS_OFF = Decisions()
@@ -283,6 +292,11 @@ def tuned_sort_impl() -> Optional[str]:
     return d.sort_impl if d is not None else None
 
 
+def tuned_codec_impl() -> Optional[str]:
+    d = _APPLIED.get()
+    return d.codec_impl if d is not None else None
+
+
 # ----------------------------------------------------------------------
 # proposers + hysteresis (called by the store as observations absorb)
 # ----------------------------------------------------------------------
@@ -304,6 +318,10 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
     if si == STATIC:
         # decided: radix holds up, keep the static default
         si = None
+    ci = dec.get("codec_impl")
+    if ci == STATIC:
+        # decided: the fused pallas codec holds up, keep the static default
+        ci = None
     return (
         dec.get("shuffle_budget"),
         sm,
@@ -313,6 +331,7 @@ def effective_decisions(p: Dict[str, Any]) -> tuple:
         dec.get("skew_trigger"),
         dec.get("hop_mode"),
         si,
+        ci,
     )
 
 
@@ -340,15 +359,24 @@ def update_profile_decisions(p: Dict[str, Any], kind: str = "exec") -> None:
         else:
             pe = pend[field] = [enc, 1]
         if pe[1] >= m and margin_ok and not flipped:
-            # at most ONE field flips per observation: every flip
-            # re-keys the plan, and the recompile pin (exactly one
+            # at most ONE re-keying flip per observation: every counted
+            # flip re-keys the plan, and the recompile pin (exactly one
             # plan-cache miss per flip) must hold even when two gates'
             # hysteresis streaks mature on the same record — the
             # runner-up keeps its matured streak and flips on the next
-            # gate-relevant observation
-            flipped = True
+            # gate-relevant observation. A decision that leaves the
+            # EFFECTIVE tuple unchanged (the impl fields settling an
+            # unset incumbent to STATIC — both carry None in the
+            # fingerprint by design, the no-exploratory-recompile
+            # principle) is recorded in ``dec`` so re-judging stops, but
+            # is NOT a flip: it neither recompiles nor consumes the
+            # one-flip slot
+            before = effective_decisions(p)
             dec[field] = cand
             pend.pop(field, None)
+            if effective_decisions(p) == before:
+                continue
+            flipped = True
             p["flips"] = p.get("flips", 0) + 1
             if field == "serve_bucket":
                 # the latency evidence was gathered under the OLD bucket;
@@ -425,6 +453,18 @@ def _proposals(
             cand, ok = _sort_impl_proposal(p, mg, m)
             if ok is not None:
                 out["sort_impl"] = (cand, ok)
+
+        # -- shuffle codec impl: the fused pallas pack/compact must beat
+        # their XLA lowerings, judged on the journaled per-stage codec
+        # dispatch clocks (table dispatch -> store.note_codec). Every
+        # observation also carries BOTH impls' modeled row-pass counts
+        # (ops/pallas_codec row-pass census), so a one-sided profile
+        # walks back through the per-pass cost model without an
+        # exploratory recompile --------------------------------------
+        if p.get("codec_ev"):
+            cand, ok = _codec_impl_proposal(p, mg, m)
+            if ok is not None:
+                out["codec_impl"] = (cand, ok)
 
         # -- admission footprint: lease observed bytes, not the static
         # input-size estimate. The p95 of the ledger-attributed per-query
@@ -626,6 +666,58 @@ def _sort_impl_proposal(
     return (None, None)
 
 
+def _codec_impl_proposal(
+    p: Dict[str, Any], mg: float, m: int
+) -> Tuple[Any, Optional[bool]]:
+    """Candidate shuffle codec impl from the per-impl dispatch-clock
+    evidence ``p["codec_ev"] = {impl: [n, ms_sum, row_passes_sum,
+    alt_row_passes_sum]}`` — the sort_impl proposal's shape, two-way
+    xla|pallas.
+
+    Both impls measured: propose the faster by the margin — "xla" when
+    the XLA lowerings win (the auto-default walk-back), STATIC when the
+    fused kernels hold (decision MADE: keep the default, stop
+    re-judging). One impl measured: model the other through the row-pass
+    ratio the observation carried (a pallas round knows the 3-pass XLA
+    pack its shape would have paid, and vice versa). Returns
+    ``(None, None)`` when the evidence floor is not met."""
+
+    def _ev(impl):
+        ev = (p.get("codec_ev") or {}).get(impl)
+        if not ev or ev[0] < m:
+            return None
+        n, ms, passes, alt = ev
+        return ms / n, passes / max(n, 1), alt / max(n, 1)
+
+    xla = _ev("xla")
+    pls = _ev("pallas")
+    if xla is not None and pls is not None:
+        if xla[0] <= pls[0] * (1.0 - mg):
+            return ("xla", True)
+        if pls[0] <= xla[0] * (1.0 - mg):
+            return (STATIC, True)
+        return (None, True)  # within the margin: keep the static default
+    if pls is not None:
+        ms, passes, alt = pls
+        if passes <= 0 or alt <= 0:
+            return (None, True)
+        modeled_xla = ms / passes * alt
+        if ms > modeled_xla * (1.0 + mg):
+            return ("xla", True)
+        return (STATIC, True)
+    if xla is not None:
+        ms, passes, alt = xla
+        if passes <= 0 or alt <= 0:
+            # alt == passes would mean no fusable stage — nothing to
+            # decide; alt <= 0 is the no-evidence degenerate
+            return (None, True)
+        modeled_pallas = ms / passes * alt
+        if modeled_pallas > ms * (1.0 + mg):
+            return ("xla", True)
+        return (STATIC, True)
+    return (None, None)
+
+
 def _serve_bucket_proposal(
     p: Dict[str, Any], target: float, mg: float
 ) -> Tuple[Any, bool]:
@@ -713,5 +805,13 @@ def describe(base: tuple) -> list:
         lines.append(
             f"sort_impl tuned: {d.sort_impl} "
             f"(was radix-where-eligible, n={n_sort})"
+        )
+    if d.codec_impl is not None:
+        n_codec = sum(
+            ev[0] for ev in (p.get("codec_ev") or {}).values()
+        )
+        lines.append(
+            f"codec_impl tuned: {d.codec_impl} "
+            f"(was pallas-where-supported, n={n_codec})"
         )
     return lines

@@ -52,6 +52,7 @@ def gated_fingerprint(plan: Node) -> tuple:
     the plan cache with it and the serving scheduler groups/keys batches
     with it (graft-lint L1 sees the gate reads threaded into both cache
     keys through this carrier)."""
+    from ..ops.pallas_codec import gate_state as _codec_gate
     from ..ops.quant import gate_state as _quant_gate
     from ..ops.radix import gate_state as _radix_gate
     from ..ops.sketch import enabled as _semi_enabled
@@ -82,9 +83,13 @@ def gated_fingerprint(plan: Node) -> tuple:
     # sort_impl rides the feedback component below, NOT this one — the
     # store keys profiles by `base`, which must hold still across
     # decision flips)
+    # the codec component carries the fused-shuffle-codec kill switch +
+    # forcing env (ops/pallas_codec.py) under the same discipline: the
+    # tuned per-shape codec_impl rides the feedback component
     base = (
         plan.fingerprint(), _ord_enabled(), _semi_enabled(), _pack_enabled(),
         _spill_gate(), _quant_gate(), _topo_gate(), _radix_gate(),
+        _codec_gate(),
     )
     # the feedback component: (autotune active, tuned Decisions) — every
     # telemetry-driven override (shuffle budget, semi mode, serve bucket,

@@ -49,6 +49,7 @@ from .ops import setops as _s
 from .ops import gather as _g_pack
 from .ops import quant as _quant
 from .ops import sketch as _sketch
+from .ops import pallas_codec as _codec
 from .ops import radix as _radix
 from .ops import sort as _sort_mod
 from .ops import stats as _st
@@ -3405,7 +3406,7 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
         quant_sig, ("topo", tuple(topo_cfg) if topo_cfg else None),
     ) + (
         ("semi", spec.probe_row, spec.use_range) if semi else ()
-    ) + _radix.impl_tag()
+    ) + _radix.impl_tag() + _codec.impl_tag()
     has_lanes = any(
         tag is not None or has_valid for tag, _nl, has_valid in plan_sig
     )
@@ -3467,11 +3468,38 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 n = counts[0]
                 pid = compute_pid(cols, kcols, n)
             bc = dummy.shape[0]
-            cnt = _sh.bucket_counts(pid, world)
-            dest, _leftover = _sh.build_send_slots_round(pid, cnt, world, bc, rnd)
+            n_header = (
+                _sh.wire_header_rows(wire) if wire is not None
+                else _sh.HEADER_ROWS
+            )
+            if _codec.pack_engaged(kind, semi, has_lanes, n_header, world):
+                # fused hash→partition→slot kernel (ops/pallas_codec):
+                # dest/cnt come out of ONE VMEM pass over the key words;
+                # the collision-free lane-buffer scatter below is shared
+                # with the XLA path, so `head` is bit-identical by
+                # construction. Range/task/semi packs can't replay the
+                # pid in Mosaic — the XLA pid lane (incl. the semi probe
+                # rewrite above) feeds the same kernel and histogram +
+                # rank + slot still fuse; in hash mode `pid` above is
+                # dead and DCE'd.
+                if _codec.pack_fuses_hash(kind, semi):
+                    words, valids, hv = _codec.hash_operands(list(kcols))
+                    dest, cnt = _codec.fused_pack_dest(
+                        words, valids, hv, n, rnd, world, bc,
+                        interpret=jax.default_backend() == "cpu",
+                    )
+                else:
+                    dest, cnt = _codec.fused_pack_dest(
+                        [], [], (), n, rnd, world, bc, pid=pid,
+                        interpret=jax.default_backend() == "cpu",
+                    )
+            else:
+                cnt = _sh.bucket_counts(pid, world)
+                dest, _leftover = _sh.build_send_slots_round(
+                    pid, cnt, world, bc, rnd
+                )
             rc = _sh.round_counts(cnt, bc, rnd)
             hx = None
-            n_header = _sh.HEADER_ROWS
             if wire is not None:
                 # bit-width-adaptive wire narrowing: lanes are the packed
                 # words of the stats-driven wire plan (validity at 1
@@ -3479,8 +3507,7 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 # riding in as the tiny replicated `bases` operand).
                 # Quantized 'q8' fields additionally compute one block
                 # scale per destination chunk here and ship it in the
-                # (widened) header rows beside the counts.
-                n_header = _sh.wire_header_rows(wire)
+                # (widened) header rows beside the counts (n_header above).
                 qrows = None
                 if _g_pack.wire_q8_cols(wire):
                     scales = _sh.quant_chunk_scales(
@@ -3729,6 +3756,67 @@ def _shuffle_state(spec: "_ShuffleSpec") -> dict:
                 lane_rows, recv_counts = None, head
                 bc = pts[0].shape[0] // world
                 pt_cols = dict(zip(pt_order, pts))
+            nml = 0
+            if lane_rows is not None:
+                nml = (
+                    lane_rows.shape[1]
+                    + (qsc_rows.shape[1] if qsc_rows is not None else 0)
+                    + (1 if pt_cols else 0)
+                )
+            if _codec.compact_engaged(
+                lane_rows is not None, False, world, bc, nml
+            ):
+                # fused front-pack (ops/pallas_codec): ONE masked block-
+                # copy pass replaces the liveness mask + stable argsort +
+                # 400x-priced row gather. q8 scale rows ride the move
+                # matrix bitcast; f64 passthrough columns (no i32 lane
+                # route on TPU) gather by a carried row-index lane that
+                # equals the argsort order bit-for-bit, dead rows included
+                parts = [lane_rows]
+                if qsc_rows is not None:
+                    parts.append(
+                        jax.lax.bitcast_convert_type(qsc_rows, jnp.int32)
+                    )
+                if pt_cols:
+                    parts.append(
+                        jnp.arange(
+                            world * bc, dtype=jnp.int32
+                        ).reshape(-1, 1)
+                    )
+                moved, total = _codec.fused_compact_move(
+                    jnp.concatenate(parts, axis=1), recv_counts, world, bc,
+                    interpret=jax.default_backend() == "cpu",
+                )
+                nw = lane_rows.shape[1]
+                word_lanes = [moved[:, j] for j in range(nw)]
+                qsc = None
+                if qsc_rows is not None:
+                    nq8 = qsc_rows.shape[1]
+                    qsc = jax.lax.bitcast_convert_type(
+                        moved[:, nw : nw + nq8], jnp.float32
+                    )
+                    nw += nq8
+                if pt_cols:
+                    order = moved[:, nw]
+                    sorted_pt = {ci: d[order] for ci, d in pt_cols.items()}
+                else:
+                    sorted_pt = {}
+                mk_valid = (
+                    lambda lane: None if lane is None
+                    else lane.astype(jnp.bool_)
+                )
+                if wire is not None:
+                    (bases,) = rep
+                    out = _g_pack.wire_unpack_cols(
+                        word_lanes, wire, bases,
+                        lambda ci: sorted_pt[ci], mk_valid, qscales=qsc,
+                    )
+                else:
+                    out, _ = _g_pack.unpack_cols(
+                        list(plan_sig), word_lanes,
+                        lambda ci: sorted_pt[ci], mk_valid,
+                    )
+                return out, _scalar(total)
             mask, total = _sh.received_row_mask(recv_counts, world, bc)
             if wire is not None:
                 (bases,) = rep
@@ -4329,11 +4417,13 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                     )
                 if st["wire"] is not None:
                     rep = rep + (st["bases"],)
+                t_pk0 = _time.perf_counter()
                 with span("shuffle.round.pack"):
                     head, pts = get_kernel(
                         ctx, st["key"] + ("pack", st["wire"]),
-                        st["build_pack"],
+                        st["build_pack"], **_codec.kernel_kwargs(),
                     )(dp, rep)
+                t_pk1 = _time.perf_counter()
                 # the two-hop plan joins both dispatch keys: its cap_o /
                 # header statics are baked into the kernel bodies, so a
                 # plan (or kill-switch) flip compiles its own program
@@ -4349,15 +4439,68 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
                          len(st["pt_eff"]), tp_key),
                         st["build_coll"],
                     )((head, pts), ())
+                t_cp0 = _time.perf_counter()
                 with span("shuffle.round.compact"):
                     out, nout = get_kernel(
                         ctx,
                         ("shuffle_compact", st["plan_sig"],
-                         st["has_lanes"], st["wire"], tp_key),
-                        st["build_compact"],
+                         st["has_lanes"], st["wire"], tp_key)
+                        + _codec.impl_tag(),
+                        st["build_compact"], **_codec.kernel_kwargs(),
                     )(
                         coll_out,
                         (st["bases"],) if st["wire"] is not None else (),
+                    )
+                t_cp1 = _time.perf_counter()
+                # codec-impl evidence for the autopilot (the sort engine's
+                # clock discipline, table.py sort above): the resolved
+                # impl's pack+compact dispatch walls + BOTH impls' modeled
+                # row-pass counts for this shape, so a one-sided profile
+                # can walk back through the per-pass cost model
+                # (plan/feedback._codec_impl_proposal). Pure host
+                # arithmetic + contextvars — 0 sync sites; note_codec
+                # no-ops outside plan executions.
+                n_header = (
+                    _sh.wire_header_rows(st["wire"])
+                    if st["wire"] is not None else _sh.HEADER_ROWS
+                )
+                fuse_hash = _codec.pack_fuses_hash(
+                    st["spec"].kind, st["spec"].sketch is not None
+                )
+                pk_sup = _codec.pack_supported(
+                    st["spec"].kind, st["spec"].sketch is not None,
+                    st["has_lanes"], n_header, st["world"],
+                )
+                cp_sup = tp_key is None and _codec.compact_supported(
+                    st["has_lanes_eff"], False, st["world"],
+                    st["bucket_cap"],
+                    _codec.move_lane_count(
+                        st["plan_sig"], st["wire"], len(st["pt_eff"])
+                    ),
+                )
+
+                def _codec_units(impl):
+                    return _codec.pack_row_passes(
+                        "pallas" if impl == "pallas" and pk_sup else "xla",
+                        fuse_hash,
+                    ) + _codec.compact_row_passes(
+                        "pallas" if impl == "pallas" and cp_sup else "xla"
+                    )
+
+                cimpl = _codec.resolved_impl()
+                st["codec_impls"] = (
+                    ("pallas" if fuse_hash else "pallas_pid")
+                    if cimpl == "pallas" and pk_sup else "xla",
+                    "pallas" if cimpl == "pallas" and cp_sup else "xla",
+                )
+                if pk_sup or cp_sup:
+                    _obsstore.note_codec(
+                        cimpl,
+                        (t_pk1 - t_pk0) + (t_cp1 - t_cp0),
+                        _codec_units(cimpl),
+                        _codec_units(
+                            "xla" if cimpl == "pallas" else "pallas"
+                        ),
                     )
                 if st["tier"] != _spill.TIER_HBM:
                     # tier 1/2: this round's compacted output streams into
@@ -4526,7 +4669,8 @@ def _shuffle_many_rounds(states, rows_total) -> List["Table"]:
             [
                 (st["send_counts"], st["n_rounds"], st["bucket_cap"],
                  st["sched"].relay,
-                 tuple(st["topo_plan"]) if st["topo_plan"] else None)
+                 tuple(st["topo_plan"]) if st["topo_plan"] else None,
+                 st.get("codec_impls", ("xla", "xla")))
                 for st in states
             ],
             states[0]["world"], t0, t_dev,

@@ -180,6 +180,13 @@ SORT_IMPL = EnvKnob(
     note="sort engine: 'auto' (radix where the lane plan is eligible), "
     "'bitonic', 'radix', 'radix_pallas'",
 )
+CODEC_IMPL = EnvKnob(
+    "CYLON_TPU_CODEC_IMPL", "auto", kind="impl",
+    keyed_via="ops.pallas_codec.impl_tag appended to every shuffle-family "
+    "cache key; plan fingerprints carry ops.pallas_codec.gate_state",
+    note="shuffle codec engine: 'auto' (fused Pallas pack/compact where "
+    "the structural predicates accept), 'xla', 'pallas'",
+)
 FORCE_SHARD_MAP = EnvKnob(
     "CYLON_TPU_FORCE_SHARD_MAP", "0", kind="impl",
     keyed_via="engine.get_kernel appends its wrapping flags "

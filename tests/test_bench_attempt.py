@@ -15,7 +15,7 @@ def attempt_env(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "REPO_DIR", str(tmp_path))
     clock = {"now": 1_000_000}
     monkeypatch.setattr(bench.time, "time", lambda: clock["now"])
-    path = tmp_path / "BENCH_TPU_attempt.json"
+    path = tmp_path / "benchmarks" / "results" / "BENCH_TPU_attempt.json"
 
     def capture(vs, rows=8_000_000, at=None, **extra):
         if at is not None:
@@ -67,13 +67,16 @@ def test_config_change_resets(attempt_env):
 
 def test_cpu_and_error_lines_never_recorded(attempt_env, tmp_path):
     capture, clock = attempt_env
+    results = tmp_path / "benchmarks" / "results"
     bench.record_tpu_attempt({"platform": "cpu", "vs_baseline": 99.0})
     bench.record_tpu_attempt({"platform": "tpu", "error": "x", "vs_baseline": 99.0})
-    assert not (tmp_path / "BENCH_TPU_attempt.json").exists()
+    assert not (results / "BENCH_TPU_attempt.json").exists()
 
 
 def test_corrupt_previous_file_still_records(attempt_env, tmp_path):
     capture, clock = attempt_env
-    (tmp_path / "BENCH_TPU_attempt.json").write_text("{not json")
+    results = tmp_path / "benchmarks" / "results"
+    results.mkdir(parents=True)
+    (results / "BENCH_TPU_attempt.json").write_text("{not json")
     out = capture(9.0)
     assert out["vs_baseline"] == 9.0 and out["captures_this_round"] == 1

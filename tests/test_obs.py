@@ -20,6 +20,7 @@ Covers the tentpole surface end to end:
 """
 import gc
 import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -305,6 +306,14 @@ def test_q3_metrics_all_declared(ctx8, rng):
 # concurrent isolation (the 8-thread acceptance twin lives in
 # tests/test_concurrent_dispatch.py)
 # ----------------------------------------------------------------------
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="two in-flight 8-device collective programs deadlock XLA:CPU's "
+           "device-count-sized dispatch pool on a single-core host (the "
+           "cross-run rendezvous strand documented in "
+           "tests/test_concurrent_dispatch.py) — the hammer twin there "
+           "carries the same guard",
+)
 def test_two_threads_two_disjoint_trees(ctx8, rng, traced):
     lf = _q3(ctx8, rng)
     lf.collect()  # warm: the hammer exercises the lock-free hit path
@@ -556,8 +565,15 @@ def test_explain_analyze_crit_column(ctx8, rng):
 def test_traceview_critical_report(ctx8, rng, profiled, tmp_path, capsys):
     """traceview --critical names the bottleneck stage: a skew-side
     stage (relay/collective) on the one-hot shape, a local stage
-    (pack/compact) on the uniform shape."""
+    (pack/compact) on the uniform shape.
+
+    The uniform leg runs under the codec kill switch: "local stages
+    dominate a uniform shuffle" is an XLA-codec stage-algebra claim
+    (3-pass pack), and the fused pallas codec exists precisely to shrink
+    those stages below the collective — same pin discipline as
+    test_lane_pack's bitonic-era gate under CYLON_TPU_NO_RADIX."""
     import tools.traceview as tv
+    from cylon_tpu.ops import pallas_codec as _pc
 
     n = 8000
     out = {}
@@ -566,7 +582,8 @@ def test_traceview_critical_report(ctx8, rng, profiled, tmp_path, capsys):
         ("one-hot", np.zeros(n, np.int32)),
     ):
         obs_export.reset_ring()
-        ct.Table.from_pydict(ctx8, {"k": keys}).shuffle(["k"])
+        with _pc.disabled():
+            ct.Table.from_pydict(ctx8, {"k": keys}).shuffle(["k"])
         path = str(tmp_path / f"{name}.json")
         obs_export.write_chrome(path)
         assert tv.main([path, "--critical"]) == 0

@@ -1,0 +1,271 @@
+"""Shuffle-codec smoke — the fused Pallas pack/compact CI gate.
+
+Gates (exit 1 on any failure):
+
+1. **Pack+compact byte cut** — the 8-way dist_inner_join shape and the
+   q3_ordered chain (key-order join emit -> groupby run-detect) must
+   both run >= the gate (default 30%) fewer roofline-modeled HBM bytes
+   across their traced PACK and COMPACT kernels under the fused codec
+   than under the CYLON_TPU_NO_PALLAS_CODEC=1 oracle (kernels are
+   classified by their dispatch cache keys via
+   engine.recorded_kernel_entries; the deleted traffic is the grouping
+   sort, the destination-slot permutation round-trips, and the
+   400x-priced compact row gather).
+2. **Oracle-exact output** — the fused run's table output is
+   bit-identical to the oracle's on both shapes (the codec is lossless
+   by contract, quantized lanes included: both impls ship the same
+   codes and scales).
+3. **Exactly-N-recompile impl flip** — flipping CYLON_TPU_CODEC_IMPL
+   on a warmed join recompiles exactly the shuffle-family programs
+   (one per distinct pack/compact dispatch key — the impl tag keys,
+   never aliases), and flipping back costs ZERO.
+4. **Census cross-check** — ops/pallas_codec.py's row-pass tables
+   agree with the analysis/contracts.py pins and the obs/prof.py
+   impl-keyed stage weights.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/codec_smoke.py --rows 20000
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _fail(msg: str) -> None:
+    print(f"CODEC SMOKE GATE FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _classify(key):
+    """'pack' / 'compact' / None from a recorded dispatch cache key —
+    the same tuples table.py builds (pack rides st["key"] + ("pack",
+    wire); compact keys lead with "shuffle_compact")."""
+    if not isinstance(key, tuple) or not key:
+        return None
+    if key[0] == "shuffle_compact":
+        return "compact"
+    if key[0] == "shuffle" and "pack" in key:
+        return "pack"
+    return None
+
+
+def measure(op):
+    """({stage: (modeled bytes, merged by_prim)}, result) over the PACK
+    and COMPACT kernels one warm call dispatches."""
+    from benchmarks.roofline import analyze
+    from cylon_tpu import engine
+
+    op()  # warm (compile outside the recorded call)
+    engine.record_kernels(True)
+    try:
+        out = op()
+    finally:
+        entries = engine.recorded_kernel_entries()
+        engine.record_kernels(False)
+    stages = {"pack": [0.0, {}], "compact": [0.0, {}]}
+    for key, fn, args in entries:
+        stage = _classify(key)
+        if stage is None:
+            continue
+        rep = analyze(fn, *args)
+        stages[stage][0] += rep.total_model_bytes
+        for k, v in rep.by_prim.items():
+            stages[stage][1][k] = stages[stage][1].get(k, 0.0) + v
+    return stages, out
+
+
+def run(rows: int, world: int, gate: float) -> int:
+    import __graft_entry__ as ge
+
+    devices = ge._force_cpu_mesh(max(world, 1))
+
+    import cylon_tpu as ct
+    from benchmarks.lane_pack_bench import make_join_pair
+    from cylon_tpu.analysis import contracts
+    from cylon_tpu.obs import prof
+    from cylon_tpu.ops import pallas_codec as pc
+
+    # -- gate 4 first: the static census pins (no compile needed) -------
+    if pc.PACK_ROW_PASSES != contracts.CODEC_PACK_ROW_PASSES:
+        _fail(
+            f"pack row-pass drift: ops.pallas_codec {pc.PACK_ROW_PASSES} "
+            f"vs contracts {contracts.CODEC_PACK_ROW_PASSES}"
+        )
+    if pc.COMPACT_ROW_PASSES != contracts.CODEC_COMPACT_ROW_PASSES:
+        _fail("compact row-pass drift between ops.pallas_codec and contracts")
+    for impl, passes in pc.PACK_ROW_PASSES.items():
+        if prof.PACK_WEIGHT_BY_IMPL[impl] != float(passes):
+            _fail(f"prof pack weight drift for impl {impl!r}")
+    for impl, passes in pc.COMPACT_ROW_PASSES.items():
+        if prof.COMPACT_WEIGHT_BY_IMPL[impl] != float(passes):
+            _fail(f"prof compact weight drift for impl {impl!r}")
+    if pc.pack_row_passes("pallas", fuse_hash=False) != 2:
+        _fail("pid-input pack mode must cost 2 row passes")
+    if not pc.codec_available():
+        _fail("pallas unavailable: the fused codec cannot engage")
+
+    ctx = ct.CylonContext.init_distributed(
+        ct.TPUConfig(devices=devices[:world])
+    )
+    rng = np.random.default_rng(0)
+    n = rows
+    lt, rt = make_join_pair(ct, ctx, rng, n)
+
+    prev = os.environ.get("CYLON_TPU_CODEC_IMPL")
+    os.environ["CYLON_TPU_CODEC_IMPL"] = "pallas"
+    try:
+        # -- shape 1: the 8-way dist_inner_join -------------------------
+        def join_op():
+            return lt.distributed_join(rt, on=["k1", "k2"], how="inner")
+
+        t0 = time.perf_counter()
+        jp, out_p = measure(join_op)
+        tp = time.perf_counter() - t0
+        with pc.disabled():
+            t0 = time.perf_counter()
+            jo, out_o = measure(join_op)
+            to = time.perf_counter() - t0
+
+        # -- shape 2: q3_ordered (key-order emit -> groupby run-detect) -
+        def q3_op():
+            return lt.distributed_join(
+                rt, on=["k1", "k2"], how="inner", emit_order="key"
+            ).distributed_groupby(["k1_x", "k2_x"], {"v": "sum"})
+
+        qp, q_out_p = measure(q3_op)
+        with pc.disabled():
+            qo, q_out_o = measure(q3_op)
+
+        def stage_bytes(st):
+            return st["pack"][0] + st["compact"][0]
+
+        def cut(p, o):
+            return 1.0 - stage_bytes(p) / stage_bytes(o) if stage_bytes(o) else 0.0
+
+        join_cut = cut(jp, jo)
+        q3_cut = cut(qp, qo)
+        rec = {
+            "benchmark": "codec_smoke",
+            "rows": n,
+            "world": world,
+            "join_oracle_mb": round(stage_bytes(jo) / 1e6, 3),
+            "join_fused_mb": round(stage_bytes(jp) / 1e6, 3),
+            "join_cut_pct": round(100 * join_cut, 1),
+            "q3_oracle_mb": round(stage_bytes(qo) / 1e6, 3),
+            "q3_fused_mb": round(stage_bytes(qp) / 1e6, 3),
+            "q3_cut_pct": round(100 * q3_cut, 1),
+            "fused_warm_s": round(tp, 4),
+            "oracle_warm_s": round(to, 4),
+        }
+        print(json.dumps(rec), flush=True)
+
+        # -- engagement: the fused kernels must actually be in the trace
+        for name, st in (("join", jp), ("q3", qp)):
+            if "pallas_call" not in st["pack"][1]:
+                _fail(f"fused pack did not engage on the {name} shape")
+            if "pallas_call" not in st["compact"][1]:
+                _fail(f"fused compact did not engage on the {name} shape")
+
+        # -- gate 2: oracle-exact output -------------------------------
+        keys = ["k1_x", "k2_x"]
+        g = out_p.to_pandas()
+        w = out_o.to_pandas()
+        cols = list(g.columns)
+        g = g.sort_values(cols).reset_index(drop=True)
+        w = w.sort_values(cols).reset_index(drop=True)
+        if len(g) != len(w) or not g.equals(w):
+            _fail("fused join output differs from the kill-switch oracle")
+        gq = q_out_p.to_pandas().sort_values(keys).reset_index(drop=True)
+        wq = q_out_o.to_pandas().sort_values(keys).reset_index(drop=True)
+        if len(gq) != len(wq) or not gq.equals(wq):
+            _fail("fused q3_ordered aggregate differs from the oracle")
+
+        # -- gate 1: byte cuts -----------------------------------------
+        if join_cut < gate:
+            _fail(
+                f"join pack+compact byte cut {100 * join_cut:.1f}% "
+                f"(< gate {100 * gate:.0f}%)"
+            )
+        if q3_cut < gate:
+            _fail(
+                f"q3_ordered pack+compact byte cut {100 * q3_cut:.1f}% "
+                f"(< gate {100 * gate:.0f}%)"
+            )
+
+        # -- gate 3: impl flip recompiles exactly the shuffle-family ---
+        from cylon_tpu import engine
+
+        cache = ctx.__dict__.setdefault("_jit_cache", {})
+        # a key combination nothing above compiled, so both impls start
+        # cold (the shapes above already hold BOTH impls' programs)
+        def flip_op():
+            return lt.distributed_join(rt, on=["k1"], how="inner")
+
+        flip_want = flip_op().to_pandas()  # warm the pallas programs
+        n0 = len(cache)
+        os.environ["CYLON_TPU_CODEC_IMPL"] = "xla"
+        engine.record_kernels(True)
+        try:
+            flip_out = flip_op()
+        finally:
+            fam = {
+                key
+                for key, _fn, _args in engine.recorded_kernel_entries()
+                if _classify(key)
+            }
+            engine.record_kernels(False)
+        n1 = len(cache)
+        if n1 - n0 != len(fam):
+            _fail(
+                f"impl flip compiled {n1 - n0} new programs (expected "
+                f"{len(fam)}: one per shuffle-family dispatch key under "
+                "the new impl tag)"
+            )
+        f = flip_out.to_pandas()
+        cols = list(f.columns)
+        if not f.sort_values(cols).reset_index(drop=True).equals(
+            flip_want.sort_values(cols).reset_index(drop=True)
+        ):
+            _fail("xla flip output differs from the fused emit")
+        os.environ["CYLON_TPU_CODEC_IMPL"] = "pallas"
+        flip_op()
+        if len(cache) != n1:
+            _fail(
+                "flip-back recompiled: the fused programs were not "
+                "retained under their own keys"
+            )
+    finally:
+        if prev is None:
+            os.environ.pop("CYLON_TPU_CODEC_IMPL", None)
+        else:
+            os.environ["CYLON_TPU_CODEC_IMPL"] = prev
+
+    print(
+        f"# codec smoke ok: join pack+compact -{100 * join_cut:.1f}%, "
+        f"q3_ordered -{100 * q3_cut:.1f}%, impl flip = {len(fam)} "
+        "recompiles (shuffle-family only), flip-back = 0",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=20_000)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--gate", type=float, default=0.30,
+                    help="minimum fractional pack+compact byte reduction")
+    args = ap.parse_args()
+    sys.exit(run(args.rows, args.world, args.gate))
+
+
+if __name__ == "__main__":
+    main()

@@ -67,7 +67,8 @@ def main():
     ap.add_argument("--widths", type=str, default="16,32")
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--out", type=str,
-                    default=os.path.join(REPO, "MULTICHIP_r05_wide.json"))
+                    default=os.path.join(REPO, "benchmarks", "results",
+                                         "MULTICHIP_r05_wide.json"))
     args = ap.parse_args()
     runs = []
     for w in (int(x) for x in args.widths.split(",")):

@@ -61,25 +61,37 @@ def main():
                          "cache key, so each impl is a distinct program; "
                          "an image baked with all three makes a runtime "
                          "CYLON_TPU_SORT_IMPL flip compile-free)")
+    ap.add_argument("--codec-impl", type=str, default="",
+                    help="comma list from {xla,pallas} or 'all': warm the "
+                         "requested ops once per shuffle-codec impl (the "
+                         "impl tag rides every shuffle-family cache key, "
+                         "so a runtime CYLON_TPU_CODEC_IMPL flip on a "
+                         "pre-baked image is compile-free)")
     args = ap.parse_args()
 
-    # literal (not imported from ops.radix): cylon_tpu must not import
-    # before _force_cpu_mesh has declared the virtual mesh
+    # literals (not imported from ops.radix / ops.pallas_codec):
+    # cylon_tpu must not import before _force_cpu_mesh has declared the
+    # virtual mesh
     _SORT_IMPLS = ("bitonic", "radix", "radix_pallas")
+    _CODEC_IMPLS = ("xla", "pallas")
 
-    sort_impls = [None]
-    if args.sort_impl:
+    def _impl_list(arg, universe, flag):
+        if not arg:
+            return [None]
         req = (
-            list(_SORT_IMPLS) if args.sort_impl.strip() == "all"
-            else [x.strip() for x in args.sort_impl.split(",") if x.strip()]
+            list(universe) if arg.strip() == "all"
+            else [x.strip() for x in arg.split(",") if x.strip()]
         )
-        bad = [x for x in req if x not in _SORT_IMPLS]
+        bad = [x for x in req if x not in universe]
         if bad:
             raise SystemExit(
-                f"--sort-impl: unknown impl(s) {bad}; choose from "
-                f"{sorted(_SORT_IMPLS)} or 'all'"
+                f"{flag}: unknown impl(s) {bad}; choose from "
+                f"{sorted(universe)} or 'all'"
             )
-        sort_impls = req
+        return req
+
+    sort_impls = _impl_list(args.sort_impl, _SORT_IMPLS, "--sort-impl")
+    codec_impls = _impl_list(args.codec_impl, _CODEC_IMPLS, "--codec-impl")
 
     world = 1
     if args.topo:
@@ -142,13 +154,19 @@ def main():
                     "wall_s": round(wall, 2)}
             if impl:
                 line["sort_impl"] = impl
+            if cimpl:
+                line["codec_impl"] = cimpl
             if err:
                 line["error"] = err
             print(json.dumps(line), flush=True)
 
-        for impl in sort_impls:
+        for impl, cimpl in (
+            (s, c) for s in sort_impls for c in codec_impls
+        ):
             if impl is not None:
                 os.environ["CYLON_TPU_SORT_IMPL"] = impl
+            if cimpl is not None:
+                os.environ["CYLON_TPU_CODEC_IMPL"] = cimpl
 
             def t(name, fn):
                 timed(name, fn, impl)
@@ -179,6 +197,8 @@ def main():
                 )
         if args.sort_impl:
             os.environ.pop("CYLON_TPU_SORT_IMPL", None)
+        if args.codec_impl:
+            os.environ.pop("CYLON_TPU_CODEC_IMPL", None)
         # drop per-bucket jit caches so memory stays bounded across buckets
         ctx.__dict__.get("_jit_cache", {}).clear()
         jax.clear_caches()

@@ -28,13 +28,13 @@ note "chain: step 1 bench.py"
 # this chain start
 START_MARK=$(mktemp)
 BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 timeout 1200 python bench.py >> "$LOG" 2>&1
-if [ -z "$(find BENCH_TPU_attempt.json -newer "$START_MARK" 2>/dev/null)" ]; then
+if [ -z "$(find benchmarks/results/BENCH_TPU_attempt.json -newer "$START_MARK" 2>/dev/null)" ]; then
   rm -f "$START_MARK"
   note "chain: bench.py produced no FRESH attempt - abort"
   exit 1
 fi
 rm -f "$START_MARK"
-note "chain: captured fresh BENCH_TPU_attempt.json"
+note "chain: captured fresh benchmarks/results/BENCH_TPU_attempt.json"
 
 note "chain: step 1b shard_map pallas probe (multi-chip construction on 1 chip)"
 BENCH_INIT_TRIES=1 BENCH_INIT_TIMEOUT=120 \
